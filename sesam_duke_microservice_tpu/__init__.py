@@ -1,0 +1,23 @@
+"""sesam-duke-microservice_tpu — a TPU-native record-matching framework.
+
+A ground-up reimplementation of the capabilities of the
+``sesam-io/sesam-duke-microservice`` reference (an incremental deduplication /
+record-linkage REST microservice wrapping the Duke 1.2 entity-matching engine),
+redesigned TPU-first: the matching hot loop (candidate blocking -> per-property
+string similarity -> naive-Bayes combination) runs as batched JAX/XLA/Pallas
+programs over HBM-resident padded token tensors, sharded across a
+``jax.sharding.Mesh`` for multi-chip scale.
+
+Subpackages
+-----------
+core      Records, properties, cleaners, comparator oracles, config parsing.
+ops       JAX/Pallas device kernels (tokenize, levenshtein, jaro-winkler, ...).
+index     Candidate blocking backends (device top-k, host inverted index).
+engine    The match processor, listeners and device matcher.
+links     Link persistence (in-memory / sqlite) with `?since=` feeds.
+service   The HTTP frontend reproducing the reference REST surface.
+parallel  Mesh construction and sharded retrieval (shard_map + collectives).
+models    Flax record-encoder (embedding-ANN blocking) + training.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,19 @@
+"""Test configuration.
+
+Device-touching tests run on a virtual 8-device CPU mesh so the multi-chip
+sharding paths execute in CI without TPU hardware (the driver separately
+dry-runs the multi-chip path; see __graft_entry__.py).  Setting the XLA flags
+must happen before jax initializes, hence the env mutation at import time.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

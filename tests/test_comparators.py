@@ -1,0 +1,145 @@
+"""Unit tests for the host comparator oracles (core.comparators)."""
+
+import math
+
+import pytest
+
+from sesam_duke_microservice_tpu.core import comparators as C
+
+
+def test_levenshtein_distance_basic():
+    assert C.levenshtein_distance("kitten", "sitting") == 3
+    assert C.levenshtein_distance("", "abc") == 3
+    assert C.levenshtein_distance("abc", "abc") == 0
+    assert C.levenshtein_distance("abc", "axc") == 1
+
+
+def test_levenshtein_compare_semantics():
+    lev = C.Levenshtein()
+    assert lev.compare("oslo", "oslo") == 1.0
+    # one edit over min length 4 -> 0.75
+    assert lev.compare("oslo", "osla") == pytest.approx(0.75)
+    # length ratio early-exit: sim could never reach 0.5
+    assert lev.compare("ab", "abcdefgh") == 0.0
+    assert lev.compare("", "abc") == 0.0
+    # capped at min length: never negative
+    assert 0.0 <= lev.compare("abcd", "wxyz") <= 1.0
+
+
+def test_jaro_winkler_known_values():
+    jw = C.JaroWinkler()
+    assert jw.compare("martha", "marhta") == pytest.approx(0.9611, abs=1e-3)
+    assert jw.compare("dixon", "dicksonx") == pytest.approx(0.8133, abs=1e-3)
+    assert jw.compare("same", "same") == 1.0
+    assert jw.compare("abc", "xyz") == 0.0
+
+
+def test_jaro_winkler_prefix_boost():
+    jw = C.JaroWinkler()
+    # shared prefix should score above plain jaro
+    j = C._jaro("prefixes", "prefixed")
+    assert jw.compare("prefixes", "prefixed") > j
+
+
+def test_qgram_formulas():
+    q = C.QGram()
+    assert q.compare("abcd", "abcd") == 1.0
+    # qgrams(abcd)={ab,bc,cd}, qgrams(abcx)={ab,bc,cx}: common=2, overlap=2/3
+    assert q.compare("abcd", "abcx") == pytest.approx(2 / 3)
+    q.formula = "jaccard"
+    assert q.compare("abcd", "abcx") == pytest.approx(2 / 4)
+    q.formula = "dice"
+    assert q.compare("abcd", "abcx") == pytest.approx(4 / 6)
+
+
+def test_numeric_comparator():
+    num = C.Numeric()
+    num.set_param("min-ratio", "0.7")
+    assert num.compare("100", "100") == 1.0
+    assert num.compare("80", "100") == pytest.approx(0.8)
+    assert num.compare("60", "100") == 0.0  # below min-ratio
+    assert num.compare("abc", "100") == 0.5  # non-numeric is neutral
+    assert num.compare("-5", "5") == 0.0
+
+
+def test_exact_and_different():
+    assert C.Exact().compare("a", "a") == 1.0
+    assert C.Exact().compare("a", "b") == 0.0
+    assert C.Different().compare("a", "a") == 0.0
+    assert C.Different().compare("a", "b") == 1.0
+
+
+def test_token_set_comparators():
+    assert C.JaccardIndex().compare("a b c", "a b d") == pytest.approx(2 / 4)
+    assert C.DiceCoefficient().compare("a b c", "a b d") == pytest.approx(4 / 6)
+    assert C.JaccardIndex().compare("x", "") == 0.0
+
+
+def test_person_name():
+    pn = C.PersonName()
+    assert pn.compare("john smith", "john smith") == 1.0
+    assert pn.compare("john smith", "smith john") == pytest.approx(0.95)
+    assert pn.compare("j smith", "john smith") > 0.7
+    assert pn.compare("john smith", "jane doe") < 0.5
+
+
+def test_soundex():
+    assert C.soundex("Robert") == "R163"
+    assert C.soundex("Rupert") == "R163"
+    assert C.soundex("Ashcraft") == "A261"
+    s = C.Soundex()
+    assert s.compare("Robert", "Rupert") == 0.9
+    assert s.compare("Robert", "Robert") == 1.0
+
+
+def test_metaphone_and_norphone():
+    assert C.metaphone("Smith") == C.metaphone("Smyth")
+    m = C.Metaphone()
+    assert m.compare("Smith", "Smyth") == 0.9
+    n = C.Norphone()
+    assert n.compare("Kristian", "Christian") == 0.9
+
+
+def test_geoposition():
+    geo = C.Geoposition()
+    geo.set_param("max-distance", "1000")
+    assert geo.compare("59.91,10.75", "59.91,10.75") == 1.0
+    # ~111m per 0.001 deg latitude
+    sim = geo.compare("59.910,10.75", "59.911,10.75")
+    assert 0.85 < sim < 0.95
+    assert geo.compare("59.91,10.75", "60.91,10.75") == 0.0
+    assert geo.compare("garbage", "59.91,10.75") == 0.5
+
+
+def test_longest_common_substring():
+    lcs = C.LongestCommonSubstring()
+    assert lcs.compare("abcdef", "abcdef") == 1.0
+    assert lcs.compare("abcdef", "abcxyz") == pytest.approx(0.5)
+    assert lcs.compare("abc", "xyz") == 0.0
+
+
+def test_weighted_levenshtein():
+    wl = C.WeightedLevenshtein()
+    # digit edits cost more than letter edits
+    letters = wl.compare("abcdef", "abcdeg")
+    digits = wl.compare("123456", "123457")
+    assert digits < letters
+
+
+def test_registry_java_names():
+    for name in (
+        "no.priv.garshol.duke.comparators.Levenshtein",
+        "no.priv.garshol.duke.comparators.JaroWinkler",
+        "no.priv.garshol.duke.comparators.QGramComparator",
+        "no.priv.garshol.duke.comparators.NumericComparator",
+        "no.priv.garshol.duke.comparators.ExactComparator",
+    ):
+        comp = C.make_comparator(name)
+        assert 0.0 <= comp.compare("abc", "abd") <= 1.0
+    with pytest.raises(KeyError):
+        C.make_comparator("no.such.Comparator")
+
+
+def test_set_param_unknown_raises():
+    with pytest.raises(KeyError):
+        C.Numeric().set_param("no-such-param", "1")

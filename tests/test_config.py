@@ -1,0 +1,230 @@
+"""Tests for the two-level XML config parser (core.config)."""
+
+import pytest
+
+from sesam_duke_microservice_tpu.core import config as cfg
+from sesam_duke_microservice_tpu.core.comparators import Levenshtein, Numeric
+from sesam_duke_microservice_tpu.core.records import Lookup
+
+
+def demo_config_string():
+    with open(cfg.DEFAULT_CONFIG_RESOURCE, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def test_parse_bundled_demo_config():
+    sc = cfg.parse_config(demo_config_string(), env={})
+    assert set(sc.deduplications) == {"countries-dbpedia-mondial"}
+    assert set(sc.record_linkages) == {"countries-dbpedia-mondial"}
+
+    dedup = sc.deduplications["countries-dbpedia-mondial"]
+    assert dedup.duke.threshold == 0.9
+    comparison = dedup.duke.comparison_properties()
+    assert [p.name for p in comparison] == ["NAME", "AREA", "CAPITAL"]
+    name_prop = dedup.duke.property_by_name("NAME")
+    assert isinstance(name_prop.comparator, Levenshtein)
+    assert name_prop.low == 0.09 and name_prop.high == 0.93
+
+    # hidden properties injected
+    all_names = [p.name for p in dedup.duke.properties]
+    assert "ID" in all_names
+    assert "dukeDatasetId" in all_names
+    assert "dukeOriginalEntityId" in all_names
+    assert "dukeDeleted" in all_names
+    assert "dukeGroupNo" not in all_names  # dedup has no groups
+
+    # Duke resolves <comparator> by object *name*; the demo config references
+    # the class name, so AreaComparator's min-ratio is NOT applied (parity)
+    area_prop = dedup.duke.property_by_name("AREA")
+    assert isinstance(area_prop.comparator, Numeric)
+    assert area_prop.comparator.min_ratio == 0.0
+
+    # referencing the named object by name DOES apply its params
+    named_ref = demo_config_string().replace(
+        "<comparator>no.priv.garshol.duke.comparators.NumericComparator</comparator>",
+        "<comparator>AreaComparator</comparator>",
+    )
+    sc_named = cfg.parse_config(named_ref, env={})
+    area_named = sc_named.deduplications["countries-dbpedia-mondial"].duke.property_by_name("AREA")
+    assert area_named.comparator.min_ratio == pytest.approx(0.7)
+
+    # two datasources with cleaners wired
+    assert [ds.dataset_id for ds in dedup.duke.data_sources] == [
+        "countries-dbpedia",
+        "countries-mondial",
+    ]
+    col = dedup.duke.data_sources[0].columns[0]
+    assert col.name == "country" and col.property == "NAME"
+    assert col.cleaner("USA") == "united states"
+
+
+def test_parse_linkage_groups():
+    sc = cfg.parse_config(demo_config_string(), env={})
+    rl = sc.record_linkages["countries-dbpedia-mondial"]
+    assert rl.link_mode == "one-to-one"
+    assert rl.link_database_type == "h2"
+    assert rl.duke.threshold == 0.7
+    assert len(rl.duke.groups) == 2
+    assert rl.duke.groups[0][0].group_no == 1
+    assert rl.duke.groups[1][0].group_no == 2
+    assert "dukeGroupNo" in [p.name for p in rl.duke.properties]
+
+
+def test_env_flags():
+    env = {
+        "THREADS": "4",
+        "PROFILE": "1",
+        "MIN_RELEVANCE": "0.5",
+        "FUZZY_SEARCH": "TRUE",
+        "MAX_SEARCH_HITS": "25",
+    }
+    sc = cfg.parse_config(demo_config_string(), env=env)
+    assert sc.threads == 4
+    assert sc.profile is True
+    assert sc.tunables.min_relevance == 0.5
+    assert sc.tunables.fuzzy_search is True
+    assert sc.tunables.max_search_hits == 25
+    # non-numeric THREADS ignored (reference regex gate, App.java:233)
+    sc2 = cfg.parse_config(demo_config_string(), env={"THREADS": "x4"})
+    assert sc2.threads == 1
+
+
+MINIMAL_DEDUP = """
+<DukeMicroService>
+  <Deduplication name="d">
+    <duke>
+      <schema>
+        <threshold>0.8</threshold>
+        <property><name>N</name>
+          <comparator>levenshtein</comparator>
+          <low>0.1</low><high>0.9</high>
+        </property>
+      </schema>
+      <data-source class="io.sesam.dukemicroservice.IncrementalDeduplicationDataSource">
+        <param name="dataset-id" value="ds1"/>
+        <column name="n" property="N"/>
+      </data-source>
+    </duke>
+  </Deduplication>
+</DukeMicroService>
+"""
+
+
+def test_minimal_config_and_aliases():
+    sc = cfg.parse_config(MINIMAL_DEDUP, env={})
+    d = sc.deduplications["d"]
+    assert isinstance(d.duke.property_by_name("N").comparator, Levenshtein)
+    assert d.link_database_type == "h2"
+
+
+def _expect_error(xml, message_part):
+    with pytest.raises(cfg.ConfigError) as ei:
+        cfg.parse_config(xml, env={})
+    assert message_part in str(ei.value)
+
+
+def test_validation_errors():
+    _expect_error("<NotDuke/>", "didn't contain a 'DukeMicroService'")
+    _expect_error(
+        "<root><DukeMicroService/><DukeMicroService/></root>", "more than one"
+    )
+    _expect_error(
+        "<DukeMicroService><Bogus/></DukeMicroService>", "Unknown element 'Bogus'"
+    )
+    # user-defined id property rejected (App.java:303-307)
+    _expect_error(
+        MINIMAL_DEDUP.replace(
+            "<property><name>N</name>",
+            '<property type="id"><name>MYID</name></property><property><name>N</name>',
+        ),
+        "id'-property",
+    )
+    # '_id' column rejected (App.java:378-384)
+    _expect_error(
+        MINIMAL_DEDUP.replace('name="n"', 'name="_id"'), "'_id' column"
+    )
+    # wrong datasource class
+    _expect_error(
+        MINIMAL_DEDUP.replace("IncrementalDeduplicationDataSource", "SomethingElse"),
+        "unsupported type",
+    )
+    # missing dataset-id
+    _expect_error(
+        MINIMAL_DEDUP.replace('name="dataset-id" value="ds1"', 'name="x" value="y"'),
+        "no datasetId",
+    )
+
+
+def test_linkage_validation():
+    linkage = """
+    <DukeMicroService>
+      <RecordLinkage name="rl" link-mode="one-to-one">
+        <duke>
+          <schema><threshold>0.7</threshold>
+            <property><name>N</name><comparator>exact</comparator>
+              <low>0.1</low><high>0.9</high></property>
+          </schema>
+          <group>
+            <data-source class="io.sesam.dukemicroservice.IncrementalRecordLinkageDataSource">
+              <param name="dataset-id" value="a"/><column name="n" property="N"/>
+            </data-source>
+          </group>
+          <group>
+            <data-source class="io.sesam.dukemicroservice.IncrementalRecordLinkageDataSource">
+              <param name="dataset-id" value="b"/><column name="n" property="N"/>
+            </data-source>
+          </group>
+        </duke>
+      </RecordLinkage>
+    </DukeMicroService>
+    """
+    sc = cfg.parse_config(linkage, env={})
+    assert sc.record_linkages["rl"].duke.groups[1][0].dataset_id == "b"
+
+    _expect_error(linkage.replace('link-mode="one-to-one"', ''), "link-mode")
+    _expect_error(
+        linkage.replace('link-mode="one-to-one"', 'link-mode="many"'),
+        "Invalid link-mode",
+    )
+    # only one group
+    one_group = linkage.replace(
+        """<group>
+            <data-source class="io.sesam.dukemicroservice.IncrementalRecordLinkageDataSource">
+              <param name="dataset-id" value="b"/><column name="n" property="N"/>
+            </data-source>
+          </group>""",
+        "",
+    )
+    _expect_error(one_group, "exactly two <group>")
+
+
+def test_lookup_attribute():
+    xml = MINIMAL_DEDUP.replace(
+        "<property><name>N</name>",
+        '<property lookup="false"><name>M</name><comparator>exact</comparator>'
+        "<low>0.2</low><high>0.8</high></property><property><name>N</name>",
+    )
+    sc = cfg.parse_config(xml, env={})
+    duke = sc.deduplications["d"].duke
+    assert duke.property_by_name("M").lookup == Lookup.FALSE
+    lookups = [p.name for p in duke.lookup_properties()]
+    assert "M" not in lookups and "N" in lookups
+
+
+def test_invalid_lookup_value_is_config_error():
+    _expect_error(
+        MINIMAL_DEDUP.replace("<property>", '<property lookup="bogus">'),
+        "Invalid lookup value 'bogus'",
+    )
+
+
+def test_sqlite_alias_and_bad_linkdb():
+    sc = cfg.parse_config(
+        MINIMAL_DEDUP.replace('name="d"', 'name="d" link-database-type="sqlite"'),
+        env={},
+    )
+    assert sc.deduplications["d"].link_database_type == "h2"
+    _expect_error(
+        MINIMAL_DEDUP.replace('name="d"', 'name="d" link-database-type="bogus"'),
+        "unknown 'link-database-type'",
+    )

@@ -1,0 +1,85 @@
+"""Tests for core records, bayes math and cleaners."""
+
+import math
+
+import pytest
+
+from sesam_duke_microservice_tpu.core import bayes
+from sesam_duke_microservice_tpu.core import cleaners
+from sesam_duke_microservice_tpu.core.comparators import Levenshtein
+from sesam_duke_microservice_tpu.core.records import Property, Record
+
+
+def test_compute_bayes():
+    assert bayes.compute_bayes(0.5, 0.5) == pytest.approx(0.5)
+    assert bayes.compute_bayes(0.9, 0.9) == pytest.approx(0.81 / (0.81 + 0.01))
+    assert bayes.compute_bayes(0.5, 0.9) == pytest.approx(0.9)
+    assert bayes.compute_bayes(0.9, 0.1) == pytest.approx(0.5)
+
+
+def test_combine_probabilities_matches_pairwise_fold():
+    probs = [0.93, 0.73, 0.61, 0.12]
+    expected = 0.5
+    for p in probs:
+        expected = bayes.compute_bayes(expected, p)
+    assert bayes.combine_probabilities(probs) == pytest.approx(expected, rel=1e-9)
+
+
+def test_combine_probabilities_extremes_clamped():
+    assert bayes.combine_probabilities([1.0]) > 0.999
+    assert bayes.combine_probabilities([0.0]) < 0.001
+    assert math.isfinite(bayes.probability_logit(1.0))
+
+
+def test_property_compare_probability():
+    prop = Property("NAME", Levenshtein(), low=0.09, high=0.93)
+    # identical -> sim 1.0 -> (0.93-0.5)*1 + 0.5 = 0.93
+    assert prop.compare_probability("oslo", "oslo") == pytest.approx(0.93)
+    # sim 0.75 -> (0.43)*(0.5625) + 0.5
+    assert prop.compare_probability("oslo", "osla") == pytest.approx(0.43 * 0.5625 + 0.5)
+    # dissimilar -> low
+    assert prop.compare_probability("oslo", "reykjavik") == pytest.approx(0.09)
+    # no comparator -> neutral
+    assert Property("X").compare_probability("a", "b") == 0.5
+
+
+def test_record_basics():
+    r = Record()
+    r.add_value("NAME", "norway")
+    r.add_value("NAME", "norge")
+    r.add_value("EMPTY", "")
+    r.add_value("NONE", None)
+    assert r.get_values("NAME") == ["norway", "norge"]
+    assert r.get_value("NAME") == "norway"
+    assert r.get_values("EMPTY") == []
+    assert r.get_value("MISSING") is None
+    assert not r.is_deleted()
+    r.add_value("dukeDeleted", "true")
+    assert r.is_deleted()
+
+
+def test_cleaners():
+    assert cleaners.lower_case_normalize("  Ålesund   By ") == "alesund by"
+    assert cleaners.trim("  x ") == "x"
+    assert cleaners.digits_only("a1b2c3") == "123"
+    assert cleaners.family_comma_given("Smith, John") == "john smith"
+    assert cleaners.country_name("USA") == "united states"
+    assert cleaners.country_name("Norway") == "norway"
+    assert cleaners.capital("Mexico City") == "mexico"
+    assert cleaners.capital("Oslo (capital)") == "oslo"
+    assert cleaners.phone_number("+47 22 33 44 55") == "4722334455"
+
+
+def test_cleaner_registry():
+    c = cleaners.get_cleaner("no.priv.garshol.duke.cleaners.LowerCaseNormalizeCleaner")
+    assert c("ABC") == "abc"
+    with pytest.raises(KeyError):
+        cleaners.get_cleaner("no.such.Cleaner")
+
+
+def test_regexp_and_chained_cleaners():
+    rc = cleaners.RegexpCleaner(r"(\d+)")
+    assert rc("abc 123 def") == "123"
+    assert rc("no digits") is None
+    chain = cleaners.ChainedCleaner(cleaners.trim, cleaners.lower_case_normalize)
+    assert chain("  ABC  ") == "abc"
