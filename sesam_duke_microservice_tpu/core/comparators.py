@@ -326,6 +326,8 @@ class Numeric(Comparator):
             d2 = float(v2)
         except (TypeError, ValueError):
             return 0.5
+        if math.isnan(d1) or math.isnan(d2) or math.isinf(d1) or math.isinf(d2):
+            return 0.5
         if d1 == d2:
             return 1.0
         if d1 == 0.0 or d2 == 0.0 or (d1 < 0.0) != (d2 < 0.0):
@@ -441,10 +443,12 @@ def metaphone(value: str) -> str:
     i = 0
     n = len(v)
     vowels = "AEIOU"
+    # "\0" as the out-of-bounds sentinel: unlike "", it is never a member of
+    # the character-class strings tested below
     while i < n:
         c = v[i]
-        nxt = v[i + 1] if i + 1 < n else ""
-        prv = v[i - 1] if i > 0 else ""
+        nxt = v[i + 1] if i + 1 < n else "\0"
+        prv = v[i - 1] if i > 0 else "\0"
         if c in vowels:
             if i == 0:
                 out.append(c)
@@ -557,8 +561,6 @@ def norphone(value: str) -> str:
     for a, b in subs:
         v = v.replace(a, b)
     v = v.replace("C", "K").replace("W", "V").replace("Z", "S").replace("Q", "K")
-    if v.endswith("DT"):
-        v = v[:-2] + "T"
     # drop non-initial vowels, collapse runs
     vowels = "AEIOUYÆØÅ"
     out = [v[0]]
@@ -657,9 +659,10 @@ class LongestCommonSubstring(Comparator):
             return 0.0
         total = 0
         s1, s2 = v1, v2
+        min_take = max(1, self.minlen)  # minlen<=0 would loop forever on length-0 LCS
         while True:
             length, i, j = self._lcs(s1, s2)
-            if length < self.minlen:
+            if length < min_take:
                 break
             total += length
             s1 = s1[:i] + s1[i + length :]

@@ -139,12 +139,25 @@ class ServiceConfig:
     tunables: MatchTunables = field(default_factory=MatchTunables)
 
 
+def _parse_number(text: str, what: str, label: str) -> float:
+    try:
+        return float(text)
+    except (TypeError, ValueError):
+        raise ConfigError(f"Invalid {what} value '{text}' in the {label}") from None
+
+
 def _instantiate_object(class_name: str, params: Dict[str, str]):
     """Instantiate an <object> bean: comparator or cleaner."""
     if comparators_mod.has_comparator(class_name):
         obj = comparators_mod.make_comparator(class_name)
         for pname, pvalue in params.items():
-            obj.set_param(pname, pvalue)
+            try:
+                obj.set_param(pname, pvalue)
+            except (KeyError, ValueError) as e:
+                raise ConfigError(
+                    f"Invalid param '{pname}'='{pvalue}' for <object> "
+                    f"class '{class_name}': {e}"
+                ) from None
         return obj
     if class_name.endswith("RegexpCleaner"):
         return cleaners_mod.RegexpCleaner(
@@ -243,10 +256,12 @@ def parse_duke_element(duke_el: ET.Element, *, is_record_linkage: bool,
     thr_el = schema_el.find("threshold")
     if thr_el is None or thr_el.text is None:
         raise ConfigError(f"The {workload_label} schema has no <threshold>!")
-    threshold = float(thr_el.text.strip())
+    threshold = _parse_number(thr_el.text.strip(), "threshold", workload_label)
     maybe_el = schema_el.find("maybe-threshold")
     maybe_threshold = (
-        float(maybe_el.text.strip()) if maybe_el is not None and maybe_el.text else None
+        _parse_number(maybe_el.text.strip(), "maybe-threshold", workload_label)
+        if maybe_el is not None and maybe_el.text
+        else None
     )
 
     properties: List[Property] = []
@@ -270,8 +285,14 @@ def parse_duke_element(duke_el: ET.Element, *, is_record_linkage: bool,
             comparator = _resolve_comparator(comp_el.text.strip(), objects)
         low_el = prop_el.find("low")
         high_el = prop_el.find("high")
-        low = float(low_el.text.strip()) if low_el is not None and low_el.text else 0.3
-        high = float(high_el.text.strip()) if high_el is not None and high_el.text else 0.95
+        low = (
+            _parse_number(low_el.text.strip(), "low", workload_label)
+            if low_el is not None and low_el.text else 0.3
+        )
+        high = (
+            _parse_number(high_el.text.strip(), "high", workload_label)
+            if high_el is not None and high_el.text else 0.95
+        )
         lookup_raw = prop_el.get("lookup", "default")
         try:
             lookup = Lookup(lookup_raw)

@@ -1,0 +1,4 @@
+from .listeners import MatchListener, LinkMatchListener, ServiceMatchListener
+from .processor import Processor
+
+__all__ = ["MatchListener", "LinkMatchListener", "ServiceMatchListener", "Processor"]

@@ -1,0 +1,128 @@
+"""Match-event listeners.
+
+Reproduces the reference's listener chain: Duke's ``MatchListener`` event
+protocol (startProcessing/batchReady/matches/matchesPerhaps/noMatchFor/
+batchDone/endProcessing — BaseLinkDatabaseMatchListener.java:53-109), the
+link-database-forwarding listener, and the service listener that additionally
+accumulates per-entity matches for http-transform responses
+(BaseLinkDatabaseMatchListener.java:44-46,84-88,115-136) and can be switched
+off while a transform runs (lines 111-113).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..core.records import ORIGINAL_ENTITY_ID_PROPERTY_NAME, DATASET_ID_PROPERTY_NAME, Record
+from ..links.base import Link, LinkDatabase, LinkKind, LinkStatus
+
+
+class MatchListener:
+    def start_processing(self) -> None: ...
+    def batch_ready(self, size: int) -> None: ...
+    def matches(self, r1: Record, r2: Record, confidence: float) -> None: ...
+    def matches_perhaps(self, r1: Record, r2: Record, confidence: float) -> None: ...
+    def no_match_for(self, record: Record) -> None: ...
+    def batch_done(self) -> None: ...
+    def end_processing(self) -> None: ...
+
+
+class LinkMatchListener(MatchListener):
+    """Duke's LinkDatabaseMatchListener: persist match events as links."""
+
+    def __init__(self, linkdb: LinkDatabase):
+        self.linkdb = linkdb
+
+    def matches(self, r1: Record, r2: Record, confidence: float) -> None:
+        self.linkdb.assert_link(
+            Link(r1.record_id, r2.record_id, LinkStatus.INFERRED,
+                 LinkKind.DUPLICATE, confidence)
+        )
+
+    def matches_perhaps(self, r1: Record, r2: Record, confidence: float) -> None:
+        self.linkdb.assert_link(
+            Link(r1.record_id, r2.record_id, LinkStatus.INFERRED,
+                 LinkKind.MAYBE, confidence)
+        )
+
+    def batch_done(self) -> None:
+        self.linkdb.commit()
+
+
+class ServiceMatchListener(MatchListener):
+    """The workload listener: forwards to the link DB (unless disabled for
+    http-transform) and accumulates per-entity matches for the transform
+    response (``duke_links``)."""
+
+    def __init__(self, workload_name: str, linkdb: LinkDatabase,
+                 kind: str = "deduplication"):
+        self._wrapped = LinkMatchListener(linkdb)
+        self.link_database_updates_disabled = False
+        self._entity_matches: Dict[str, List[Tuple[Record, float]]] = {}
+        prefix = (
+            "recordLinkageMatchListener" if kind == "recordlinkage"
+            else "deduplicationMatchListener"
+        )
+        self.logger = logging.getLogger(f"{prefix}-{workload_name}")
+        self._batch_start: Optional[float] = None
+
+    def set_link_database_updates_disabled(self, disabled: bool) -> None:
+        self.link_database_updates_disabled = disabled
+
+    def batch_ready(self, size: int) -> None:
+        self._entity_matches = {}
+        self._batch_start = time.monotonic()
+        self.logger.info("batchReady(size=%d)", size)
+        if not self.link_database_updates_disabled:
+            self._wrapped.batch_ready(size)
+
+    def batch_done(self) -> None:
+        if not self.link_database_updates_disabled:
+            self._wrapped.batch_done()
+        if self._batch_start is not None:
+            self.logger.info(
+                "batchDone() batchElapsedTime: %s seconds.",
+                time.monotonic() - self._batch_start,
+            )
+
+    def matches(self, r1: Record, r2: Record, confidence: float) -> None:
+        if not self.link_database_updates_disabled:
+            self._wrapped.matches(r1, r2, confidence)
+        self._record_entity_match(r1, r2, confidence)
+
+    def matches_perhaps(self, r1: Record, r2: Record, confidence: float) -> None:
+        if not self.link_database_updates_disabled:
+            self._wrapped.matches_perhaps(r1, r2, confidence)
+        self._record_entity_match(r1, r2, confidence)
+
+    def no_match_for(self, record: Record) -> None:
+        if not self.link_database_updates_disabled:
+            self._wrapped.no_match_for(record)
+
+    def start_processing(self) -> None:
+        if not self.link_database_updates_disabled:
+            self._wrapped.start_processing()
+
+    def end_processing(self) -> None:
+        if not self.link_database_updates_disabled:
+            self._wrapped.end_processing()
+
+    def _record_entity_match(self, r1: Record, r2: Record, confidence: float) -> None:
+        entity_id = r1.get_value(ORIGINAL_ENTITY_ID_PROPERTY_NAME)
+        self._entity_matches.setdefault(entity_id, []).append((r2, confidence))
+
+    def get_links_for_entity(self, entity_id: str) -> List[dict]:
+        """duke_links rows for one input entity
+        (BaseLinkDatabaseMatchListener.java:115-136)."""
+        out = []
+        for record, confidence in self._entity_matches.get(entity_id, []):
+            out.append(
+                {
+                    "datasetId": record.get_value(DATASET_ID_PROPERTY_NAME),
+                    "entityId": record.get_value(ORIGINAL_ENTITY_ID_PROPERTY_NAME),
+                    "confidence": confidence,
+                }
+            )
+        return out

@@ -1,0 +1,153 @@
+"""The matching engine — host reference implementation.
+
+Reproduces the Duke 1.2 ``Processor.deduplicate(List<Record>)`` contract the
+reference drives for both workloads (App.java:1005, App.java:1159; SURVEY.md
+section 3.2 call stack):
+
+    batch_ready(n)
+    index every record; commit the blocking database
+    for each record: candidates = database.find_candidate_matches(record)
+        for each candidate (skipping self): prob = compare(record, candidate)
+            prob > threshold        -> matches()
+            prob > maybe_threshold  -> matches_perhaps()
+        no qualifying candidate     -> no_match_for()
+    batch_done()
+
+Pair probability: per comparison property, the max over value pairs of
+``Property.compare_probability``, folded with naive Bayes from a 0.5 prior;
+properties with no values on either side contribute nothing.
+
+This host engine is the semantic oracle and CPU baseline.  The TPU path
+(``engine.device_matcher``) replaces the inner loops with batched device
+programs but must produce the same events; differential tests hold the two
+together.  ``threads`` mirrors the reference's ``Processor.setThreads``
+(App.java:344) by fanning the per-record loop over a thread pool.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+from ..core.bayes import combine_probabilities
+from ..core.config import DukeSchema
+from ..core.records import Record
+from ..index.base import CandidateIndex
+from .listeners import MatchListener
+
+
+@dataclass
+class ProfileStats:
+    batches: int = 0
+    records_processed: int = 0
+    candidates_retrieved: int = 0
+    pairs_compared: int = 0
+    retrieval_seconds: float = 0.0
+    compare_seconds: float = 0.0
+
+    def merge(self, other: "ProfileStats") -> None:
+        self.batches += other.batches
+        self.records_processed += other.records_processed
+        self.candidates_retrieved += other.candidates_retrieved
+        self.pairs_compared += other.pairs_compared
+        self.retrieval_seconds += other.retrieval_seconds
+        self.compare_seconds += other.compare_seconds
+
+
+class Processor:
+    def __init__(self, schema: DukeSchema, database: CandidateIndex,
+                 *, group_filtering: bool = False, threads: int = 1,
+                 profile: bool = False):
+        self.schema = schema
+        self.database = database
+        self.group_filtering = group_filtering
+        self.threads = max(1, threads)
+        self.profile = profile
+        self.listeners: List[MatchListener] = []
+        self.stats = ProfileStats()
+        self._listener_lock = threading.Lock()
+
+    def add_match_listener(self, listener: MatchListener) -> None:
+        self.listeners.append(listener)
+
+    # -- pair scoring -------------------------------------------------------
+
+    def compare(self, r1: Record, r2: Record) -> float:
+        """Naive-Bayes pair probability over comparison properties."""
+        probs = []
+        for prop in self.schema.comparison_properties():
+            vs1 = [v for v in r1.get_values(prop.name) if v]
+            vs2 = [v for v in r2.get_values(prop.name) if v]
+            if not vs1 or not vs2:
+                continue
+            best = 0.0
+            for v1 in vs1:
+                for v2 in vs2:
+                    p = prop.compare_probability(v1, v2)
+                    if p > best:
+                        best = p
+            probs.append(best)
+        return combine_probabilities(probs)
+
+    # -- batch processing ---------------------------------------------------
+
+    def deduplicate(self, records: Sequence[Record]) -> None:
+        for listener in self.listeners:
+            listener.batch_ready(len(records))
+
+        for record in records:
+            self.database.index(record)
+        self.database.commit()
+
+        if self.threads == 1:
+            for record in records:
+                self._match_record(record)
+        else:
+            with ThreadPoolExecutor(max_workers=self.threads) as pool:
+                list(pool.map(self._match_record, records))
+
+        self.stats.batches += 1
+        for listener in self.listeners:
+            listener.batch_done()
+
+    def _match_record(self, record: Record) -> None:
+        t0 = time.monotonic()
+        candidates = self.database.find_candidate_matches(
+            record, group_filtering=self.group_filtering
+        )
+        t1 = time.monotonic()
+
+        found = False
+        threshold = self.schema.threshold
+        maybe = self.schema.maybe_threshold
+        pairs = 0
+        for candidate in candidates:
+            if candidate.record_id == record.record_id:
+                continue
+            prob = self.compare(record, candidate)
+            pairs += 1
+            if prob > threshold:
+                found = True
+                self._emit("matches", record, candidate, prob)
+            elif maybe is not None and maybe != 0.0 and prob > maybe:
+                found = True
+                self._emit("matches_perhaps", record, candidate, prob)
+        if not found:
+            with self._listener_lock:
+                for listener in self.listeners:
+                    listener.no_match_for(record)
+
+        t2 = time.monotonic()
+        self.stats.records_processed += 1
+        self.stats.candidates_retrieved += len(candidates)
+        self.stats.pairs_compared += pairs
+        self.stats.retrieval_seconds += t1 - t0
+        self.stats.compare_seconds += t2 - t1
+
+    def _emit(self, event: str, r1: Record, r2: Record, prob: float) -> None:
+        with self._listener_lock:
+            for listener in self.listeners:
+                getattr(listener, event)(r1, r2, prob)

@@ -1,0 +1,169 @@
+"""Per-workload runtime bundle and the ingest/feed flows.
+
+The equivalent of the reference's ``App.Deduplication`` / ``App.RecordLinkage``
+inner classes (App.java:87-189): each workload owns its datasources, blocking
+index, processor, listener, link database, and a lock serializing access
+(writers block; readers time out after 1 s and surface 503 — App.java:718-725,
+827-834, enforced by the HTTP layer).
+
+Flow parity notes:
+  * POST batch (App.java:924-1028 / 1065-1179): parse -> records -> partition
+    deleted/live -> tombstone + retract links for deleted -> deduplicate live.
+  * Deleted-record detection uses the hidden ``dukeDeleted`` property for
+    BOTH workloads.  The reference's dedup path checks a nonexistent
+    ``_deleted`` property (App.java:974) so its dedup deletes never retract
+    links (SURVEY.md quirk Q2) — deliberately fixed here.
+  * http-transform disables indexing AND link-db updates for BOTH workloads.
+    The reference only does so for record linkage (quirk Q6: a dedup
+    "transform" has full side effects) — deliberately fixed here.
+  * GET feed rows (App.java:744-770): `_id` = id1+"_"+id2 with ':'->'_',
+    `_updated` = link timestamp, `_deleted` = retracted, entity/dataset
+    fields resolved by index point-lookups.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from ..core.config import ServiceConfig, WorkloadConfig
+from ..core.records import (
+    DATASET_ID_PROPERTY_NAME,
+    ORIGINAL_ENTITY_ID_PROPERTY_NAME,
+    Record,
+)
+from ..index.base import CandidateIndex
+from ..index.inverted import InvertedIndex
+from ..links import create_link_database
+from ..links.base import LinkDatabase, LinkStatus
+from ..service.datasource import IncrementalDataSource
+from .listeners import ServiceMatchListener
+from .processor import Processor
+
+
+class Workload:
+    def __init__(self, config: WorkloadConfig, index: CandidateIndex,
+                 processor: Processor, listener: ServiceMatchListener,
+                 link_database: LinkDatabase):
+        self.config = config
+        self.name = config.name
+        self.kind = config.kind
+        self.index = index
+        self.processor = processor
+        self.listener = listener
+        self.link_database = link_database
+        self.lock = threading.Lock()
+        self.datasources: Dict[str, IncrementalDataSource] = {
+            ds.dataset_id: IncrementalDataSource(ds)
+            for ds in config.duke.data_sources
+        }
+
+    # -- ingest + match (call with self.lock held) --------------------------
+
+    def process_batch(self, dataset_id: str, entities: Sequence[dict],
+                      http_transform: bool = False) -> List[dict]:
+        """Ingest a batch and run matching; returns the transform response
+        rows (input entities + duke_links) when ``http_transform``."""
+        datasource = self.datasources[dataset_id]
+        records = datasource.records_for_batch(entities)
+        live = [r for r in records if not r.is_deleted()]
+        deleted = [r for r in records if r.is_deleted()]
+
+        try:
+            if http_transform:
+                self.index.set_indexing_disabled(True)
+                self.listener.set_link_database_updates_disabled(True)
+            else:
+                for record in deleted:
+                    # tombstone in the index (still resolvable by the GET
+                    # feed's point lookups), then retract its links
+                    self.index.index(record)
+                    for link in self.link_database.get_all_links_for(record.record_id):
+                        link.retract()
+                        self.link_database.assert_link(link)
+                if deleted:
+                    self.index.commit()
+
+            if live or http_transform:
+                self.processor.deduplicate(live)
+
+            if http_transform:
+                return self._transform_response(entities)
+            return []
+        finally:
+            self.index.set_indexing_disabled(False)
+            self.listener.set_link_database_updates_disabled(False)
+
+    def _transform_response(self, entities: Sequence[dict]) -> List[dict]:
+        rows = []
+        for entity in entities:
+            row = dict(entity)
+            entity_id = entity.get("_id")
+            entity_id = str(entity_id) if entity_id is not None else None
+            row["duke_links"] = self.listener.get_links_for_entity(entity_id)
+            rows.append(row)
+        return rows
+
+    # -- incremental feed (call with self.lock held) ------------------------
+
+    def links_since(self, since: int = 0) -> List[dict]:
+        rows = []
+        for link in self.link_database.get_changes_since(since):
+            r1 = self.index.find_record_by_id(link.id1)
+            r2 = self.index.find_record_by_id(link.id2)
+            rows.append(
+                {
+                    "_id": f"{link.id1}_{link.id2}".replace(":", "_"),
+                    "_updated": link.timestamp,
+                    "_deleted": link.status == LinkStatus.RETRACTED,
+                    "entity1": r1.get_value(ORIGINAL_ENTITY_ID_PROPERTY_NAME) if r1 else None,
+                    "entity2": r2.get_value(ORIGINAL_ENTITY_ID_PROPERTY_NAME) if r2 else None,
+                    "dataset1": r1.get_value(DATASET_ID_PROPERTY_NAME) if r1 else None,
+                    "dataset2": r2.get_value(DATASET_ID_PROPERTY_NAME) if r2 else None,
+                    "confidence": link.confidence,
+                }
+            )
+        return rows
+
+    def close(self) -> None:
+        """Release index/link-db resources (the reference leaks these on hot
+        reload — SURVEY.md quirk Q7; fixed by calling this on config swap)."""
+        self.index.close()
+        self.link_database.close()
+
+
+def build_workload(wc: WorkloadConfig, sc: ServiceConfig, *,
+                   backend: str = "host",
+                   persistent: bool = True) -> Workload:
+    """Assemble a workload: blocking index + processor + listener + link DB.
+
+    ``backend``: 'host' (inverted index + scalar scoring — the conformance/
+    baseline path) or 'device' (TPU-resident corpus + batched kernels, see
+    engine.device_matcher).
+    """
+    group_filtering = wc.is_record_linkage
+    if backend == "device":
+        from .device_matcher import DeviceIndex, DeviceProcessor
+
+        index = DeviceIndex(wc.duke, tunables=sc.tunables)
+        processor = DeviceProcessor(
+            wc.duke, index, group_filtering=group_filtering, profile=sc.profile
+        )
+    else:
+        index = InvertedIndex(wc.duke, tunables=sc.tunables)
+        processor = Processor(
+            wc.duke,
+            index,
+            group_filtering=group_filtering,
+            threads=sc.threads,
+            profile=sc.profile,
+        )
+
+    link_database = create_link_database(
+        wc.link_database_type,
+        wc.data_folder if persistent else None,
+        is_record_linkage=wc.is_record_linkage,
+    )
+    listener = ServiceMatchListener(wc.name, link_database, kind=wc.kind)
+    processor.add_match_listener(listener)
+    return Workload(wc, index, processor, listener, link_database)

@@ -1,0 +1,4 @@
+from .base import CandidateIndex
+from .inverted import InvertedIndex
+
+__all__ = ["CandidateIndex", "InvertedIndex"]

@@ -1,0 +1,29 @@
+from .base import Link, LinkStatus, LinkKind, LinkDatabase
+from .memory import InMemoryLinkDatabase
+from .sqlite import SqliteLinkDatabase
+
+__all__ = [
+    "Link",
+    "LinkStatus",
+    "LinkKind",
+    "LinkDatabase",
+    "InMemoryLinkDatabase",
+    "SqliteLinkDatabase",
+]
+
+
+def create_link_database(link_database_type: str, data_folder=None,
+                         is_record_linkage: bool = False) -> LinkDatabase:
+    """Factory mirroring App.java:566-611: 'h2' (durable; SQLite here) or
+    'in-memory'."""
+    import os
+
+    if link_database_type == "in-memory":
+        return InMemoryLinkDatabase()
+    if link_database_type == "h2":
+        if data_folder is None:
+            return InMemoryLinkDatabase()
+        name = "recordlinkdatabase" if is_record_linkage else "linkdatabase"
+        os.makedirs(data_folder, exist_ok=True)
+        return SqliteLinkDatabase(os.path.join(data_folder, name + ".sqlite"))
+    raise ValueError(f"Got an unknown 'link-database-type' value: '{link_database_type}'")

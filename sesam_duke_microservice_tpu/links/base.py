@@ -1,0 +1,123 @@
+"""Link model and link-database interface.
+
+Re-expresses the Duke 1.2 link API surface the reference drives
+(``Link``/``LinkStatus``/``LinkDatabase`` — App.java:63-65,997-1000;
+SinceAwareInMemoryLinkDatabase.java) in Python.  A link records that two
+record ids were inferred to (maybe) refer to the same entity; clients poll
+changes incrementally by millisecond timestamp (``get_changes_since``,
+served by GET /deduplication/:name?since=N — App.java:843).
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from typing import List, Optional
+
+
+class LinkStatus(enum.Enum):
+    ASSERTED = "asserted"
+    INFERRED = "inferred"
+    UNKNOWN = "unknown"
+    RETRACTED = "retracted"
+
+
+class LinkKind(enum.Enum):
+    DUPLICATE = "duplicate"
+    MAYBE = "maybe"
+    DIFFERENT = "different"
+
+
+_last_millis = 0
+_millis_lock = __import__("threading").Lock()
+
+
+def now_millis() -> int:
+    """Millisecond wall-clock, strictly monotonic per process.
+
+    The reference stamps links with System.currentTimeMillis, so two updates
+    to the same link within one millisecond are indistinguishable to a
+    ``?since=`` poller.  Bumping by 1ms on collision keeps every change
+    observable without altering the wire format.
+    """
+    global _last_millis
+    with _millis_lock:
+        now = int(time.time() * 1000)
+        if now <= _last_millis:
+            now = _last_millis + 1
+        _last_millis = now
+        return now
+
+
+class Link:
+    """An (id1, id2) pair with status/kind/confidence/timestamp.
+
+    Ids are stored in sorted order so (a, b) and (b, a) are the same link
+    (Duke's Link constructor normalizes the same way; the feed's ``_id`` is
+    ``id1 + "_" + id2`` — App.java:759).
+    """
+
+    __slots__ = ("id1", "id2", "status", "kind", "confidence", "timestamp")
+
+    def __init__(self, id1: str, id2: str, status: LinkStatus, kind: LinkKind,
+                 confidence: float, timestamp: Optional[int] = None):
+        if id1 > id2:
+            id1, id2 = id2, id1
+        self.id1 = id1
+        self.id2 = id2
+        self.status = status
+        self.kind = kind
+        self.confidence = float(confidence)
+        self.timestamp = now_millis() if timestamp is None else int(timestamp)
+
+    def key(self):
+        return (self.id1, self.id2)
+
+    def retract(self) -> None:
+        """Mark the link retracted and touch the timestamp (Duke Link.retract;
+        driven at App.java:997-1000)."""
+        self.status = LinkStatus.RETRACTED
+        self.timestamp = now_millis()
+
+    def copy(self) -> "Link":
+        return Link(self.id1, self.id2, self.status, self.kind,
+                    self.confidence, self.timestamp)
+
+    def __repr__(self) -> str:
+        return (f"Link({self.id1!r}, {self.id2!r}, {self.status.value}, "
+                f"{self.kind.value}, {self.confidence:.4f}, ts={self.timestamp})")
+
+
+class LinkDatabase:
+    """Interface: assert/retrieve links, incremental change feed."""
+
+    def assert_link(self, link: Link) -> None:
+        raise NotImplementedError
+
+    def get_all_links_for(self, record_id: str) -> List[Link]:
+        raise NotImplementedError
+
+    def get_all_links(self) -> List[Link]:
+        raise NotImplementedError
+
+    def get_changes_since(self, since: int) -> List[Link]:
+        raise NotImplementedError
+
+    def commit(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+# Idempotence tolerance for repeated asserts of an unchanged link
+# (SinceAwareInMemoryLinkDatabase.java:22-24)
+CONFIDENCE_EPSILON = 1e-6
+
+
+def is_same_assertion(old: Link, new: Link) -> bool:
+    return (
+        old.status == new.status
+        and old.kind == new.kind
+        and abs(old.confidence - new.confidence) < CONFIDENCE_EPSILON
+    )

@@ -1,0 +1,40 @@
+"""In-memory link database with since-feed and idempotent assert.
+
+Parity target: SinceAwareInMemoryLinkDatabase.java:10-42 — re-asserting an
+identical link (same status/kind, |confidence delta| < 1e-6) must NOT bump
+the timestamp, so pollers don't see spurious changes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from .base import Link, LinkDatabase, is_same_assertion
+
+
+class InMemoryLinkDatabase(LinkDatabase):
+    def __init__(self):
+        self._links: Dict[Tuple[str, str], Link] = {}
+
+    def assert_link(self, link: Link) -> None:
+        old = self._links.get(link.key())
+        if old is not None and is_same_assertion(old, link):
+            return
+        self._links[link.key()] = link
+
+    def get_all_links_for(self, record_id: str) -> List[Link]:
+        return [
+            l for l in self._links.values()
+            if l.id1 == record_id or l.id2 == record_id
+        ]
+
+    def get_all_links(self) -> List[Link]:
+        return list(self._links.values())
+
+    def get_changes_since(self, since: int) -> List[Link]:
+        # linear timestamp scan (SinceAwareInMemoryLinkDatabase.java:33-41),
+        # strictly-greater-than semantics
+        return sorted(
+            (l for l in self._links.values() if l.timestamp > since),
+            key=lambda l: (l.timestamp, l.id1, l.id2),
+        )

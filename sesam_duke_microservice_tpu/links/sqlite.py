@@ -1,0 +1,102 @@
+"""Durable link database on SQLite.
+
+The durable backend behind ``link-database-type="h2"`` (the reference embeds
+H2 via Duke's JDBCLinkDatabase, App.java:577-604; SQLite is the natural
+stdlib equivalent).  Same semantics as the in-memory flavor: idempotent
+assert, strictly-greater-than since feed, retraction as a status update.
+Safe for multi-threaded use (one connection per thread).
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+from typing import List
+
+from .base import Link, LinkDatabase, LinkKind, LinkStatus, is_same_assertion
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS links (
+    id1 TEXT NOT NULL,
+    id2 TEXT NOT NULL,
+    status TEXT NOT NULL,
+    kind TEXT NOT NULL,
+    confidence REAL NOT NULL,
+    timestamp INTEGER NOT NULL,
+    PRIMARY KEY (id1, id2)
+);
+CREATE INDEX IF NOT EXISTS links_ts ON links (timestamp);
+CREATE INDEX IF NOT EXISTS links_id2 ON links (id2);
+"""
+
+
+class SqliteLinkDatabase(LinkDatabase):
+    def __init__(self, path: str):
+        self.path = path
+        if path != ":memory:":
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._local = threading.local()
+        with self._conn() as conn:
+            conn.executescript(_SCHEMA)
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self.path)
+            conn.execute("PRAGMA journal_mode=WAL")
+            self._local.conn = conn
+        return conn
+
+    @staticmethod
+    def _row_to_link(row) -> Link:
+        return Link(row[0], row[1], LinkStatus(row[2]), LinkKind(row[3]),
+                    row[4], row[5])
+
+    def assert_link(self, link: Link) -> None:
+        conn = self._conn()
+        cur = conn.execute(
+            "SELECT id1, id2, status, kind, confidence, timestamp FROM links "
+            "WHERE id1=? AND id2=?",
+            (link.id1, link.id2),
+        )
+        row = cur.fetchone()
+        if row is not None and is_same_assertion(self._row_to_link(row), link):
+            return
+        conn.execute(
+            "INSERT INTO links (id1, id2, status, kind, confidence, timestamp) "
+            "VALUES (?,?,?,?,?,?) ON CONFLICT(id1, id2) DO UPDATE SET "
+            "status=excluded.status, kind=excluded.kind, "
+            "confidence=excluded.confidence, timestamp=excluded.timestamp",
+            (link.id1, link.id2, link.status.value, link.kind.value,
+             link.confidence, link.timestamp),
+        )
+        conn.commit()
+
+    def get_all_links_for(self, record_id: str) -> List[Link]:
+        cur = self._conn().execute(
+            "SELECT id1, id2, status, kind, confidence, timestamp FROM links "
+            "WHERE id1=? OR id2=?",
+            (record_id, record_id),
+        )
+        return [self._row_to_link(r) for r in cur.fetchall()]
+
+    def get_all_links(self) -> List[Link]:
+        cur = self._conn().execute(
+            "SELECT id1, id2, status, kind, confidence, timestamp FROM links"
+        )
+        return [self._row_to_link(r) for r in cur.fetchall()]
+
+    def get_changes_since(self, since: int) -> List[Link]:
+        cur = self._conn().execute(
+            "SELECT id1, id2, status, kind, confidence, timestamp FROM links "
+            "WHERE timestamp > ? ORDER BY timestamp, id1, id2",
+            (since,),
+        )
+        return [self._row_to_link(r) for r in cur.fetchall()]
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None

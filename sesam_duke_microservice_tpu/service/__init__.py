@@ -1,0 +1,3 @@
+from .datasource import IncrementalDataSource, IngestError
+
+__all__ = ["IncrementalDataSource", "IngestError"]

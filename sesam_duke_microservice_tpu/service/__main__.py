@@ -1,0 +1,42 @@
+"""CLI entrypoint: ``python -m sesam_duke_microservice_tpu.service``.
+
+Equivalent of the reference's ``java -jar`` entrypoint (Dockerfile:8): loads
+CONFIG_STRING or the bundled demo config and serves on port 4567 (PORT env /
+--port override).  ``--backend device`` selects the TPU matcher.
+"""
+
+import argparse
+import logging
+import os
+
+from .app import DEFAULT_PORT, create_app, serve
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="TPU-native Duke record-matching microservice")
+    parser.add_argument("--port", type=int,
+                        default=int(os.environ.get("PORT", DEFAULT_PORT)))
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--backend", choices=["host", "device"],
+                        default=os.environ.get("DUKE_TPU_BACKEND", "host"))
+    parser.add_argument("--ephemeral", action="store_true",
+                        help="keep all state in memory (no data folder writes)")
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    app = create_app(backend=args.backend, persistent=not args.ephemeral)
+    server = serve(app, port=args.port, host=args.host)
+    logging.getLogger("duke-tpu-service").info(
+        "Serving on %s:%d (backend=%s)", args.host, args.port, args.backend
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,317 @@
+"""The HTTP frontend — full reference REST surface.
+
+Route-for-route reproduction of App.java:649-887 on the stdlib threading
+HTTP server (the reference uses Spark-Java/Jetty on port 4567):
+
+    GET  /                                                homepage
+    GET  /config                                          active XML verbatim
+    POST /config                                          multipart hot reload
+    POST /deduplication/:name/:datasetId                  ingest+match
+    POST /deduplication/:name/:datasetId/httptransform    transform
+    GET  /deduplication/:name/:datasetId[/httptransform]  405 after validation
+    GET  /deduplication/:name?since=N                     incremental feed
+    (same six shapes under /recordlinkage)
+
+Semantics preserved: writers take the workload lock unconditionally; feed
+readers try for 1 s and answer 503 with the reference's message
+(App.java:718-725, 827-834); POST body may be a JSON array or a single
+object, and a single-entity transform answers a single object
+(App.java:952-965, 1196-1198); unknown names 404 on entity endpoints and 400
+on feeds; valid-name GETs on POST-only endpoints answer 405.
+
+Documented divergences: the reference 500s (NPE) on an unknown recordlinkage
+feed name — here both feeds answer 400; malformed JSON answers 400 rather
+than a Jetty stack-trace 500; hot reload closes the replaced workloads'
+resources (fixing quirk Q7's index/connection leak).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from email.parser import BytesParser
+from email.policy import default as email_policy
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..core.config import ConfigError, ServiceConfig, load_default_config, parse_config
+from ..engine.workload import Workload, build_workload
+from .homepage import render_homepage
+
+logger = logging.getLogger("duke-tpu-service")
+
+DEFAULT_PORT = 4567  # the reference's Spark default (Dockerfile EXPOSE 4567)
+
+READ_LOCK_TIMEOUT_SECONDS = 1.0
+_BUSY_TEMPLATE = (
+    "The {kind} is being written to, so reading is not currently possible. "
+    "Please wait a bit and try again later."
+)
+
+
+class DukeApp:
+    """Application state: parsed config + live workloads, hot-swappable."""
+
+    def __init__(self, config: ServiceConfig, *, backend: str = "host",
+                 persistent: bool = True):
+        self.backend = backend
+        self.persistent = persistent
+        self._swap_lock = threading.Lock()
+        self.config: Optional[ServiceConfig] = None
+        self.deduplications: Dict[str, Workload] = {}
+        self.record_linkages: Dict[str, Workload] = {}
+        self.apply_config(config)
+
+    @property
+    def config_string(self) -> str:
+        return self.config.config_string if self.config else ""
+
+    def apply_config(self, sc: ServiceConfig) -> None:
+        """Build all workloads, then atomically swap (App.java:543-546) and
+        close the replaced ones (quirk Q7 fix)."""
+        new_dedups = {
+            name: build_workload(wc, sc, backend=self.backend,
+                                 persistent=self.persistent)
+            for name, wc in sc.deduplications.items()
+        }
+        new_linkages = {
+            name: build_workload(wc, sc, backend=self.backend,
+                                 persistent=self.persistent)
+            for name, wc in sc.record_linkages.items()
+        }
+        with self._swap_lock:
+            old = list(self.deduplications.values()) + list(self.record_linkages.values())
+            self.config = sc
+            self.deduplications = new_dedups
+            self.record_linkages = new_linkages
+        for wl in old:
+            try:
+                wl.close()
+            except Exception:
+                logger.exception("Error closing replaced workload")
+
+    def reload_from_string(self, config_string: str) -> None:
+        self.apply_config(parse_config(config_string))
+
+
+class _HttpError(Exception):
+    def __init__(self, status: int, message: str, content_type: str = "text/plain"):
+        self.status = status
+        self.message = message
+        self.content_type = content_type
+
+
+_ENTITY_PATH = re.compile(
+    r"^/(deduplication|recordlinkage)/([^/]*)/([^/]*?)(/httptransform)?$"
+)
+_FEED_PATH = re.compile(r"^/(deduplication|recordlinkage)/([^/]*)$")
+
+
+class DukeRequestHandler(BaseHTTPRequestHandler):
+    app: DukeApp = None  # set by serve()
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing -----------------------------------------------------------
+
+    def log_message(self, fmt, *args):
+        logger.info("%s %s", self.address_string(), fmt % args)
+
+    def _reply(self, status: int, body: bytes, content_type: str = "application/json",
+               extra_headers: Optional[dict] = None) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            # client went away mid-response; the reference swallows Jetty's
+            # EofException the same way (App.java:780-786)
+            logger.info("Ignoring client disconnect on %s", self.path)
+
+    def _reply_text(self, status: int, message: str) -> None:
+        self._reply(status, message.encode("utf-8"), "text/plain")
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
+    # -- routing ------------------------------------------------------------
+
+    def do_GET(self):
+        try:
+            self._read_body()  # drain; unread bytes would corrupt keep-alive
+            parsed = urlparse(self.path)
+            path = parsed.path
+            if path == "/":
+                self._reply(200, render_homepage(self.app).encode("utf-8"), "text/html")
+            elif path == "/config":
+                self._reply(200, self.app.config_string.encode("utf-8"), "application/xml")
+            elif m := _ENTITY_PATH.match(path):
+                self._validate_entity_path(m)
+                raise _HttpError(405, "This endpoint only supports POST requests.")
+            elif m := _FEED_PATH.match(path):
+                self._handle_feed(m, parse_qs(parsed.query))
+            else:
+                raise _HttpError(404, "Not found")
+        except _HttpError as e:
+            self._reply_text(e.status, e.message)
+        except Exception:
+            logger.exception("Error serving GET %s", self.path)
+            self._reply_text(500, "Internal server error")
+
+    def do_POST(self):
+        try:
+            # read the body up front: replying with the body unread would
+            # leave its bytes to be parsed as the next keep-alive request
+            body = self._read_body()
+            path = urlparse(self.path).path
+            if path == "/config":
+                self._handle_config_upload(body)
+            elif m := _ENTITY_PATH.match(path):
+                self._handle_post_batch(m, body)
+            else:
+                raise _HttpError(404, "Not found")
+        except _HttpError as e:
+            self._reply_text(e.status, e.message)
+        except Exception:
+            logger.exception("Error serving POST %s", self.path)
+            self._reply_text(500, "Internal server error")
+
+    # -- handlers -----------------------------------------------------------
+
+    def _workloads(self, kind: str) -> Dict[str, Workload]:
+        return (self.app.deduplications if kind == "deduplication"
+                else self.app.record_linkages)
+
+    def _validate_entity_path(self, m) -> Tuple[str, Workload, str, bool]:
+        kind, name, dataset_id, transform = m.group(1), m.group(2), m.group(3), bool(m.group(4))
+        label = "deduplication" if kind == "deduplication" else "recordLinkage"
+        if not name:
+            raise _HttpError(404, f"The {label}Name cannot be an empty string!")
+        if not dataset_id:
+            raise _HttpError(404, "The datasetId cannot be an empty string!")
+        workload = self._workloads(kind).get(name)
+        if workload is None:
+            raise _HttpError(
+                404,
+                f"Unknown {label} '{name}'! (All {label}s must be specified in "
+                f"the configuration)",
+            )
+        if dataset_id not in workload.datasources:
+            raise _HttpError(
+                404, f"Unknown dataset-id '{dataset_id}' for the {label} '{name}'!"
+            )
+        return kind, workload, dataset_id, transform
+
+    def _handle_post_batch(self, m, body: bytes) -> None:
+        kind, workload, dataset_id, transform = self._validate_entity_path(m)
+        try:
+            payload = json.loads(body.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            raise _HttpError(400, "Request body must be a JSON array or object")
+        if isinstance(payload, dict):
+            batch, single = [payload], True
+        elif isinstance(payload, list):
+            batch, single = payload, False
+        else:
+            raise _HttpError(400, "Request body must be a JSON array or object")
+        for entity in batch:
+            if not isinstance(entity, dict):
+                raise _HttpError(400, "Batch elements must be JSON objects")
+
+        with workload.lock:
+            try:
+                rows = workload.process_batch(dataset_id, batch, http_transform=transform)
+            except Exception as e:
+                logger.exception("Batch processing failed")
+                raise _HttpError(500, f"Batch processing failed: {e}")
+
+        if transform:
+            out = rows[0] if single and len(rows) == 1 else rows
+            self._reply(200, json.dumps(out).encode("utf-8"))
+        else:
+            self._reply(200, b'{"success": true}')
+
+    def _handle_feed(self, m, query) -> None:
+        kind, name = m.group(1), m.group(2)
+        label = "deduplication" if kind == "deduplication" else "recordLinkage"
+        if not name:
+            raise _HttpError(400, f"The {label}Name cannot be an empty string!")
+        workload = self._workloads(kind).get(name)
+        if workload is None:
+            raise _HttpError(
+                400,
+                f"Unknown {label} '{name}'! (All {label}s must be specified in "
+                f"the configuration)",
+            )
+        since = 0
+        since_params = query.get("since")
+        if since_params and since_params[0]:
+            try:
+                since = int(since_params[0])
+            except ValueError:
+                raise _HttpError(400, f"Invalid since value '{since_params[0]}'")
+
+        if not workload.lock.acquire(timeout=READ_LOCK_TIMEOUT_SECONDS):
+            raise _HttpError(503, _BUSY_TEMPLATE.format(kind=label))
+        try:
+            rows = workload.links_since(since)
+        finally:
+            workload.lock.release()
+        body = "[" + ",\n".join(json.dumps(r) for r in rows) + "]"
+        self._reply(200, body.encode("utf-8"))
+
+    def _handle_config_upload(self, body: bytes) -> None:
+        content_type = self.headers.get("Content-Type", "")
+        config_string = None
+        if content_type.startswith("multipart/form-data"):
+            config_string = _extract_multipart_field(content_type, body, "configfile")
+            if config_string is None:
+                raise _HttpError(400, "Missing multipart field 'configfile'")
+        else:
+            # convenience divergence: accept the raw XML as the request body
+            config_string = body.decode("utf-8", errors="replace")
+        try:
+            self.app.reload_from_string(config_string)
+        except ConfigError as e:
+            raise _HttpError(400, f"Invalid configuration: {e}")
+        except Exception as e:
+            logger.exception("Config reload failed")
+            raise _HttpError(500, f"Config reload failed: {e}")
+        # success: redirect to the homepage (App.java:682)
+        self._reply(302, b"ok", "text/plain", {"Location": "/"})
+
+
+def _extract_multipart_field(content_type: str, body: bytes,
+                             field: str) -> Optional[str]:
+    """Minimal multipart/form-data parsing via the stdlib email parser."""
+    message = BytesParser(policy=email_policy).parsebytes(
+        b"Content-Type: " + content_type.encode("latin-1") + b"\r\n\r\n" + body
+    )
+    if not message.is_multipart():
+        return None
+    for part in message.iter_parts():
+        if part.get_param("name", header="content-disposition") == field:
+            payload = part.get_payload(decode=True)
+            return payload.decode("utf-8", errors="replace")
+    return None
+
+
+def create_app(config: Optional[ServiceConfig] = None, *, backend: str = "host",
+               persistent: bool = True) -> DukeApp:
+    if config is None:
+        config = load_default_config()
+    return DukeApp(config, backend=backend, persistent=persistent)
+
+
+def serve(app: DukeApp, port: int = DEFAULT_PORT,
+          host: str = "0.0.0.0") -> ThreadingHTTPServer:
+    handler = type("BoundHandler", (DukeRequestHandler,), {"app": app})
+    server = ThreadingHTTPServer((host, port), handler)
+    return server

@@ -1,0 +1,236 @@
+"""Tests for the inverted index, processor, datasource and workload flows."""
+
+import pytest
+
+from sesam_duke_microservice_tpu.core.config import parse_config
+from sesam_duke_microservice_tpu.engine.workload import build_workload
+from sesam_duke_microservice_tpu.index.inverted import InvertedIndex, analyze
+from sesam_duke_microservice_tpu.links.base import LinkStatus
+from sesam_duke_microservice_tpu.service.datasource import (
+    IncrementalDataSource,
+    IngestError,
+)
+
+DEDUP_XML = """
+<DukeMicroService>
+  <Deduplication name="people" link-database-type="in-memory">
+    <duke>
+      <schema>
+        <threshold>0.8</threshold>
+        <property><name>NAME</name>
+          <comparator>levenshtein</comparator><low>0.1</low><high>0.95</high>
+        </property>
+        <property><name>EMAIL</name>
+          <comparator>exact</comparator><low>0.2</low><high>0.95</high>
+        </property>
+      </schema>
+      <data-source class="io.sesam.dukemicroservice.IncrementalDeduplicationDataSource">
+        <param name="dataset-id" value="crm"/>
+        <column name="name" property="NAME"
+                cleaner="no.priv.garshol.duke.cleaners.LowerCaseNormalizeCleaner"/>
+        <column name="email" property="EMAIL"/>
+      </data-source>
+      <data-source class="io.sesam.dukemicroservice.IncrementalDeduplicationDataSource">
+        <param name="dataset-id" value="web"/>
+        <column name="name" property="NAME"
+                cleaner="no.priv.garshol.duke.cleaners.LowerCaseNormalizeCleaner"/>
+        <column name="email" property="EMAIL"/>
+      </data-source>
+    </duke>
+  </Deduplication>
+</DukeMicroService>
+"""
+
+LINKAGE_XML = """
+<DukeMicroService>
+  <RecordLinkage name="people" link-mode="one-to-one" link-database-type="in-memory">
+    <duke>
+      <schema>
+        <threshold>0.7</threshold>
+        <property><name>NAME</name>
+          <comparator>levenshtein</comparator><low>0.1</low><high>0.95</high>
+        </property>
+      </schema>
+      <group>
+        <data-source class="io.sesam.dukemicroservice.IncrementalRecordLinkageDataSource">
+          <param name="dataset-id" value="left"/>
+          <column name="name" property="NAME"/>
+        </data-source>
+      </group>
+      <group>
+        <data-source class="io.sesam.dukemicroservice.IncrementalRecordLinkageDataSource">
+          <param name="dataset-id" value="right"/>
+          <column name="name" property="NAME"/>
+        </data-source>
+      </group>
+    </duke>
+  </RecordLinkage>
+</DukeMicroService>
+"""
+
+
+@pytest.fixture
+def dedup_workload():
+    sc = parse_config(DEDUP_XML, env={"MIN_RELEVANCE": "0.05"})
+    return build_workload(sc.deduplications["people"], sc, persistent=False)
+
+
+@pytest.fixture
+def linkage_workload():
+    sc = parse_config(LINKAGE_XML, env={"MIN_RELEVANCE": "0.05"})
+    return build_workload(sc.record_linkages["people"], sc, persistent=False)
+
+
+def test_analyze():
+    assert analyze("The Quick Brown-Fox!") == ["quick", "brown", "fox"]
+    assert analyze("Åse 42") == ["åse", "42"]
+
+
+def test_datasource_record_synthesis():
+    sc = parse_config(DEDUP_XML, env={})
+    ds = IncrementalDataSource(sc.deduplications["people"].duke.data_sources[0])
+    r = ds.record_for_entity(
+        {"_id": "e1", "name": "John SMITH", "email": "j@x.com", "extra": "ignored"}
+    )
+    assert r.record_id == "crm__e1"
+    assert r.get_value("NAME") == "john smith"
+    assert r.get_value("EMAIL") == "j@x.com"
+    assert r.get_value("dukeOriginalEntityId") == "e1"
+    assert r.get_value("dukeDatasetId") == "crm"
+    assert not r.is_deleted()
+
+    assert ds.record_for_entity({"_id": "e2", "_deleted": True, "name": "x"}).is_deleted()
+    # array values become multi-valued properties (quirk Q1 fixed)
+    multi = ds.record_for_entity({"_id": "e3", "name": ["Ann", "Anna"]})
+    assert multi.get_values("NAME") == ["ann", "anna"]
+    # numeric _id coerced to string
+    assert ds.record_for_entity({"_id": 7, "name": "n"}).record_id == "crm__7"
+    with pytest.raises(IngestError):
+        ds.record_for_entity({"name": "no id"})
+
+
+def test_linkage_datasource_group_prefix():
+    sc = parse_config(LINKAGE_XML, env={})
+    ds1 = IncrementalDataSource(sc.record_linkages["people"].duke.groups[0][0])
+    r = ds1.record_for_entity({"_id": "e1", "name": "x"})
+    assert r.record_id == "1__left__e1"
+    assert r.get_value("dukeGroupNo") == "1"
+
+
+def test_dedup_end_to_end(dedup_workload):
+    wl = dedup_workload
+    with wl.lock:
+        wl.process_batch("crm", [
+            {"_id": "1", "name": "John Smith", "email": "john@x.com"},
+            {"_id": "2", "name": "Mary Jones", "email": "mary@x.com"},
+        ])
+        wl.process_batch("web", [
+            {"_id": "9", "name": "Jon Smith", "email": "john@x.com"},
+        ])
+        rows = wl.links_since(0)
+    assert len(rows) == 1
+    row = rows[0]
+    assert {row["entity1"], row["entity2"]} == {"1", "9"}
+    assert {row["dataset1"], row["dataset2"]} == {"crm", "web"}
+    assert row["_deleted"] is False
+    assert row["confidence"] > 0.8
+    assert row["_id"] == "crm__1_web__9"
+
+    # incremental: polling after the fact returns nothing new
+    ts = row["_updated"]
+    with wl.lock:
+        assert wl.links_since(ts) == []
+
+    # re-posting the same batch must not create feed churn (idempotent assert)
+    with wl.lock:
+        wl.process_batch("web", [{"_id": "9", "name": "Jon Smith", "email": "john@x.com"}])
+        assert wl.links_since(ts) == []
+
+
+def test_dedup_delete_retracts_links(dedup_workload):
+    wl = dedup_workload
+    with wl.lock:
+        wl.process_batch("crm", [{"_id": "1", "name": "John Smith", "email": "j@x.com"}])
+        wl.process_batch("web", [{"_id": "9", "name": "John Smith", "email": "j@x.com"}])
+        assert len(wl.links_since(0)) == 1
+        ts = wl.links_since(0)[0]["_updated"]
+
+        wl.process_batch("web", [{"_id": "9", "_deleted": True, "name": "John Smith"}])
+        rows = wl.links_since(ts)
+    assert len(rows) == 1
+    assert rows[0]["_deleted"] is True
+    # the tombstoned record must no longer be matchable
+    with wl.lock:
+        wl.process_batch("crm", [{"_id": "2", "name": "John Smith", "email": "j@x.com"}])
+        new_rows = [r for r in wl.links_since(0) if "crm__2" in r["_id"]]
+    assert all("web__9" not in r["_id"] for r in new_rows)
+
+
+def test_http_transform_is_side_effect_free(dedup_workload):
+    wl = dedup_workload
+    with wl.lock:
+        wl.process_batch("crm", [{"_id": "1", "name": "John Smith", "email": "j@x.com"}])
+        rows = wl.process_batch(
+            "web",
+            [{"_id": "9", "name": "John Smith", "email": "j@x.com"},
+             {"_id": "10", "name": "Zzz Yyy", "email": "z@y.com"}],
+            http_transform=True,
+        )
+        assert len(rows) == 2
+        assert rows[0]["_id"] == "9"
+        assert rows[0]["duke_links"] == [
+            {"datasetId": "crm", "entityId": "1", "confidence": pytest.approx(rows[0]["duke_links"][0]["confidence"])}
+        ]
+        assert rows[0]["duke_links"][0]["confidence"] > 0.8
+        assert rows[1]["duke_links"] == []
+        # no link persisted, nothing indexed
+        assert wl.links_since(0) == []
+        assert wl.index.find_record_by_id("web__9") is None
+
+
+def test_recordlinkage_group_exclusion(linkage_workload):
+    wl = linkage_workload
+    with wl.lock:
+        # two identical names in the SAME group: must not match each other
+        wl.process_batch("left", [
+            {"_id": "a", "name": "Turing"},
+            {"_id": "b", "name": "Turing"},
+        ])
+        assert wl.links_since(0) == []
+        # same name in the other group: matches both
+        wl.process_batch("right", [{"_id": "c", "name": "Turing"}])
+        rows = wl.links_since(0)
+    keys = {r["_id"] for r in rows}
+    assert keys == {"1__left__a_2__right__c", "1__left__b_2__right__c"}
+
+
+def test_inverted_index_visibility_and_lookup(dedup_workload):
+    sc = parse_config(DEDUP_XML, env={})
+    idx = InvertedIndex(sc.deduplications["people"].duke)
+    ds = IncrementalDataSource(sc.deduplications["people"].duke.data_sources[0])
+    r = ds.record_for_entity({"_id": "1", "name": "Grace Hopper", "email": "g@h.com"})
+    idx.index(r)
+    # not visible before commit (Lucene searcher semantics)
+    assert idx.find_record_by_id("crm__1") is None
+    idx.commit()
+    assert idx.find_record_by_id("crm__1").get_value("NAME") == "grace hopper"
+    # reindex replaces previous copy
+    r2 = ds.record_for_entity({"_id": "1", "name": "Grace B Hopper", "email": "g@h.com"})
+    idx.index(r2)
+    idx.commit()
+    assert len(idx) == 1
+    assert idx.find_record_by_id("crm__1").get_value("NAME") == "grace b hopper"
+
+
+def test_max_search_hits_caps_search(dedup_workload):
+    sc = parse_config(DEDUP_XML, env={"MAX_SEARCH_HITS": "3", "MIN_RELEVANCE": "0.0"})
+    wl = build_workload(sc.deduplications["people"], sc, persistent=False)
+    with wl.lock:
+        batch = [
+            {"_id": str(i), "name": "John Smith", "email": f"{i}@x.com"}
+            for i in range(8)
+        ]
+        wl.process_batch("crm", batch)
+    # search cap limits candidates per record, so matching still works but
+    # each record saw at most 3 candidates
+    assert wl.processor.stats.candidates_retrieved <= 3 * 8

@@ -1,0 +1,97 @@
+"""Tests for link databases (memory + sqlite): idempotent assert, since feed,
+retraction."""
+
+import time
+
+import pytest
+
+from sesam_duke_microservice_tpu.links import (
+    InMemoryLinkDatabase,
+    Link,
+    LinkKind,
+    LinkStatus,
+    SqliteLinkDatabase,
+    create_link_database,
+)
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def linkdb(request, tmp_path):
+    if request.param == "memory":
+        return InMemoryLinkDatabase()
+    return SqliteLinkDatabase(str(tmp_path / "links.sqlite"))
+
+
+def L(id1, id2, conf=0.95, status=LinkStatus.INFERRED, kind=LinkKind.DUPLICATE, ts=None):
+    return Link(id1, id2, status, kind, conf, ts)
+
+
+def test_id_normalization():
+    link = L("b", "a")
+    assert (link.id1, link.id2) == ("a", "b")
+
+
+def test_assert_and_get(linkdb):
+    linkdb.assert_link(L("a", "b", ts=100))
+    linkdb.assert_link(L("a", "c", ts=200))
+    assert len(linkdb.get_all_links()) == 2
+    assert {l.key() for l in linkdb.get_all_links_for("a")} == {("a", "b"), ("a", "c")}
+    assert [l.key() for l in linkdb.get_all_links_for("c")] == [("a", "c")]
+    assert linkdb.get_all_links_for("zzz") == []
+
+
+def test_idempotent_assert_preserves_timestamp(linkdb):
+    """Re-asserting an identical link must not bump the timestamp
+    (SinceAwareInMemoryLinkDatabase.java:12-31)."""
+    linkdb.assert_link(L("a", "b", conf=0.9, ts=100))
+    linkdb.assert_link(L("a", "b", conf=0.9 + 1e-9, ts=999))
+    (link,) = linkdb.get_all_links()
+    assert link.timestamp == 100
+    # changed confidence beyond epsilon -> replaced
+    linkdb.assert_link(L("a", "b", conf=0.8, ts=999))
+    (link,) = linkdb.get_all_links()
+    assert link.timestamp == 999 and link.confidence == 0.8
+    # changed status -> replaced
+    linkdb.assert_link(L("a", "b", conf=0.8, status=LinkStatus.RETRACTED, ts=1500))
+    (link,) = linkdb.get_all_links()
+    assert link.status == LinkStatus.RETRACTED
+
+
+def test_changes_since_strictly_greater(linkdb):
+    linkdb.assert_link(L("a", "b", ts=100))
+    linkdb.assert_link(L("c", "d", ts=200))
+    linkdb.assert_link(L("e", "f", ts=300))
+    assert len(linkdb.get_changes_since(0)) == 3
+    assert [l.key() for l in linkdb.get_changes_since(100)] == [("c", "d"), ("e", "f")]
+    assert linkdb.get_changes_since(300) == []
+
+
+def test_retraction_flow(linkdb):
+    linkdb.assert_link(L("a", "b", ts=100))
+    for link in linkdb.get_all_links_for("a"):
+        link.retract()
+        linkdb.assert_link(link)
+    (link,) = linkdb.get_all_links()
+    assert link.status == LinkStatus.RETRACTED
+    assert link.timestamp > 100  # retract touches the timestamp
+    assert len(linkdb.get_changes_since(100)) == 1
+
+
+def test_sqlite_persistence(tmp_path):
+    path = str(tmp_path / "links.sqlite")
+    db = SqliteLinkDatabase(path)
+    db.assert_link(L("a", "b", ts=42))
+    db.close()
+    db2 = SqliteLinkDatabase(path)
+    (link,) = db2.get_all_links()
+    assert link.key() == ("a", "b") and link.timestamp == 42
+    db2.close()
+
+
+def test_factory(tmp_path):
+    assert isinstance(create_link_database("in-memory"), InMemoryLinkDatabase)
+    db = create_link_database("h2", str(tmp_path / "wl"), is_record_linkage=True)
+    assert isinstance(db, SqliteLinkDatabase)
+    assert db.path.endswith("recordlinkdatabase.sqlite")
+    with pytest.raises(ValueError):
+        create_link_database("bogus")

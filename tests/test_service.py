@@ -1,0 +1,296 @@
+"""HTTP surface tests: drive a real server on an ephemeral port."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from sesam_duke_microservice_tpu.core.config import parse_config
+from sesam_duke_microservice_tpu.service.app import DukeApp, serve
+
+CONFIG_XML = """
+<DukeMicroService>
+  <Deduplication name="people" link-database-type="in-memory">
+    <duke>
+      <schema>
+        <threshold>0.8</threshold>
+        <property><name>NAME</name>
+          <comparator>levenshtein</comparator><low>0.1</low><high>0.95</high>
+        </property>
+        <property><name>EMAIL</name>
+          <comparator>exact</comparator><low>0.2</low><high>0.95</high>
+        </property>
+      </schema>
+      <data-source class="io.sesam.dukemicroservice.IncrementalDeduplicationDataSource">
+        <param name="dataset-id" value="crm"/>
+        <column name="name" property="NAME"
+                cleaner="no.priv.garshol.duke.cleaners.LowerCaseNormalizeCleaner"/>
+        <column name="email" property="EMAIL"/>
+      </data-source>
+      <data-source class="io.sesam.dukemicroservice.IncrementalDeduplicationDataSource">
+        <param name="dataset-id" value="web"/>
+        <column name="name" property="NAME"
+                cleaner="no.priv.garshol.duke.cleaners.LowerCaseNormalizeCleaner"/>
+        <column name="email" property="EMAIL"/>
+      </data-source>
+    </duke>
+  </Deduplication>
+  <RecordLinkage name="pairing" link-mode="one-to-one" link-database-type="in-memory">
+    <duke>
+      <schema>
+        <threshold>0.7</threshold>
+        <property><name>NAME</name>
+          <comparator>levenshtein</comparator><low>0.1</low><high>0.95</high>
+        </property>
+      </schema>
+      <group>
+        <data-source class="io.sesam.dukemicroservice.IncrementalRecordLinkageDataSource">
+          <param name="dataset-id" value="left"/>
+          <column name="name" property="NAME"/>
+        </data-source>
+      </group>
+      <group>
+        <data-source class="io.sesam.dukemicroservice.IncrementalRecordLinkageDataSource">
+          <param name="dataset-id" value="right"/>
+          <column name="name" property="NAME"/>
+        </data-source>
+      </group>
+    </duke>
+  </RecordLinkage>
+</DukeMicroService>
+"""
+
+
+@pytest.fixture(scope="module")
+def server_url():
+    # low MIN_RELEVANCE via the real env so config hot-reloads (which re-read
+    # os.environ, like the reference's configureDatabase) keep the setting;
+    # tiny test corpora legitimately score below the 0.9 default cut
+    import os
+
+    os.environ["MIN_RELEVANCE"] = "0.05"
+    sc = parse_config(CONFIG_XML)
+    app = DukeApp(sc, persistent=False)
+    server = serve(app, port=0, host="127.0.0.1")
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    yield url
+    server.shutdown()
+    del os.environ["MIN_RELEVANCE"]
+
+
+class _NoRedirect(urllib.request.HTTPRedirectHandler):
+    def redirect_request(self, *args, **kwargs):
+        return None
+
+
+_opener = urllib.request.build_opener(_NoRedirect)
+
+
+def request(url, method="GET", body=None, headers=None):
+    req = urllib.request.Request(url, data=body, method=method, headers=headers or {})
+    try:
+        with _opener.open(req) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def post_json(url, payload):
+    return request(url, "POST", json.dumps(payload).encode(),
+                   {"Content-Type": "application/json"})
+
+
+def test_homepage_lists_endpoints(server_url):
+    status, headers, body = request(server_url + "/")
+    assert status == 200 and "text/html" in headers["Content-Type"]
+    text = body.decode()
+    assert "/deduplication/people/crm" in text
+    assert "/recordlinkage/pairing/left" in text
+    assert "configfile" in text
+
+
+def test_get_config_verbatim(server_url):
+    status, headers, body = request(server_url + "/config")
+    assert status == 200
+    assert headers["Content-Type"].startswith("application/xml")
+    assert body.decode() == CONFIG_XML
+
+
+def test_post_batch_and_feed(server_url):
+    status, _, body = post_json(server_url + "/deduplication/people/crm", [
+        {"_id": "1", "name": "Alan Turing", "email": "alan@blechley.uk"},
+        {"_id": "2", "name": "Ada Lovelace", "email": "ada@analytical.uk"},
+    ])
+    assert status == 200
+    assert body == b'{"success": true}'
+
+    status, _, body = post_json(server_url + "/deduplication/people/web",
+                                {"_id": "9", "name": "Alan Turing", "email": "alan@blechley.uk"})
+    assert status == 200 and body == b'{"success": true}'
+
+    status, headers, body = request(server_url + "/deduplication/people?since=0")
+    assert status == 200
+    rows = json.loads(body)
+    assert len(rows) == 1
+    assert {rows[0]["entity1"], rows[0]["entity2"]} == {"1", "9"}
+    assert rows[0]["confidence"] > 0.8
+
+    # incremental poll: nothing new after the returned timestamp
+    ts = rows[0]["_updated"]
+    status, _, body = request(server_url + f"/deduplication/people?since={ts}")
+    assert json.loads(body) == []
+
+
+def test_http_transform_single_and_array(server_url):
+    post_json(server_url + "/deduplication/people/crm",
+              [{"_id": "t1", "name": "Grace Hopper", "email": "g@navy.mil"}])
+    # single entity in -> single object out (App.java:1196-1198)
+    status, _, body = post_json(
+        server_url + "/deduplication/people/web/httptransform",
+        {"_id": "t9", "name": "Grace Hopper", "email": "g@navy.mil"},
+    )
+    assert status == 200
+    obj = json.loads(body)
+    assert isinstance(obj, dict)
+    assert obj["_id"] == "t9"
+    assert obj["duke_links"][0]["entityId"] == "t1"
+    assert obj["duke_links"][0]["datasetId"] == "crm"
+
+    # array in -> array out
+    status, _, body = post_json(
+        server_url + "/deduplication/people/web/httptransform",
+        [{"_id": "t9", "name": "Grace Hopper", "email": "g@navy.mil"}],
+    )
+    assert isinstance(json.loads(body), list)
+
+    # transform left no trace: the transformed entity is not in the feed
+    status, _, body = request(server_url + "/deduplication/people?since=0")
+    assert all("t9" not in json.dumps(r) for r in json.loads(body))
+
+
+def test_recordlinkage_endpoints(server_url):
+    post_json(server_url + "/recordlinkage/pairing/left",
+              [{"_id": "L1", "name": "Katherine Johnson"}])
+    post_json(server_url + "/recordlinkage/pairing/right",
+              [{"_id": "R1", "name": "Katherine Johnson"}])
+    status, _, body = request(server_url + "/recordlinkage/pairing")
+    rows = json.loads(body)
+    assert len(rows) == 1
+    assert rows[0]["dataset1"] == "left" and rows[0]["dataset2"] == "right"
+
+
+def test_validation_status_codes(server_url):
+    # unknown workload on entity endpoint -> 404
+    status, _, body = post_json(server_url + "/deduplication/nope/crm", [])
+    assert status == 404 and b"Unknown deduplication 'nope'" in body
+    # unknown dataset -> 404
+    status, _, body = post_json(server_url + "/deduplication/people/nope", [])
+    assert status == 404 and b"Unknown dataset-id 'nope'" in body
+    # GET on POST-only endpoint with valid path -> 405
+    status, _, body = request(server_url + "/deduplication/people/crm")
+    assert status == 405 and b"only supports POST" in body
+    status, _, _ = request(server_url + "/deduplication/people/crm/httptransform")
+    assert status == 405
+    # GET on POST-only endpoint with bogus name -> 404 (validation first)
+    status, _, _ = request(server_url + "/deduplication/nope/crm")
+    assert status == 404
+    # unknown feed name -> 400
+    status, _, _ = request(server_url + "/deduplication/nope")
+    assert status == 400
+    status, _, _ = request(server_url + "/recordlinkage/nope")
+    assert status == 400
+    # malformed JSON -> 400
+    status, _, _ = request(server_url + "/deduplication/people/crm", "POST",
+                           b"{not json", {"Content-Type": "application/json"})
+    assert status == 400
+    # bad since -> 400
+    status, _, _ = request(server_url + "/deduplication/people?since=abc")
+    assert status == 400
+    # entity without _id -> 500 (reference: RuntimeException out of the handler)
+    status, _, _ = post_json(server_url + "/deduplication/people/crm", [{"name": "x"}])
+    assert status == 500
+
+
+def test_feed_503_when_write_locked(server_url):
+    import sesam_duke_microservice_tpu.service.app as app_module
+
+    # grab the workload lock as a writer would, then poll the feed
+    handler_app = None
+    # find the app via a request for config? Instead reach through the server fixture:
+    # the fixture's app object is bound to the handler class of this server.
+    # Simpler: create a fresh app+server for this test.
+    sc = parse_config(CONFIG_XML, env={})
+    app = app_module.DukeApp(sc, persistent=False)
+    server = app_module.serve(app, port=0, host="127.0.0.1")
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    wl = app.deduplications["people"]
+    old_timeout = app_module.READ_LOCK_TIMEOUT_SECONDS
+    app_module.READ_LOCK_TIMEOUT_SECONDS = 0.05
+    try:
+        with wl.lock:
+            status, _, body = request(url + "/deduplication/people")
+            assert status == 503
+            assert b"being written to" in body
+    finally:
+        app_module.READ_LOCK_TIMEOUT_SECONDS = old_timeout
+        server.shutdown()
+
+
+def test_config_upload_multipart_and_rollback(server_url):
+    new_config = CONFIG_XML.replace('name="people"', 'name="people2"')
+    boundary = "----testboundary42"
+    part = (
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; name="configfile"; filename="c.xml"\r\n'
+        "Content-Type: application/xml\r\n\r\n"
+        f"{new_config}\r\n"
+        f"--{boundary}--\r\n"
+    ).encode()
+    status, headers, _ = request(
+        server_url + "/config", "POST", part,
+        {"Content-Type": f"multipart/form-data; boundary={boundary}"},
+    )
+    assert status == 302 and headers["Location"] == "/"
+
+    # new workload active, old gone
+    status, _, _ = post_json(server_url + "/deduplication/people2/crm", [])
+    assert status == 200
+    status, _, _ = post_json(server_url + "/deduplication/people/crm", [])
+    assert status == 404
+    # /config serves the new string verbatim
+    _, _, body = request(server_url + "/config")
+    assert body.decode() == new_config
+
+    # invalid upload -> 400, old config stays active
+    status, _, _ = request(server_url + "/config", "POST", b"<Bogus/>",
+                           {"Content-Type": "application/xml"})
+    assert status == 400
+    status, _, _ = post_json(server_url + "/deduplication/people2/crm", [])
+    assert status == 200
+
+    # restore for other tests (raw-body convenience upload)
+    status, _, _ = request(server_url + "/config", "POST", CONFIG_XML.encode(),
+                           {"Content-Type": "application/xml"})
+    assert status == 302
+
+
+def test_deleted_entity_retraction_over_http(server_url):
+    post_json(server_url + "/deduplication/people/crm",
+              [{"_id": "d1", "name": "Edsger Dijkstra", "email": "e@tue.nl"}])
+    post_json(server_url + "/deduplication/people/web",
+              [{"_id": "d9", "name": "Edsger Dijkstra", "email": "e@tue.nl"}])
+    _, _, body = request(server_url + "/deduplication/people?since=0")
+    link_rows = [r for r in json.loads(body) if "d1" in r["_id"]]
+    assert link_rows and link_rows[0]["_deleted"] is False
+
+    post_json(server_url + "/deduplication/people/web",
+              [{"_id": "d9", "_deleted": True, "name": "Edsger Dijkstra"}])
+    _, _, body = request(server_url + "/deduplication/people?since=0")
+    link_rows = [r for r in json.loads(body) if "d1" in r["_id"]]
+    assert link_rows[0]["_deleted"] is True
