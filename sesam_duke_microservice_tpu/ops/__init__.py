@@ -1,0 +1,14 @@
+"""TPU compute path: feature extraction + batched pairwise scoring.
+
+The reference's hot loop is per-pair virtual dispatch into Duke comparator
+classes (SURVEY.md section 3.2 hot loop 1, driven from App.java:1005/1159).
+Here that loop becomes a data-parallel device program:
+
+  * ``features``  — per-record O(N) feature extraction on host (tokenize,
+    hash, phonetic codes, numeric parse); produces padded numpy tensors.
+  * ``pairwise``  — per-pair O(N^2 / block) similarity kernels in JAX
+    (edit-distance wavefront via cumulative-min, Jaro-Winkler scan,
+    sorted-set intersection by batched binary search, scalar compares).
+  * ``scoring``   — assembles per-property kernels + the naive-Bayes
+    log-odds combine into one jitted blockwise scoring program.
+"""

@@ -1,0 +1,309 @@
+"""Host-side per-record feature extraction for the device scoring path.
+
+Design: the O(N) per-record work (unicode handling, hashing, phonetic codes,
+numeric parsing, tokenization) stays on the host where strings are natural;
+the O(N^2) per-pair work runs on device over the padded tensors produced
+here.  This replaces the reference's per-pair string handling inside Duke
+comparators (SURVEY.md section 1 L1) with a tokenize-once/compare-many split.
+
+Each schema property is assigned a *feature kind* based on its comparator
+class; ``extract_batch`` turns a list of records into a dict of numpy arrays
+per property, every array shaped ``(N, V, ...)`` where ``V`` is the number of
+value slots (Duke records are multi-valued; pair probability is the max over
+value pairs — Processor.compare / ops.scoring).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core import comparators as C
+from ..core.config import DukeSchema
+from ..core.records import Record
+
+# Static shape defaults (device tensors are padded to these; values are
+# truncated — the only intended divergence from the host oracle, documented
+# in tests/test_ops.py).
+MAX_CHARS = 64       # chars per value for edit-distance comparators
+MAX_GRAMS = 64       # distinct q-grams per value (64 >= MAX_CHARS - q + 1)
+MAX_TOKENS = 16      # distinct whitespace tokens per value
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+
+# Sentinel for empty sorted-set slots: int32 max sorts last.
+SET_PAD = np.int32(2**31 - 1)
+
+
+def fnv1a64(value: str) -> int:
+    h = _FNV_OFFSET
+    for b in value.encode("utf-8"):
+        h ^= b
+        h = (h * _FNV_PRIME) & _MASK64
+    return h
+
+
+def _hash2x32(value: str) -> tuple:
+    h = fnv1a64(value)
+    lo = np.int64(h & 0xFFFFFFFF).astype(np.int32)
+    hi = np.int64(h >> 32).astype(np.int32)
+    return hi, lo
+
+
+def _hash32(value: str) -> np.int32:
+    h = fnv1a64(value)
+    return np.int64((h ^ (h >> 32)) & 0xFFFFFFFF).astype(np.int32)
+
+
+# -- feature kinds -----------------------------------------------------------
+
+CHARS = "chars"              # padded codepoints + length (+ hash)
+CHARS_WEIGHTED = "chars_w"   # chars + per-char class for weighted edits
+GRAM_SET = "gram_set"        # sorted distinct q-gram hashes
+TOKEN_SET = "token_set"      # sorted distinct token hashes
+HASH = "hash"                # value hash only (exact/different)
+PHONETIC = "phonetic"        # value hash + phonetic code hash
+NUMERIC = "numeric"          # parsed float
+GEO = "geo"                  # parsed lat/lon
+
+
+def feature_kind(comparator) -> Optional[str]:
+    """Feature kind for a comparator instance, or None if the comparator has
+    no device kernel yet (scored on host via the hybrid pruning path —
+    engine.device_matcher)."""
+    if comparator is None:
+        return None
+    if isinstance(comparator, C.WeightedLevenshtein):
+        return CHARS_WEIGHTED
+    if isinstance(comparator, (C.Levenshtein, C.JaroWinkler)) and not isinstance(
+        comparator, C.JaroWinklerTokenized
+    ):
+        return CHARS
+    if isinstance(comparator, C.QGram):
+        return GRAM_SET
+    if isinstance(comparator, (C.JaccardIndex, C.DiceCoefficient)):
+        return TOKEN_SET
+    if isinstance(comparator, (C.Exact, C.Different)):
+        return HASH
+    if isinstance(comparator, (C.Soundex, C.Metaphone, C.Norphone)):
+        return PHONETIC
+    if isinstance(comparator, C.Numeric):
+        return NUMERIC
+    if isinstance(comparator, C.Geoposition):
+        return GEO
+    return None
+
+
+def _phonetic_code(comparator, value: str) -> str:
+    if isinstance(comparator, C.Soundex):
+        return C.soundex(value)
+    if isinstance(comparator, C.Metaphone):
+        return C.metaphone(value)
+    return C.norphone(value)
+
+
+@dataclass
+class PropertyFeatureSpec:
+    """Static description of one schema property's device representation."""
+
+    name: str
+    kind: str
+    low: float
+    high: float
+    comparator: object
+    values_per_record: int = 1
+
+    @property
+    def v(self) -> int:
+        return self.values_per_record
+
+
+@dataclass
+class SchemaFeatures:
+    """Per-schema feature plan: which properties score on device vs host."""
+
+    device_props: List[PropertyFeatureSpec] = field(default_factory=list)
+    host_props: List = field(default_factory=list)  # core Property objects
+
+    @classmethod
+    def plan(cls, schema: DukeSchema, values_per_record: int = 1) -> "SchemaFeatures":
+        plan = cls()
+        for prop in schema.comparison_properties():
+            kind = feature_kind(prop.comparator)
+            if kind is None:
+                plan.host_props.append(prop)
+            else:
+                plan.device_props.append(
+                    PropertyFeatureSpec(
+                        name=prop.name,
+                        kind=kind,
+                        low=prop.low,
+                        high=prop.high,
+                        comparator=prop.comparator,
+                        values_per_record=values_per_record,
+                    )
+                )
+        return plan
+
+
+# -- extraction --------------------------------------------------------------
+
+
+def _char_class(ch: str) -> int:
+    if ch.isdigit():
+        return 2
+    if ch.isalpha():
+        return 1
+    return 0
+
+
+def extract_property(
+    spec: PropertyFeatureSpec, values_per_record: Sequence[List[str]]
+) -> Dict[str, np.ndarray]:
+    """Extract one property's features for N records.
+
+    ``values_per_record[i]`` is record i's (cleaned, non-empty) value list
+    for this property; slots beyond ``spec.v`` are dropped (Duke scores the
+    max over all value pairs; we bound the value axis for static shapes).
+    """
+    n = len(values_per_record)
+    v = spec.v
+    out: Dict[str, np.ndarray] = {}
+    valid = np.zeros((n, v), dtype=bool)
+    hash_hi = np.zeros((n, v), dtype=np.int32)
+    hash_lo = np.zeros((n, v), dtype=np.int32)
+
+    kind = spec.kind
+    if kind in (CHARS, CHARS_WEIGHTED):
+        chars = np.zeros((n, v, MAX_CHARS), dtype=np.int32)
+        length = np.zeros((n, v), dtype=np.int32)
+        classes = (
+            np.zeros((n, v, MAX_CHARS), dtype=np.int32)
+            if kind == CHARS_WEIGHTED
+            else None
+        )
+    elif kind == GRAM_SET:
+        grams = np.full((n, v, MAX_GRAMS), SET_PAD, dtype=np.int32)
+        gram_count = np.zeros((n, v), dtype=np.int32)
+        q = int(getattr(spec.comparator, "q", 2))
+    elif kind == TOKEN_SET:
+        tokens = np.full((n, v, MAX_TOKENS), SET_PAD, dtype=np.int32)
+        token_count = np.zeros((n, v), dtype=np.int32)
+    elif kind == PHONETIC:
+        code_hi = np.zeros((n, v), dtype=np.int32)
+        code_lo = np.zeros((n, v), dtype=np.int32)
+        code_valid = np.zeros((n, v), dtype=bool)
+    elif kind == NUMERIC:
+        number = np.zeros((n, v), dtype=np.float32)
+        number_valid = np.zeros((n, v), dtype=bool)
+    elif kind == GEO:
+        lat = np.zeros((n, v), dtype=np.float32)
+        lon = np.zeros((n, v), dtype=np.float32)
+        geo_valid = np.zeros((n, v), dtype=bool)
+
+    for i, values in enumerate(values_per_record):
+        for k, value in enumerate(values[:v]):
+            valid[i, k] = True
+            hi, lo = _hash2x32(value)
+            hash_hi[i, k] = hi
+            hash_lo[i, k] = lo
+            if kind in (CHARS, CHARS_WEIGHTED):
+                trunc = value[:MAX_CHARS]
+                length[i, k] = len(trunc)
+                for j, ch in enumerate(trunc):
+                    chars[i, k, j] = ord(ch)
+                    if classes is not None:
+                        classes[i, k, j] = _char_class(ch)
+            elif kind == GRAM_SET:
+                ids = sorted({int(_hash32(g)) for g in C.qgrams(value, q)})
+                ids = ids[:MAX_GRAMS]
+                grams[i, k, : len(ids)] = ids
+                gram_count[i, k] = len(ids)
+            elif kind == TOKEN_SET:
+                ids = sorted({int(_hash32(t)) for t in value.split()})
+                ids = ids[:MAX_TOKENS]
+                tokens[i, k, : len(ids)] = ids
+                token_count[i, k] = len(ids)
+            elif kind == PHONETIC:
+                code = _phonetic_code(spec.comparator, value)
+                if code:
+                    chi, clo = _hash2x32(code)
+                    code_hi[i, k] = chi
+                    code_lo[i, k] = clo
+                    code_valid[i, k] = True
+            elif kind == NUMERIC:
+                try:
+                    d = float(value)
+                    if np.isfinite(d):
+                        number[i, k] = np.float32(d)
+                        number_valid[i, k] = True
+                except (TypeError, ValueError):
+                    pass
+            elif kind == GEO:
+                parsed = C.Geoposition._parse(value)
+                if parsed is not None:
+                    lat[i, k] = np.float32(parsed[0])
+                    lon[i, k] = np.float32(parsed[1])
+                    geo_valid[i, k] = True
+
+    out["valid"] = valid
+    out["hash_hi"] = hash_hi
+    out["hash_lo"] = hash_lo
+    if kind in (CHARS, CHARS_WEIGHTED):
+        out["chars"] = chars
+        out["length"] = length
+        if classes is not None:
+            out["classes"] = classes
+    elif kind == GRAM_SET:
+        out["grams"] = grams
+        out["gram_count"] = gram_count
+    elif kind == TOKEN_SET:
+        out["tokens"] = tokens
+        out["token_count"] = token_count
+    elif kind == PHONETIC:
+        out["code_hi"] = code_hi
+        out["code_lo"] = code_lo
+        out["code_valid"] = code_valid
+    elif kind == NUMERIC:
+        out["number"] = number
+        out["number_valid"] = number_valid
+    elif kind == GEO:
+        out["lat"] = lat
+        out["lon"] = lon
+        out["geo_valid"] = geo_valid
+    return out
+
+
+def extract_batch(
+    plan: SchemaFeatures, records: Sequence[Record]
+) -> Dict[str, Dict[str, np.ndarray]]:
+    """Extract all device-scored properties for a batch of records.
+
+    Returns ``{property_name: {tensor_name: (N, V, ...) array}}``.
+    """
+    out: Dict[str, Dict[str, np.ndarray]] = {}
+    for spec in plan.device_props:
+        values = [
+            [val for val in r.get_values(spec.name) if val] for r in records
+        ]
+        out[spec.name] = extract_property(spec, values)
+    return out
+
+
+def concat_features(
+    parts: Sequence[Dict[str, Dict[str, np.ndarray]]]
+) -> Dict[str, Dict[str, np.ndarray]]:
+    """Concatenate per-batch feature dicts along the record axis."""
+    if not parts:
+        return {}
+    out: Dict[str, Dict[str, np.ndarray]] = {}
+    for prop in parts[0]:
+        out[prop] = {
+            name: np.concatenate([p[prop][name] for p in parts], axis=0)
+            for name in parts[0][prop]
+        }
+    return out

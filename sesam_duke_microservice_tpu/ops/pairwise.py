@@ -1,0 +1,303 @@
+"""Batched pairwise similarity kernels (JAX, TPU-friendly).
+
+Every kernel maps a flat batch of P value pairs to similarities in [0, 1],
+replicating the scalar semantics of ``core.comparators`` (the oracles; each
+kernel has a differential test against them).  TPU-first design notes:
+
+  * All shapes are static; the pair batch is the parallel axis the VPU works
+    over.  No per-pair Python, no data-dependent shapes.
+  * Edit distance avoids the sequential inner loop with the classic
+    min-plus-scan identity::
+
+        cur[j] = min(prev[j]+1, prev[j-1]+cost[j], cur[j-1]+1)
+               = j + cummin( m[k] - k )[j],   m[k] = min(prev[k]+1, prev[k-1]+cost[k])
+
+    so each DP row is one vectorized ``associative_scan`` over the column
+    axis; ``lax.scan`` walks rows.  O(L) steps of O(P*L) vector work instead
+    of O(P*L^2) scalar work — the same wavefront idea a systolic algorithm
+    uses, expressed in XLA ops.
+  * Set intersections (q-grams, tokens) use host-sorted hash arrays and a
+    batched binary search: O(S log S) vector ops and O(P*S) memory instead of
+    the O(P*S^2) equality matrix.
+  * Jaro's greedy char matching is inherently sequential in the query string;
+    we scan its <=L steps with all pairs advancing in lockstep, each step
+    fully vectorized over P and the candidate axis.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+INT32_MAX = 2**31 - 1
+
+
+# -- edit distance -----------------------------------------------------------
+
+
+def levenshtein_distance(c1, l1, c2, l2):
+    """Batched Levenshtein distance.
+
+    c1, c2: (P, L) int32 codepoints (0-padded); l1, l2: (P,) int32 lengths.
+    Returns (P,) int32 distances d(c1[:l1], c2[:l2]).
+    """
+    p, l = c1.shape
+    jidx = jnp.arange(l + 1, dtype=jnp.int32)
+    init_row = jnp.broadcast_to(jidx, (p, l + 1))
+    init_result = l2  # distance when l1 == 0
+
+    def step(carry, i):
+        prev, result = carry
+        ch = lax.dynamic_slice_in_dim(c1, i, 1, axis=1)  # (P, 1)
+        cost = jnp.where(c2 == ch, 0, 1)  # (P, L)
+        m = jnp.minimum(prev[:, 1:] + 1, prev[:, :-1] + cost)
+        row0 = jnp.full((p, 1), i + 1, dtype=jnp.int32)
+        g = jnp.concatenate([row0, m], axis=1) - jidx
+        cur = lax.associative_scan(jnp.minimum, g, axis=1) + jidx
+        d = jnp.take_along_axis(cur, l2[:, None], axis=1)[:, 0]
+        result = jnp.where(i + 1 == l1, d, result)
+        return (cur, result), None
+
+    (_, result), _ = lax.scan(
+        step, (init_row, init_result), jnp.arange(l, dtype=jnp.int32)
+    )
+    return result
+
+
+def levenshtein_sim(c1, l1, c2, l2, equal):
+    """Duke Levenshtein similarity (core.comparators.Levenshtein.compare).
+
+    ``equal``: (P,) bool — exact string equality (from value hashes), the
+    comparators' shared v1==v2 early exit.
+    """
+    shorter = jnp.minimum(l1, l2)
+    longer = jnp.maximum(l1, l2)
+    dist = levenshtein_distance(c1, l1, c2, l2)
+    dist = jnp.minimum(dist, shorter)
+    sim = 1.0 - dist.astype(jnp.float32) / jnp.maximum(shorter, 1).astype(jnp.float32)
+    sim = jnp.where((longer - shorter) * 2 > shorter, 0.0, sim)
+    sim = jnp.where(shorter == 0, 0.0, sim)
+    return jnp.where(equal, 1.0, sim)
+
+
+def weighted_levenshtein_sim(
+    c1, k1, l1, c2, k2, l2, equal, *, digit_weight, letter_weight, other_weight
+):
+    """core.comparators.WeightedLevenshtein.compare.
+
+    k1, k2: (P, L) int32 char classes (0 other, 1 letter, 2 digit) computed
+    on host with Python's unicode str.isalpha/isdigit for oracle parity.
+    """
+    p, l = c1.shape
+    wvec = jnp.array([other_weight, letter_weight, digit_weight], jnp.float32)
+    w1 = jnp.take(wvec, k1)  # (P, L)
+    w2 = jnp.take(wvec, k2)
+    cw2 = jnp.cumsum(w2, axis=1)
+    zeros = jnp.zeros((p, 1), jnp.float32)
+    prefix2 = jnp.concatenate([zeros, cw2], axis=1)  # (P, L+1) = row 0
+    big = jnp.float32(3.4e38)
+
+    def step(carry, i):
+        prev, row0_prev, result = carry
+        ch = lax.dynamic_slice_in_dim(c1, i, 1, axis=1)
+        wi = lax.dynamic_slice_in_dim(w1, i, 1, axis=1)  # (P, 1)
+        sub = jnp.where(c2 == ch, 0.0, jnp.maximum(wi, w2))
+        m = jnp.minimum(prev[:, 1:] + wi, prev[:, :-1] + sub)
+        row0 = row0_prev + wi[:, 0]
+        g = jnp.concatenate([row0[:, None], m], axis=1) - prefix2
+        cur = lax.associative_scan(jnp.minimum, g, axis=1) + prefix2
+        d = jnp.take_along_axis(cur, l2[:, None], axis=1)[:, 0]
+        result = jnp.where(i + 1 == l1, d, result)
+        return (cur, row0, result), None
+
+    init_result = jnp.take_along_axis(prefix2, l2[:, None], axis=1)[:, 0]
+    (_, _, result), _ = lax.scan(
+        step,
+        (prefix2, jnp.zeros((p,), jnp.float32), init_result),
+        jnp.arange(l, dtype=jnp.int32),
+    )
+    del big
+    shorter = jnp.minimum(l1, l2).astype(jnp.float32)
+    dist = jnp.minimum(result, shorter)
+    sim = 1.0 - dist / jnp.maximum(shorter, 1.0)
+    sim = jnp.where(shorter == 0, 0.0, sim)
+    return jnp.where(equal, 1.0, sim)
+
+
+# -- Jaro-Winkler ------------------------------------------------------------
+
+
+def _jaro(c1, l1, c2, l2):
+    p, l = c1.shape
+    jidx = jnp.arange(l, dtype=jnp.int32)
+    window = jnp.maximum(jnp.maximum(l1, l2) // 2 - 1, 0)  # (P,)
+
+    def step(carry, i):
+        used, nmatch, m1 = carry
+        ch = lax.dynamic_slice_in_dim(c1, i, 1, axis=1)  # (P, 1)
+        lo = jnp.maximum(0, i - window)[:, None]
+        hi = jnp.minimum(l2, i + window + 1)[:, None]
+        ok = (
+            (~used)
+            & (c2 == ch)
+            & (jidx >= lo)
+            & (jidx < hi)
+            & (i < l1)[:, None]
+        )
+        any_ok = ok.any(axis=1)
+        first = jnp.argmax(ok, axis=1)
+        used = used | (ok & (jidx == first[:, None]))
+        m1 = jnp.where(
+            (jidx == nmatch[:, None]) & any_ok[:, None], ch, m1
+        )
+        nmatch = nmatch + any_ok.astype(jnp.int32)
+        return (used, nmatch, m1), None
+
+    used0 = jnp.zeros((p, l), bool)
+    nmatch0 = jnp.zeros((p,), jnp.int32)
+    m10 = jnp.zeros((p, l), jnp.int32)
+    (used, nmatch, m1), _ = lax.scan(
+        step, (used0, nmatch0, m10), jnp.arange(l, dtype=jnp.int32)
+    )
+
+    # compact matched chars of c2 in order: scatter c2[j] to rank position
+    rank = jnp.cumsum(used.astype(jnp.int32), axis=1) - 1
+    pos = jnp.where(used, rank, l)  # l = out of range -> dropped
+    pidx = jnp.arange(p)[:, None]
+    m2 = jnp.zeros((p, l), jnp.int32).at[pidx, pos].set(c2, mode="drop")
+
+    kidx = jnp.arange(l, dtype=jnp.int32)
+    diff = (m1 != m2) & (kidx < nmatch[:, None])
+    transpositions = diff.sum(axis=1) // 2
+
+    m = nmatch.astype(jnp.float32)
+    n1 = jnp.maximum(l1, 1).astype(jnp.float32)
+    n2 = jnp.maximum(l2, 1).astype(jnp.float32)
+    jaro = (m / n1 + m / n2 + (m - transpositions) / jnp.maximum(m, 1.0)) / 3.0
+    return jnp.where((nmatch == 0) | (l1 == 0) | (l2 == 0), 0.0, jaro)
+
+
+def jaro_winkler_sim(
+    c1, l1, c2, l2, equal, *, prefix_scale=0.1, boost_threshold=0.7, max_prefix=4
+):
+    """core.comparators.JaroWinkler.compare."""
+    j = _jaro(c1, l1, c2, l2)
+    l = c1.shape[1]
+    k = min(max_prefix, l)
+    kidx = jnp.arange(k, dtype=jnp.int32)
+    both = jnp.minimum(l1, l2)[:, None]
+    eq = (c1[:, :k] == c2[:, :k]) & (kidx < both)
+    prefix = jnp.cumprod(eq.astype(jnp.int32), axis=1).sum(axis=1)
+    boosted = j + prefix.astype(jnp.float32) * prefix_scale * (1.0 - j)
+    sim = jnp.where(j < boost_threshold, j, boosted)
+    return jnp.where(equal, 1.0, sim)
+
+
+# -- sorted-set intersection -------------------------------------------------
+
+
+def set_intersection_count(a, na, b, nb):
+    """|set(a[:na]) ∩ set(b[:nb])| for sorted, distinct int32 ids.
+
+    a: (P, Sa), b: (P, Sb) sorted ascending, padded with INT32_MAX.
+    Batched binary search of each element of a in b: O(Sa log Sb).
+    """
+    p, sa = a.shape
+    sb = b.shape[1]
+    # [0, Sb] has Sb+1 possible insertion points: ceil(log2(Sb+1)) halvings
+    steps = max(1, math.ceil(math.log2(sb + 1)))
+    lo = jnp.zeros((p, sa), jnp.int32)
+    hi = jnp.broadcast_to(jnp.int32(sb), (p, sa))
+    for _ in range(steps):
+        mid = (lo + hi) // 2
+        bv = jnp.take_along_axis(b, jnp.minimum(mid, sb - 1), axis=1)
+        go_right = bv < a
+        lo = jnp.where(go_right, mid + 1, lo)
+        hi = jnp.where(go_right, hi, mid)
+    bv = jnp.take_along_axis(b, jnp.minimum(lo, sb - 1), axis=1)
+    found = (lo < nb[:, None]) & (bv == a)
+    valid_a = jnp.arange(sa, dtype=jnp.int32) < na[:, None]
+    return (found & valid_a).sum(axis=1)
+
+
+def qgram_sim(g1, n1, g2, n2, equal, *, formula="overlap"):
+    """core.comparators.QGram.compare over precomputed distinct-gram sets."""
+    common = set_intersection_count(g1, n1, g2, n2).astype(jnp.float32)
+    f1 = n1.astype(jnp.float32)
+    f2 = n2.astype(jnp.float32)
+    if formula == "jaccard":
+        sim = common / jnp.maximum(f1 + f2 - common, 1.0)
+    elif formula == "dice":
+        sim = 2.0 * common / jnp.maximum(f1 + f2, 1.0)
+    else:
+        sim = common / jnp.maximum(jnp.minimum(f1, f2), 1.0)
+    sim = jnp.where((n1 == 0) | (n2 == 0), 0.0, sim)
+    return jnp.where(equal, 1.0, sim)
+
+
+def token_set_sim(t1, n1, t2, n2, equal, *, dice=False):
+    """JaccardIndex (dice=False) / DiceCoefficient (dice=True) over token sets."""
+    inter = set_intersection_count(t1, n1, t2, n2).astype(jnp.float32)
+    f1 = n1.astype(jnp.float32)
+    f2 = n2.astype(jnp.float32)
+    if dice:
+        sim = 2.0 * inter / jnp.maximum(f1 + f2, 1.0)
+    else:
+        sim = inter / jnp.maximum(f1 + f2 - inter, 1.0)
+    sim = jnp.where((n1 == 0) | (n2 == 0), 0.0, sim)
+    return jnp.where(equal, 1.0, sim)
+
+
+# -- scalar comparators ------------------------------------------------------
+
+
+def exact_sim(equal):
+    return jnp.where(equal, 1.0, 0.0)
+
+
+def different_sim(equal):
+    return jnp.where(equal, 0.0, 1.0)
+
+
+def phonetic_sim(equal, code_equal, codes_valid):
+    """Soundex/Metaphone/Norphone: equal values 1.0, equal nonempty codes 0.9."""
+    return jnp.where(equal, 1.0, jnp.where(code_equal & codes_valid, 0.9, 0.0))
+
+
+def numeric_sim(d1, v1, d2, v2, *, min_ratio=0.0):
+    """core.comparators.Numeric.compare (note: NO string-equality early exit —
+    two equal unparseable strings are neutral 0.5, matching the oracle)."""
+    both = v1 & v2
+    neutral = jnp.float32(0.5)
+    a1 = jnp.abs(d1)
+    a2 = jnp.abs(d2)
+    ratio = jnp.minimum(a1, a2) / jnp.maximum(jnp.maximum(a1, a2), 1e-38)
+    sim = jnp.where(ratio < min_ratio, 0.0, ratio)
+    zero_or_sign = (d1 == 0.0) | (d2 == 0.0) | ((d1 < 0.0) != (d2 < 0.0))
+    sim = jnp.where(zero_or_sign, 0.0, sim)
+    sim = jnp.where(d1 == d2, 1.0, sim)
+    return jnp.where(both, sim, neutral)
+
+
+_EARTH_RADIUS_M = 6371000.0
+
+
+def geoposition_sim(lat1, lon1, v1, lat2, lon2, v2, *, max_distance=0.0):
+    """core.comparators.Geoposition.compare (haversine; radians precomputed)."""
+    both = v1 & v2
+    dlat = lat2 - lat1
+    dlon = lon2 - lon1
+    a = (
+        jnp.sin(dlat / 2) ** 2
+        + jnp.cos(lat1) * jnp.cos(lat2) * jnp.sin(dlon / 2) ** 2
+    )
+    dist = 2.0 * _EARTH_RADIUS_M * jnp.arcsin(jnp.minimum(1.0, jnp.sqrt(a)))
+    if max_distance <= 0:
+        sim = jnp.where(dist == 0.0, 1.0, 0.0)
+    else:
+        sim = jnp.maximum(0.0, 1.0 - dist / max_distance)
+    return jnp.where(both, sim, jnp.float32(0.5))

@@ -1,0 +1,274 @@
+"""The device scoring program: per-property kernels + naive-Bayes combine.
+
+Assembles, for a given schema feature plan (ops.features.SchemaFeatures), a
+jitted function that scores a block of Q query records against the whole
+device-resident corpus in chunks, maintaining a running top-K per query.
+This replaces the reference hot loop (candidate fetch + per-pair comparator
+dispatch + Bayes fold, SURVEY.md section 3.2) with one XLA program:
+
+    for each corpus chunk (lax.scan, static trip count):
+        sims  = per-property pairwise kernels        (ops.pairwise)
+        probs = Duke's [low, high] similarity map    (per property)
+        logit = sum of clamped log-odds              (naive Bayes, 0.5 prior)
+        merge chunk scores into running top-K        (lax.top_k)
+
+Hybrid host properties: comparators without a device kernel contribute an
+*optimistic* constant logit bound on device (max(0, logit(high)) per
+property); ranking is by the device partial logit (the constant does not
+reorder), and the host adds the exact contributions for the surviving top-K
+pairs only — exact semantics at O(K) host work per query instead of O(N).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core import comparators as C
+from . import features as F
+from . import pairwise as pw
+
+# Sentinel for empty top-K slots (logit scale).
+NEG_INF = -3.0e38
+
+# Matches core.bayes._EPS: probabilities clamped away from {0, 1}.
+_EPS = 1e-10
+_MAX_LOGIT = math.log((1.0 - _EPS) / _EPS)
+
+
+def probability_to_logit(p: float) -> float:
+    p = min(max(p, _EPS), 1.0 - _EPS)
+    return math.log(p / (1.0 - p))
+
+
+def host_bound_logit(host_props) -> float:
+    """Optimistic total logit the host-scored properties could contribute."""
+    return sum(max(0.0, probability_to_logit(p.high)) for p in host_props)
+
+
+# -- per-property pair similarity -------------------------------------------
+
+
+def _pair_expand(qa: jnp.ndarray, ca: jnp.ndarray) -> tuple:
+    """(Q, V, ...) x (C, V, ...) -> flat (Q*C*V*V, ...) pair operands."""
+    q, v = qa.shape[0], qa.shape[1]
+    c = ca.shape[0]
+    rq = qa.shape[2:]
+    rc = ca.shape[2:]
+    a = jnp.broadcast_to(qa[:, None, :, None], (q, c, v, v) + rq)
+    b = jnp.broadcast_to(ca[None, :, None, :], (q, c, v, v) + rc)
+    return a.reshape((q * c * v * v,) + rq), b.reshape((q * c * v * v,) + rc)
+
+
+def _property_sim(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict) -> tuple:
+    """Pair similarity for one property.
+
+    Returns (sim, combo_valid), both flat (Q*C*V*V,).
+    """
+    hh1, hh2 = _pair_expand(qf["hash_hi"], cf["hash_hi"])
+    hl1, hl2 = _pair_expand(qf["hash_lo"], cf["hash_lo"])
+    v1, v2 = _pair_expand(qf["valid"], cf["valid"])
+    combo_valid = v1 & v2
+    equal = (hh1 == hh2) & (hl1 == hl2) & combo_valid
+
+    kind = spec.kind
+    cmp = spec.comparator
+    if kind == F.CHARS:
+        c1, c2 = _pair_expand(qf["chars"], cf["chars"])
+        l1, l2 = _pair_expand(qf["length"], cf["length"])
+        if isinstance(cmp, C.JaroWinkler):
+            sim = pw.jaro_winkler_sim(
+                c1, l1, c2, l2, equal,
+                prefix_scale=cmp.prefix_scale,
+                boost_threshold=cmp.boost_threshold,
+                max_prefix=int(cmp.max_prefix),
+            )
+        else:
+            sim = pw.levenshtein_sim(c1, l1, c2, l2, equal)
+    elif kind == F.CHARS_WEIGHTED:
+        c1, c2 = _pair_expand(qf["chars"], cf["chars"])
+        k1, k2 = _pair_expand(qf["classes"], cf["classes"])
+        l1, l2 = _pair_expand(qf["length"], cf["length"])
+        sim = pw.weighted_levenshtein_sim(
+            c1, k1, l1, c2, k2, l2, equal,
+            digit_weight=cmp.digit_weight,
+            letter_weight=cmp.letter_weight,
+            other_weight=cmp.other_weight,
+        )
+    elif kind == F.GRAM_SET:
+        g1, g2 = _pair_expand(qf["grams"], cf["grams"])
+        n1, n2 = _pair_expand(qf["gram_count"], cf["gram_count"])
+        sim = pw.qgram_sim(g1, n1, g2, n2, equal, formula=cmp.formula)
+    elif kind == F.TOKEN_SET:
+        t1, t2 = _pair_expand(qf["tokens"], cf["tokens"])
+        n1, n2 = _pair_expand(qf["token_count"], cf["token_count"])
+        sim = pw.token_set_sim(
+            t1, n1, t2, n2, equal, dice=isinstance(cmp, C.DiceCoefficient)
+        )
+    elif kind == F.HASH:
+        sim = (
+            pw.different_sim(equal)
+            if isinstance(cmp, C.Different)
+            else pw.exact_sim(equal)
+        )
+    elif kind == F.PHONETIC:
+        ch1, ch2 = _pair_expand(qf["code_hi"], cf["code_hi"])
+        cl1, cl2 = _pair_expand(qf["code_lo"], cf["code_lo"])
+        cv1, cv2 = _pair_expand(qf["code_valid"], cf["code_valid"])
+        sim = pw.phonetic_sim(equal, (ch1 == ch2) & (cl1 == cl2), cv1 & cv2)
+    elif kind == F.NUMERIC:
+        d1, d2 = _pair_expand(qf["number"], cf["number"])
+        nv1, nv2 = _pair_expand(qf["number_valid"], cf["number_valid"])
+        sim = pw.numeric_sim(d1, nv1, d2, nv2, min_ratio=cmp.min_ratio)
+    elif kind == F.GEO:
+        la1, la2 = _pair_expand(qf["lat"], cf["lat"])
+        lo1, lo2 = _pair_expand(qf["lon"], cf["lon"])
+        gv1, gv2 = _pair_expand(qf["geo_valid"], cf["geo_valid"])
+        sim = pw.geoposition_sim(
+            la1, lo1, gv1, la2, lo2, gv2, max_distance=cmp.max_distance
+        )
+    else:  # pragma: no cover - plan() never emits unknown kinds
+        raise ValueError(f"no device kernel for feature kind {kind!r}")
+    return sim, combo_valid
+
+
+def _property_logit(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict,
+                    q: int, c: int) -> jnp.ndarray:
+    """Per-pair clamped log-odds contribution of one property: (Q, C) f32.
+
+    Duke's PropertyImpl.compare map (core.records.Property.compare_probability):
+    sim >= 0.5 -> (high-0.5)*sim^2 + 0.5, else -> low; properties missing on
+    either side are neutral (prob 0.5 -> logit 0).  Max over value-pair
+    combos is taken in probability space — the map is applied per combo, so
+    semantics match the host engine even for low > 0.5 configs.
+    """
+    sim, combo_valid = _property_sim(spec, qf, cf)
+    v = spec.v
+    prob = jnp.where(
+        sim >= 0.5, (spec.high - 0.5) * sim * sim + 0.5, jnp.float32(spec.low)
+    )
+    prob = jnp.where(combo_valid, prob, -1.0)
+    prob4 = prob.reshape(q, c, v, v)
+    valid4 = combo_valid.reshape(q, c, v, v)
+    best = prob4.max(axis=(2, 3))
+    any_valid = valid4.any(axis=(2, 3))
+    best = jnp.where(any_valid, best, 0.5)
+    best = jnp.clip(best, _EPS, 1.0 - _EPS)
+    return jnp.log(best) - jnp.log1p(-best)
+
+
+def build_pair_logits(plan: F.SchemaFeatures) -> Callable:
+    """Returns fn(qfeats, cfeats) -> (Q, C) partial logit over device props."""
+
+    specs = list(plan.device_props)
+
+    def pair_logits(qfeats: Dict[str, Dict], cfeats: Dict[str, Dict]) -> jnp.ndarray:
+        first = next(iter(qfeats.values()))
+        q = first["valid"].shape[0]
+        firstc = next(iter(cfeats.values()))
+        c = firstc["valid"].shape[0]
+        total = jnp.zeros((q, c), jnp.float32)
+        for spec in specs:
+            total = total + _property_logit(
+                spec, qfeats[spec.name], cfeats[spec.name], q, c
+            )
+        return total
+
+    return pair_logits
+
+
+# -- the blockwise corpus scorer --------------------------------------------
+
+
+@dataclass
+class ScoreResult:
+    """Top-K device scores for a query block (numpy, already fetched)."""
+
+    top_logit: np.ndarray   # (Q, K) partial device logit, NEG_INF when empty
+    top_index: np.ndarray   # (Q, K) corpus row index
+    count_above: np.ndarray  # (Q,) candidates whose optimistic prob clears min threshold
+
+
+def build_corpus_scorer(
+    plan: F.SchemaFeatures,
+    *,
+    chunk: int = 512,
+    top_k: int = 64,
+    group_filtering: bool = False,
+) -> Callable:
+    """Build the jitted query-block x corpus scorer.
+
+    Returned callable signature::
+
+        fn(qfeats, corpus_feats, corpus_valid, corpus_deleted, corpus_group,
+           query_group, query_row, min_logit) -> (top_logit, top_index, count_above)
+
+    ``corpus_*`` arrays are padded to a capacity that is a multiple of
+    ``chunk``; recompiles only when the capacity changes (doubling growth).
+    ``query_row`` is each query's own corpus row (-1 when not indexed, e.g.
+    http-transform) for self-pair exclusion; ``min_logit`` is
+    logit(min(threshold, maybe_threshold)) minus the host-property bound.
+    """
+
+    pair_logits = build_pair_logits(plan)
+
+    @partial(jax.jit, static_argnames=())
+    def score(qfeats, corpus_feats, corpus_valid, corpus_deleted, corpus_group,
+              query_group, query_row, min_logit):
+        first = next(iter(qfeats.values()))
+        q = first["valid"].shape[0]
+        cap = corpus_valid.shape[0]
+        nchunks = cap // chunk
+
+        init_logit = jnp.full((q, top_k), NEG_INF, jnp.float32)
+        init_index = jnp.full((q, top_k), -1, jnp.int32)
+        init_count = jnp.zeros((q,), jnp.int32)
+
+        def body(carry, ci):
+            top_logit, top_index, count = carry
+            start = ci * chunk
+            cf = jax.tree_util.tree_map(
+                lambda a: lax.dynamic_slice_in_dim(a, start, chunk, axis=0),
+                corpus_feats,
+            )
+            logits = pair_logits(qfeats, cf)  # (Q, chunk)
+
+            cvalid = lax.dynamic_slice_in_dim(corpus_valid, start, chunk)
+            cdel = lax.dynamic_slice_in_dim(corpus_deleted, start, chunk)
+            cgroup = lax.dynamic_slice_in_dim(corpus_group, start, chunk)
+            cidx = start + jnp.arange(chunk, dtype=jnp.int32)
+
+            mask = cvalid & ~cdel
+            if group_filtering:
+                mask = mask & (cgroup[None, :] != query_group[:, None])
+            mask = mask & (cidx[None, :] != query_row[:, None])
+            logits = jnp.where(mask, logits, NEG_INF)
+
+            count = count + (logits > min_logit).sum(axis=1).astype(jnp.int32)
+
+            merged_logit = jnp.concatenate([top_logit, logits], axis=1)
+            merged_index = jnp.concatenate(
+                [top_index, jnp.broadcast_to(cidx[None, :], (q, chunk))], axis=1
+            )
+            top_logit, sel = lax.top_k(merged_logit, top_k)
+            top_index = jnp.take_along_axis(merged_index, sel, axis=1)
+            return (top_logit, top_index, count), None
+
+        (top_logit, top_index, count), _ = lax.scan(
+            body, (init_logit, init_index, init_count),
+            jnp.arange(nchunks, dtype=jnp.int32),
+        )
+        return top_logit, top_index, count
+
+    return score
+
+
+def logit_to_probability(logit: np.ndarray) -> np.ndarray:
+    return 1.0 / (1.0 + np.exp(-np.asarray(logit, dtype=np.float64)))

@@ -1,0 +1,343 @@
+"""Differential tests: device kernels (ops.*) vs scalar oracles (core.comparators).
+
+Each batched pairwise kernel must reproduce the host comparator's value for
+randomized string pairs (the host implementations are the semantic oracles;
+they in turn carry the Duke 1.2 semantics the reference drives — SURVEY.md
+section 1 L1).  Strings are kept within ops.features.MAX_CHARS so truncation
+(the one documented divergence) doesn't enter.
+"""
+
+import random
+import string
+
+import numpy as np
+import pytest
+
+from sesam_duke_microservice_tpu.core import comparators as C
+from sesam_duke_microservice_tpu.core.bayes import combine_probabilities
+from sesam_duke_microservice_tpu.ops import features as F
+from sesam_duke_microservice_tpu.ops import pairwise as pw
+from sesam_duke_microservice_tpu.ops import scoring as S
+
+rng = random.Random(1234)
+
+ALPHABET = string.ascii_lowercase + "0123456789 éøå"
+
+
+def rand_value(max_len=20, min_len=1):
+    n = rng.randint(min_len, max_len)
+    return "".join(rng.choice(ALPHABET) for _ in range(n))
+
+
+def make_pairs(n=300):
+    """Mixed pair population: random, near-duplicates, exact, empty."""
+    pairs = []
+    for _ in range(n):
+        a = rand_value()
+        roll = rng.random()
+        if roll < 0.2:
+            b = a  # exact
+        elif roll < 0.5 and a:
+            # near-duplicate: few random edits
+            b = list(a)
+            for _ in range(rng.randint(1, 3)):
+                op = rng.randint(0, 2)
+                pos = rng.randrange(len(b)) if b else 0
+                if op == 0 and b:
+                    b[pos] = rng.choice(ALPHABET)
+                elif op == 1:
+                    b.insert(pos, rng.choice(ALPHABET))
+                elif b:
+                    del b[pos]
+            b = "".join(b)
+        else:
+            b = rand_value()
+        if not b:
+            b = "x"
+        pairs.append((a, b))
+    # NOTE: empty values never reach comparators — Record.add_value drops
+    # them and the scoring driver masks invalid value slots — so pairs here
+    # are always non-empty.
+    pairs += [("a", "a"), ("a", "b"), ("ab", "ba"), ("x", "xyzzy")]
+    return pairs
+
+
+def features_for(comparator, values, low=0.3, high=0.9):
+    spec = F.PropertyFeatureSpec(
+        name="p", kind=F.feature_kind(comparator), low=low, high=high,
+        comparator=comparator,
+    )
+    feats = F.extract_property(spec, [[v] if v else [] for v in values])
+    return spec, feats
+
+
+def _flat(feats, name):
+    a = feats[name]
+    return np.asarray(a[:, 0]) if a.ndim >= 2 else np.asarray(a)
+
+
+def _equal_flags(f1, f2):
+    return (
+        (_flat(f1, "hash_hi") == _flat(f2, "hash_hi"))
+        & (_flat(f1, "hash_lo") == _flat(f2, "hash_lo"))
+        & _flat(f1, "valid")
+        & _flat(f2, "valid")
+    )
+
+
+def run_kernel(comparator, pairs):
+    """Score pairs with the device kernel matching the comparator."""
+    import jax.numpy as jnp
+
+    v1s = [p[0] for p in pairs]
+    v2s = [p[1] for p in pairs]
+    spec, f1 = features_for(comparator, v1s)
+    _, f2 = features_for(comparator, v2s)
+    equal = jnp.asarray(_equal_flags(f1, f2))
+    kind = spec.kind
+    if kind == F.CHARS:
+        if isinstance(comparator, C.JaroWinkler):
+            sim = pw.jaro_winkler_sim(
+                jnp.asarray(_flat(f1, "chars")), jnp.asarray(_flat(f1, "length")),
+                jnp.asarray(_flat(f2, "chars")), jnp.asarray(_flat(f2, "length")),
+                equal,
+                prefix_scale=comparator.prefix_scale,
+                boost_threshold=comparator.boost_threshold,
+                max_prefix=comparator.max_prefix,
+            )
+        else:
+            sim = pw.levenshtein_sim(
+                jnp.asarray(_flat(f1, "chars")), jnp.asarray(_flat(f1, "length")),
+                jnp.asarray(_flat(f2, "chars")), jnp.asarray(_flat(f2, "length")),
+                equal,
+            )
+    elif kind == F.CHARS_WEIGHTED:
+        sim = pw.weighted_levenshtein_sim(
+            jnp.asarray(_flat(f1, "chars")), jnp.asarray(_flat(f1, "classes")),
+            jnp.asarray(_flat(f1, "length")),
+            jnp.asarray(_flat(f2, "chars")), jnp.asarray(_flat(f2, "classes")),
+            jnp.asarray(_flat(f2, "length")),
+            equal,
+            digit_weight=comparator.digit_weight,
+            letter_weight=comparator.letter_weight,
+            other_weight=comparator.other_weight,
+        )
+    elif kind == F.GRAM_SET:
+        sim = pw.qgram_sim(
+            jnp.asarray(_flat(f1, "grams")), jnp.asarray(_flat(f1, "gram_count")),
+            jnp.asarray(_flat(f2, "grams")), jnp.asarray(_flat(f2, "gram_count")),
+            equal, formula=comparator.formula,
+        )
+    elif kind == F.TOKEN_SET:
+        sim = pw.token_set_sim(
+            jnp.asarray(_flat(f1, "tokens")), jnp.asarray(_flat(f1, "token_count")),
+            jnp.asarray(_flat(f2, "tokens")), jnp.asarray(_flat(f2, "token_count")),
+            equal, dice=isinstance(comparator, C.DiceCoefficient),
+        )
+    elif kind == F.HASH:
+        sim = (
+            pw.different_sim(equal)
+            if isinstance(comparator, C.Different)
+            else pw.exact_sim(equal)
+        )
+    elif kind == F.PHONETIC:
+        code_equal = (
+            (_flat(f1, "code_hi") == _flat(f2, "code_hi"))
+            & (_flat(f1, "code_lo") == _flat(f2, "code_lo"))
+        )
+        sim = pw.phonetic_sim(
+            equal, jnp.asarray(code_equal),
+            jnp.asarray(_flat(f1, "code_valid") & _flat(f2, "code_valid")),
+        )
+    elif kind == F.NUMERIC:
+        sim = pw.numeric_sim(
+            jnp.asarray(_flat(f1, "number")), jnp.asarray(_flat(f1, "number_valid")),
+            jnp.asarray(_flat(f2, "number")), jnp.asarray(_flat(f2, "number_valid")),
+            min_ratio=comparator.min_ratio,
+        )
+    elif kind == F.GEO:
+        sim = pw.geoposition_sim(
+            jnp.asarray(_flat(f1, "lat")), jnp.asarray(_flat(f1, "lon")),
+            jnp.asarray(_flat(f1, "geo_valid")),
+            jnp.asarray(_flat(f2, "lat")), jnp.asarray(_flat(f2, "lon")),
+            jnp.asarray(_flat(f2, "geo_valid")),
+            max_distance=comparator.max_distance,
+        )
+    else:
+        raise AssertionError(kind)
+    return np.asarray(sim)
+
+
+CHAR_COMPARATORS = [
+    C.Levenshtein(),
+    C.WeightedLevenshtein(),
+    C.JaroWinkler(),
+]
+
+
+@pytest.mark.parametrize(
+    "comparator",
+    CHAR_COMPARATORS + [C.QGram(), C.JaccardIndex(), C.DiceCoefficient(),
+                        C.Exact(), C.Different(), C.Soundex(), C.Metaphone(),
+                        C.Norphone()],
+    ids=lambda c: type(c).__name__,
+)
+def test_kernel_matches_oracle(comparator):
+    pairs = make_pairs()
+    got = run_kernel(comparator, pairs)
+    want = np.array([comparator.compare(a, b) for a, b in pairs])
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_qgram_formulas():
+    pairs = make_pairs(150)
+    for formula in ("overlap", "jaccard", "dice"):
+        cmp = C.QGram()
+        cmp.formula = formula
+        got = run_kernel(cmp, pairs)
+        want = np.array([cmp.compare(a, b) for a, b in pairs])
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_qgram_q3():
+    cmp = C.QGram()
+    cmp.q = 3
+    pairs = make_pairs(150)
+    got = run_kernel(cmp, pairs)
+    want = np.array([cmp.compare(a, b) for a, b in pairs])
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_numeric_kernel():
+    cmp = C.Numeric()
+    cmp.min_ratio = 0.7
+    values = ["42", "41", "0", "-5", "5", "abc", "", "1e3", "999.5", "nan", "42"]
+    pairs = [(a, b) for a in values for b in values]
+    got = run_kernel(cmp, pairs)
+    want = np.array([cmp.compare(a, b) for a, b in pairs])
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_geoposition_kernel():
+    cmp = C.Geoposition()
+    cmp.max_distance = 5000.0
+    values = ["59.91,10.75", "59.92,10.76", "40.71,-74.0", "bogus", "", "59.91,10.75"]
+    pairs = [(a, b) for a in values for b in values]
+    got = run_kernel(cmp, pairs)
+    want = np.array([cmp.compare(a, b) for a, b in pairs])
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_levenshtein_distance_exact():
+    pairs = make_pairs(200)
+    spec, f1 = features_for(C.Levenshtein(), [p[0] for p in pairs])
+    _, f2 = features_for(C.Levenshtein(), [p[1] for p in pairs])
+    import jax.numpy as jnp
+
+    dist = np.asarray(
+        pw.levenshtein_distance(
+            jnp.asarray(_flat(f1, "chars")), jnp.asarray(_flat(f1, "length")),
+            jnp.asarray(_flat(f2, "chars")), jnp.asarray(_flat(f2, "length")),
+        )
+    )
+    want = np.array([C.levenshtein_distance(a, b) for a, b in pairs])
+    np.testing.assert_array_equal(dist, want)
+
+
+# -- the assembled scoring program ------------------------------------------
+
+
+def test_pair_logits_match_host_bayes():
+    """build_pair_logits == host per-pair Bayes over a multi-property schema."""
+    import jax.numpy as jnp
+    from sesam_duke_microservice_tpu.core.config import DukeSchema
+    from sesam_duke_microservice_tpu.core.records import Property
+
+    lev = C.Levenshtein()
+    num = C.Numeric()
+    num.min_ratio = 0.7
+    props = [
+        Property("ID", id_property=True),
+        Property("name", lev, 0.3, 0.8),
+        Property("area", num, 0.1, 0.9),
+    ]
+    schema = DukeSchema(
+        threshold=0.85, maybe_threshold=None, properties=props, data_sources=[]
+    )
+    plan = F.SchemaFeatures.plan(schema)
+    assert not plan.host_props
+
+    n = 40
+    recs1 = []
+    recs2 = []
+    for i in range(n):
+        name = rand_value(12)
+        recs1.append({"name": [name] if name else [],
+                      "area": [str(rng.randint(1, 50))]})
+        name2 = name if rng.random() < 0.5 else rand_value(12)
+        recs2.append({"name": [name2] if name2 else [],
+                      "area": [str(rng.randint(1, 50))]})
+
+    def feats(recs):
+        return {
+            spec.name: F.extract_property(spec, [r[spec.name] for r in recs])
+            for spec in plan.device_props
+        }
+
+    f1 = {k: {n2: jnp.asarray(a) for n2, a in d.items()} for k, d in feats(recs1).items()}
+    f2 = {k: {n2: jnp.asarray(a) for n2, a in d.items()} for k, d in feats(recs2).items()}
+
+    pair_logits = S.build_pair_logits(plan)
+    logits = np.asarray(pair_logits(f1, f2))  # (n, n)
+    probs = S.logit_to_probability(logits)
+
+    name_prop = props[1]
+    area_prop = props[2]
+    for i in range(0, n, 7):
+        for j in range(0, n, 7):
+            ps = []
+            if recs1[i]["name"] and recs2[j]["name"]:
+                ps.append(
+                    name_prop.compare_probability(
+                        recs1[i]["name"][0], recs2[j]["name"][0]
+                    )
+                )
+            ps.append(
+                area_prop.compare_probability(
+                    recs1[i]["area"][0], recs2[j]["area"][0]
+                )
+            )
+            want = combine_probabilities(ps)
+            assert probs[i, j] == pytest.approx(want, abs=1e-4)
+
+
+def test_multi_value_max_semantics():
+    """Multi-valued properties: device takes max prob over value pairs."""
+    import jax.numpy as jnp
+    from sesam_duke_microservice_tpu.core.records import Property
+
+    lev = C.Levenshtein()
+    prop = Property("name", lev, 0.3, 0.8)
+    spec = F.PropertyFeatureSpec(
+        name="name", kind=F.CHARS, low=0.3, high=0.8, comparator=lev,
+        values_per_record=2,
+    )
+    v1 = [["alpha", "beta"]]
+    v2 = [["betta"]]
+    f1 = {k: jnp.asarray(v) for k, v in F.extract_property(spec, v1).items()}
+    f2 = {k: jnp.asarray(v) for k, v in F.extract_property(spec, v2).items()}
+    logit = np.asarray(S._property_logit(spec, f1, f2, 1, 1))[0, 0]
+    want = max(
+        prop.compare_probability(a, b) for a in v1[0] for b in v2[0]
+    )
+    got = S.logit_to_probability(logit)
+    assert got == pytest.approx(want, abs=1e-5)
+
+
+def test_host_bound_logit():
+    from sesam_duke_microservice_tpu.core.records import Property
+
+    props = [Property("a", C.PersonName(), 0.2, 0.8),
+             Property("b", C.PersonName(), 0.4, 0.5)]
+    bound = S.host_bound_logit(props)
+    assert bound == pytest.approx(S.probability_to_logit(0.8), abs=1e-9)
