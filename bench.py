@@ -1,0 +1,160 @@
+"""Benchmark: record pairs scored per second, device vs CPU baseline.
+
+Replicates the reference's stresstest workload shape (seeded fake entities,
+sesam_node_deduplication_stresstest_config.conf.json:86-106 — seed 1234,
+area in [1,10], ids in [1,1e6]) and measures the BASELINE.json metric:
+record-pairs scored per second per chip at dedup semantics.
+
+  * CPU baseline: the host engine's exact pair scoring loop
+    (engine.processor.Processor.compare — Duke-InMemoryDatabase-style
+    brute force) over a sample of pairs, extrapolated to pairs/sec.
+  * Device: DeviceProcessor over the full corpus — every query scored
+    against every HBM-resident corpus row by the blockwise XLA program.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import time
+
+import numpy as np
+
+# bench sizes (env-overridable for quick runs)
+CORPUS = int(os.environ.get("BENCH_CORPUS", "8192"))
+QUERIES = int(os.environ.get("BENCH_QUERIES", "1024"))
+CPU_SAMPLE_PAIRS = int(os.environ.get("BENCH_CPU_PAIRS", "20000"))
+
+
+def stresstest_records(n, seed=1234, dataset="ds1"):
+    """Seeded fake entities mirroring the sesam stresstest value pools."""
+    from sesam_duke_microservice_tpu.core.records import (
+        DATASET_ID_PROPERTY_NAME,
+        ID_PROPERTY_NAME,
+        ORIGINAL_ENTITY_ID_PROPERTY_NAME,
+        Record,
+    )
+
+    rng = random.Random(seed)
+    first = ["ole", "kari", "per", "anne", "nils", "ingrid", "lars", "berit",
+             "jan", "liv", "arne", "astrid", "knut", "solveig", "odd", "randi"]
+    last = ["hansen", "johansen", "olsen", "larsen", "andersen", "pedersen",
+            "nilsen", "kristiansen", "jensen", "karlsen", "johnsen", "pettersen"]
+    records = []
+    for i in range(n):
+        r = Record()
+        eid = str(rng.randint(1, 1_000_000))
+        r.add_value(ID_PROPERTY_NAME, f"{dataset}__{eid}_{i}")
+        r.add_value(ORIGINAL_ENTITY_ID_PROPERTY_NAME, f"{eid}_{i}")
+        r.add_value(DATASET_ID_PROPERTY_NAME, dataset)
+        name = f"{rng.choice(first)} {rng.choice(last)}"
+        if rng.random() < 0.15:  # perturbations create near-duplicates
+            pos = rng.randrange(len(name))
+            name = name[:pos] + rng.choice("abcdefghij") + name[pos + 1:]
+        r.add_value("name", name)
+        r.add_value("area", str(rng.randint(1, 10)))
+        r.add_value("ssn", str(rng.randint(1, 1_000_000)))
+        records.append(r)
+    return records
+
+
+def bench_schema():
+    from sesam_duke_microservice_tpu.core import comparators as C
+    from sesam_duke_microservice_tpu.core.config import DukeSchema
+    from sesam_duke_microservice_tpu.core.records import (
+        ID_PROPERTY_NAME,
+        Property,
+    )
+
+    numeric = C.Numeric()
+    numeric.min_ratio = 0.7
+    return DukeSchema(
+        threshold=0.9,
+        maybe_threshold=None,
+        properties=[
+            Property(ID_PROPERTY_NAME, id_property=True),
+            Property("name", C.Levenshtein(), 0.3, 0.88),
+            Property("area", numeric, 0.45, 0.65),
+            Property("ssn", C.Exact(), 0.3, 0.95),
+        ],
+        data_sources=[],
+    )
+
+
+def cpu_baseline_pairs_per_sec(schema, records) -> float:
+    """Exact host pair scoring rate (Duke-style scalar hot loop)."""
+    from sesam_duke_microservice_tpu.engine.processor import Processor
+
+    proc = Processor(schema, database=None)
+    rng = random.Random(4321)
+    n = len(records)
+    pairs = [
+        (records[rng.randrange(n)], records[rng.randrange(n)])
+        for _ in range(CPU_SAMPLE_PAIRS)
+    ]
+    t0 = time.perf_counter()
+    acc = 0.0
+    for r1, r2 in pairs:
+        acc += proc.compare(r1, r2)
+    dt = time.perf_counter() - t0
+    assert acc >= 0.0
+    return CPU_SAMPLE_PAIRS / dt
+
+
+def device_pairs_per_sec(schema, corpus_records, query_records) -> float:
+    """Steady-state device scoring rate over an indexed corpus."""
+    from sesam_duke_microservice_tpu.engine.device_matcher import (
+        DeviceIndex,
+        DeviceProcessor,
+    )
+
+    index = DeviceIndex(schema)
+    proc = DeviceProcessor(schema, index)
+
+    # build the corpus (feature extraction + device transfer, not timed:
+    # the metric is scoring throughput; ingest cost is amortized across the
+    # corpus lifetime in the incremental service)
+    for r in corpus_records:
+        index.index(r)
+    index.commit()
+
+    # warmup: compile the scorer for the bucket shapes
+    warm = query_records[: min(64, len(query_records))]
+    proc.deduplicate(warm)
+
+    stats0 = proc.stats.pairs_compared
+    t0 = time.perf_counter()
+    proc.deduplicate(query_records)
+    dt = time.perf_counter() - t0
+    scored = proc.stats.pairs_compared - stats0
+    return scored / dt
+
+
+def main():
+    schema = bench_schema()
+    corpus = stresstest_records(CORPUS, seed=1234)
+    queries = stresstest_records(QUERIES, seed=5678, dataset="ds2")
+
+    cpu_rate = cpu_baseline_pairs_per_sec(schema, corpus)
+    dev_rate = device_pairs_per_sec(schema, corpus, queries)
+
+    result = {
+        "metric": "pairs_scored_per_sec",
+        "value": round(dev_rate, 1),
+        "unit": "pairs/s",
+        "vs_baseline": round(dev_rate / cpu_rate, 2),
+    }
+    print(json.dumps(result))
+    print(
+        f"# cpu_baseline={cpu_rate:.0f} pairs/s, device={dev_rate:.0f} pairs/s, "
+        f"corpus={CORPUS}, queries={QUERIES}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,578 @@
+"""The TPU-native matching backend: device-resident corpus + batched scoring.
+
+Replaces the reference hot path (per-record Lucene candidate query + per-pair
+scalar comparator dispatch — SURVEY.md section 3.2, hot loops 1-2) with one
+XLA program per query block: the whole corpus lives on device as padded
+feature tensors (``ops.features``), a jitted blockwise scorer
+(``ops.scoring.build_corpus_scorer``) scores every query against every
+corpus row in chunks keeping a running top-K, and the host only finalizes
+the surviving K pairs per query.
+
+Semantics contract (held to the host engine by differential tests in
+``tests/test_device_matcher.py``):
+
+  * exact brute-force blocking — candidates are a superset of anything
+    Lucene retrieves, so recall can only improve (SURVEY.md section 7
+    "blocking recall parity");
+  * the match/maybe/no-match events equal the host ``engine.processor``'s
+    for every pair whose probability clears ``min(threshold,
+    maybe_threshold)``: device logits are exact for device-kernel
+    properties, and host-only comparators are re-scored exactly for the
+    surviving pairs (optimistic-bound pruning, ``ops.scoring``);
+  * K-escalation keeps this exact: if any query had more potential
+    candidates than K, the scorer re-runs with doubled K until all fit.
+
+Mutation model (vs Lucene's delete-then-readd,
+IncrementalLuceneDatabase.java:507-517): the corpus is append-only with
+tombstone masks.  Re-indexing an ID tombstones the old row and appends a new
+one; ``dukeDeleted`` records stay resolvable by id (the GET feed needs them,
+App.java:854-855) but carry a deleted mask bit that excludes them from
+candidate scoring (IncrementalLuceneDatabase.java:478).  Capacity grows by
+doubling in multiples of the scan chunk, so the jitted scorer recompiles
+only O(log N) times over a corpus's lifetime.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.config import DukeSchema, MatchTunables
+from ..core.records import GROUP_NO_PROPERTY_NAME, Record, SchemaError
+from ..index.base import CandidateIndex
+from .listeners import MatchListener
+from .processor import ProfileStats
+
+logger = logging.getLogger("device-matcher")
+
+# Query blocks are bucketed to these sizes so batch-size jitter does not
+# recompile the scorer (static shapes; SURVEY.md section 7 hard part 2).
+# Env-tunable so the CPU test backend can use small shapes; TPU defaults
+# are sized for the MXU/VPU (DEVICE_CHUNK rows of corpus per scan step).
+_QUERY_BUCKETS = tuple(
+    int(b) for b in os.environ.get("DEVICE_QUERY_BUCKETS", "16,64,256").split(",")
+)
+_CHUNK = int(os.environ.get("DEVICE_CHUNK", "512"))
+_INITIAL_TOP_K = int(os.environ.get("DEVICE_TOP_K", "64"))
+
+
+def _bucket_for(n: int) -> int:
+    for b in _QUERY_BUCKETS:
+        if n <= b:
+            return b
+    return _QUERY_BUCKETS[-1]
+
+
+class DeviceCorpus:
+    """Host mirror + device tensors for one workload's indexed records.
+
+    Numpy arrays are the durable host mirror (rebuildable source of truth is
+    the record store); device arrays are refreshed lazily per commit.  Rows
+    are append-only; ``row_valid`` clears on tombstone.
+    """
+
+    def __init__(self, plan, values_per_record: int):
+        self.plan = plan
+        self.v = values_per_record
+        self.capacity = 0
+        self.size = 0
+        self.feats: Dict[str, Dict[str, np.ndarray]] = {}
+        self.row_valid = np.zeros((0,), dtype=bool)
+        self.row_deleted = np.zeros((0,), dtype=bool)
+        self.row_group = np.full((0,), -1, dtype=np.int32)
+        self.row_ids: List[Optional[str]] = []
+        self._device = None           # cached jnp feature mirrors
+        self._dirty_full = True       # capacity changed -> full re-upload
+        self._dirty_masks = True      # valid/deleted/group changed (small)
+        self._pending_update: Optional[Tuple[int, int]] = None  # appended rows
+        self._mask_device = None
+
+    # -- growth --------------------------------------------------------------
+
+    def _grow(self, needed: int) -> None:
+        cap = max(self.capacity, _CHUNK)
+        while cap < needed:
+            cap *= 2
+        if cap == self.capacity:
+            return
+        self.row_valid = _grow_1d(self.row_valid, cap, False)
+        self.row_deleted = _grow_1d(self.row_deleted, cap, False)
+        self.row_group = _grow_1d(self.row_group, cap, -1)
+        for prop, tensors in self.feats.items():
+            self.feats[prop] = {
+                name: _grow_nd(arr, cap) for name, arr in tensors.items()
+            }
+        self.capacity = cap
+        self._dirty_full = True
+        self._dirty_masks = True
+
+    def append(self, feats: Dict[str, Dict[str, np.ndarray]],
+               deleted: np.ndarray, group: np.ndarray,
+               ids: Sequence[str]) -> np.ndarray:
+        """Append N rows; returns their row indices."""
+        n = len(ids)
+        if n == 0:
+            return np.zeros((0,), dtype=np.int64)
+        if not self.feats:
+            # first append defines per-property tensor shapes
+            self.feats = {
+                prop: {
+                    name: np.zeros((0,) + arr.shape[1:], dtype=arr.dtype)
+                    for name, arr in tensors.items()
+                }
+                for prop, tensors in feats.items()
+            }
+        self._grow(self.size + n)
+        rows = np.arange(self.size, self.size + n)
+        for prop, tensors in feats.items():
+            for name, arr in tensors.items():
+                self.feats[prop][name][rows] = arr
+        self.row_valid[rows] = True
+        self.row_deleted[rows] = deleted
+        self.row_group[rows] = group
+        self.row_ids.extend(ids)
+        old_size, self.size = self.size, self.size + n
+        self._dirty_masks = True
+        if not self._dirty_full:
+            # track the appended range for an incremental device update;
+            # merge with a prior un-flushed range (always contiguous)
+            if self._pending_update is None:
+                self._pending_update = (old_size, n)
+            else:
+                s, c = self._pending_update
+                self._pending_update = (s, old_size + n - s)
+        return rows
+
+    def tombstone(self, row: int) -> None:
+        self.row_valid[row] = False
+        self._dirty_masks = True
+
+    # -- device mirror -------------------------------------------------------
+
+    def device_arrays(self):
+        """(feats, valid, deleted, group) as device arrays.
+
+        Steady-state incremental batches update the device copy in place
+        (one ``dynamic_update_slice`` per feature tensor, O(batch) transfer)
+        instead of re-uploading the whole corpus; a full upload happens only
+        on capacity growth.  The three O(capacity)-byte mask arrays are
+        always refreshed wholesale — tombstones touch arbitrary rows and
+        the arrays are tiny next to the feature tensors.
+        """
+        import jax.numpy as jnp
+
+        if self._device is None or self._dirty_full:
+            self._device = {
+                prop: {name: jnp.asarray(arr) for name, arr in tensors.items()}
+                for prop, tensors in self.feats.items()
+            }
+            self._pending_update = None
+            self._dirty_full = False
+        elif self._pending_update is not None:
+            start, count = self._pending_update
+            # bucket the update length to limit updater recompiles
+            bucket = _CHUNK
+            while bucket < count:
+                bucket *= 2
+            bucket = min(bucket, self.capacity)
+            start = min(start, self.capacity - bucket)
+            self._device = {
+                prop: {
+                    name: _updated_rows(dev, self.feats[prop][name], start,
+                                        bucket)
+                    for name, dev in tensors.items()
+                }
+                for prop, tensors in self._device.items()
+            }
+            self._pending_update = None
+        if self._mask_device is None or self._dirty_masks:
+            self._mask_device = (
+                jnp.asarray(self.row_valid),
+                jnp.asarray(self.row_deleted),
+                jnp.asarray(self.row_group),
+            )
+            self._dirty_masks = False
+        valid, deleted, group = self._mask_device
+        return self._device, valid, deleted, group
+
+
+def _updated_rows(dev, host_arr: np.ndarray, start: int, bucket: int):
+    """In-place-update rows [start, start+bucket) of a device array from the
+    host mirror.  Donation lets XLA reuse the existing device buffer."""
+    upd = host_arr[start:start + bucket]
+    return _row_updater(dev.dtype, dev.ndim)(dev, upd, np.int32(start))
+
+
+_ROW_UPDATERS: Dict = {}
+
+
+def _row_updater(dtype, ndim):
+    import jax
+    from jax import lax
+
+    key = (str(dtype), ndim)
+    if key not in _ROW_UPDATERS:
+        # start stays a traced scalar: one compile per (dtype, rank, shapes),
+        # not per update position
+        _ROW_UPDATERS[key] = jax.jit(
+            lambda dev, upd, start: lax.dynamic_update_slice_in_dim(
+                dev, upd, start, axis=0
+            ),
+            donate_argnums=(0,),
+        )
+    return _ROW_UPDATERS[key]
+
+
+def _grow_1d(arr: np.ndarray, cap: int, fill) -> np.ndarray:
+    out = np.full((cap,), fill, dtype=arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+def _grow_nd(arr: np.ndarray, cap: int) -> np.ndarray:
+    # grown rows are zero-filled, which is safe ONLY because they stay
+    # row_valid=False until append() overwrites them — never read them
+    # unmasked (sorted-set tensors would need SET_PAD fill otherwise)
+    out = np.zeros((cap,) + arr.shape[1:], dtype=arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+class DeviceIndex(CandidateIndex):
+    """``CandidateIndex`` backed by the device-resident corpus.
+
+    Candidate retrieval through this interface is exact brute force (every
+    live record whose optimistic device score clears ``min_relevance`` is a
+    candidate) — but the fast path is ``DeviceProcessor.deduplicate``, which
+    never materializes candidate Records and goes straight from the scorer's
+    top-K to listener events.
+    """
+
+    def __init__(self, schema: DukeSchema, *,
+                 tunables: Optional[MatchTunables] = None,
+                 values_per_record: Optional[int] = None):
+        from ..ops import features as F
+
+        self.schema = schema
+        self.tunables = tunables or MatchTunables()
+        v = values_per_record or int(os.environ.get("DEVICE_VALUE_SLOTS", "1"))
+        self.plan = F.SchemaFeatures.plan(schema, values_per_record=v)
+        if not self.plan.device_props:
+            raise SchemaError(
+                "the device backend needs at least one comparison property "
+                "with a device kernel (all configured comparators are "
+                "host-only); use the host backend for this schema"
+            )
+        self.corpus = DeviceCorpus(self.plan, v)
+        self.records: Dict[str, Record] = {}     # id -> live record
+        self.id_to_row: Dict[str, int] = {}
+        self.indexing_disabled = False
+        self._pending: List[Record] = []
+        self._lock = threading.Lock()
+        self._scorer_cache: Optional["_ScorerCache"] = None
+
+    @property
+    def scorer_cache(self) -> "_ScorerCache":
+        if self._scorer_cache is None:
+            self._scorer_cache = _ScorerCache(self)
+        return self._scorer_cache
+
+    # -- CandidateIndex ------------------------------------------------------
+
+    def index(self, record: Record) -> None:
+        if self.indexing_disabled:
+            return
+        with self._lock:
+            self._pending.append(record)
+
+    def commit(self) -> None:
+        from ..ops import features as F
+
+        with self._lock:
+            pending, self._pending = self._pending, []
+        if not pending:
+            return
+        # last write per ID wins within a batch (Duke re-index semantics)
+        by_id: Dict[str, Record] = {}
+        for r in pending:
+            by_id[r.record_id] = r
+        records = list(by_id.values())
+        for r in records:
+            old = self.id_to_row.get(r.record_id)
+            if old is not None:
+                self.corpus.tombstone(old)
+        feats = F.extract_batch(self.plan, records)
+        deleted = np.array([r.is_deleted() for r in records], dtype=bool)
+        group = np.array(
+            [int(r.get_value(GROUP_NO_PROPERTY_NAME) or -1) for r in records],
+            dtype=np.int32,
+        )
+        ids = [r.record_id for r in records]
+        rows = self.corpus.append(feats, deleted, group, ids)
+        for r, row in zip(records, rows):
+            self.id_to_row[r.record_id] = int(row)
+            self.records[r.record_id] = r
+
+    def find_record_by_id(self, record_id: str) -> Optional[Record]:
+        return self.records.get(record_id)
+
+    def find_candidate_matches(self, record: Record,
+                               group_filtering: bool = False) -> List[Record]:
+        """Interface-parity path: scores one record against the corpus and
+        returns every live record whose *device* probability clears
+        ``min_relevance``-equivalent pruning.  The DeviceProcessor fast path
+        bypasses this."""
+        result = self.scorer_cache.score_block(
+            [record], group_filtering=group_filtering
+        )
+        out: List[Record] = []
+        for row, _logit in result.survivors(0):
+            rid = self.corpus.row_ids[row]
+            rec = self.records.get(rid)
+            if rec is not None:
+                out.append(rec)
+        return out
+
+    def delete(self, record: Record) -> None:
+        with self._lock:
+            row = self.id_to_row.pop(record.record_id, None)
+            if row is not None:
+                self.corpus.tombstone(row)
+            self.records.pop(record.record_id, None)
+
+    def set_indexing_disabled(self, disabled: bool) -> None:
+        self.indexing_disabled = disabled
+
+    def close(self) -> None:
+        pass
+
+
+class _BlockResult:
+    """Scored query block: per-query candidate rows above the pruning bound."""
+
+    def __init__(self, top_logit: np.ndarray, top_index: np.ndarray,
+                 min_logit: float):
+        self.top_logit = top_logit
+        self.top_index = top_index
+        self.min_logit = min_logit
+
+    def survivors(self, q: int) -> List[Tuple[int, float]]:
+        """(corpus_row, device_logit) pairs that may clear the threshold."""
+        logits = self.top_logit[q]
+        rows = self.top_index[q]
+        keep = logits > self.min_logit
+        return [(int(r), float(l)) for r, l in zip(rows[keep], logits[keep])]
+
+
+class _ScorerCache:
+    """Builds/caches jitted scorers per (top_k, group_filtering) and runs the
+    exact K-escalation loop."""
+
+    def __init__(self, index: DeviceIndex):
+        self.index = index
+        self._scorers: Dict[Tuple[int, bool], object] = {}
+
+    def _scorer(self, top_k: int, group_filtering: bool):
+        from ..ops import scoring as S
+
+        key = (top_k, group_filtering)
+        if key not in self._scorers:
+            self._scorers[key] = S.build_corpus_scorer(
+                self.index.plan, chunk=_CHUNK, top_k=top_k,
+                group_filtering=group_filtering,
+            )
+        return self._scorers[key]
+
+    def score_block(self, records: Sequence[Record], *,
+                    group_filtering: bool) -> _BlockResult:
+        from ..ops import features as F
+        from ..ops import scoring as S
+        import jax.numpy as jnp
+
+        index = self.index
+        schema = index.schema
+        corpus = index.corpus
+        n = len(records)
+
+        thresholds = [schema.threshold]
+        if schema.maybe_threshold:
+            thresholds.append(schema.maybe_threshold)
+        min_threshold = min(thresholds)
+        host_bound = S.host_bound_logit(index.plan.host_props)
+        # 1e-3 safety margin covers float32 kernel error at the bound; the
+        # surviving pairs are re-scored host-exact, so the margin only costs
+        # a few extra finalizations, never correctness.
+        min_logit = S.probability_to_logit(min_threshold) - host_bound - 1e-3
+
+        if corpus.size == 0:
+            return _BlockResult(
+                np.full((n, 1), S.NEG_INF, np.float32),
+                np.full((n, 1), -1, np.int32), min_logit,
+            )
+
+        bucket = _bucket_for(n)
+        # (a block larger than the biggest bucket is split by the caller)
+        rows = [index.id_to_row.get(r.record_id, -1) for r in records]
+        if all(row >= 0 for row in rows):
+            # normal dedup/linkage path: the batch was just indexed, so its
+            # features already sit in the corpus host mirror — gather rows
+            # instead of re-running per-character extraction (the dominant
+            # host cost)
+            rows_np = np.asarray(rows)
+            qfeats_np = {
+                prop: {name: arr[rows_np] for name, arr in tensors.items()}
+                for prop, tensors in corpus.feats.items()
+            }
+        else:
+            # http-transform: queries are not in the corpus
+            qfeats_np = F.extract_batch(index.plan, records)
+        qfeats = {
+            prop: {
+                name: jnp.asarray(_pad_rows(arr, bucket))
+                for name, arr in tensors.items()
+            }
+            for prop, tensors in qfeats_np.items()
+        }
+        query_row = np.full((bucket,), -1, dtype=np.int32)
+        query_group = np.full((bucket,), -2, dtype=np.int32)
+        for i, r in enumerate(records):
+            query_row[i] = rows[i]
+            group_no = r.get_value(GROUP_NO_PROPERTY_NAME)
+            if group_filtering and not group_no:
+                # host-engine parity (index.inverted.find_candidate_matches)
+                raise ValueError(
+                    f"The '{GROUP_NO_PROPERTY_NAME}' property was missing "
+                    "or empty!"
+                )
+            query_group[i] = int(group_no) if group_no else -2
+        query_row_j = jnp.asarray(query_row)
+        query_group_j = jnp.asarray(query_group)
+
+        cfeats, cvalid, cdeleted, cgroup = corpus.device_arrays()
+        top_k = _INITIAL_TOP_K
+        while True:
+            k = min(top_k, corpus.capacity)
+            scorer = self._scorer(k, group_filtering)
+            top_logit, top_index, count = scorer(
+                qfeats, cfeats, cvalid, cdeleted, cgroup,
+                query_group_j, query_row_j, jnp.float32(min_logit),
+            )
+            count_np = np.asarray(count)[:n]
+            if k >= corpus.capacity or count_np.max(initial=0) <= k:
+                return _BlockResult(
+                    np.asarray(top_logit), np.asarray(top_index), min_logit
+                )
+            top_k = k * 2
+            logger.info(
+                "K-escalation: %d candidates above bound, retrying with K=%d",
+                int(count_np.max()), top_k,
+            )
+
+
+def _pad_rows(arr: np.ndarray, bucket: int) -> np.ndarray:
+    n = arr.shape[0]
+    if n == bucket:
+        return arr
+    out = np.zeros((bucket,) + arr.shape[1:], dtype=arr.dtype)
+    out[:n] = arr
+    return out
+
+
+class DeviceProcessor:
+    """Drop-in for ``engine.processor.Processor`` running the TPU path.
+
+    Same listener event protocol (SURVEY.md section 1 L1); the per-record
+    candidate loop becomes: block queries -> one device scoring program ->
+    host finalization of the surviving top-K pairs.
+    """
+
+    def __init__(self, schema: DukeSchema, database: DeviceIndex, *,
+                 group_filtering: bool = False, profile: bool = False,
+                 threads: int = 1):
+        self.schema = schema
+        self.database = database
+        self.group_filtering = group_filtering
+        self.profile = profile
+        self.listeners: List[MatchListener] = []
+        self.stats = ProfileStats()
+        self._scorers = database.scorer_cache
+        del threads  # device path has no host thread fan-out
+
+    def add_match_listener(self, listener: MatchListener) -> None:
+        self.listeners.append(listener)
+
+    # host-exact pair probability: surviving pairs are finalized with the
+    # same double-precision math as the host engine, so threshold decisions
+    # and reported confidences are bit-identical to ``engine.processor``
+    # (SURVEY.md section 7 hard part 4) — the device program is a pruning
+    # filter, never the source of emitted probabilities.
+    def compare(self, r1: Record, r2: Record) -> float:
+        from .processor import Processor
+
+        return Processor.compare(self, r1, r2)
+
+    def deduplicate(self, records: Sequence[Record]) -> None:
+        t0 = time.monotonic()
+        for listener in self.listeners:
+            listener.batch_ready(len(records))
+
+        for record in records:
+            self.database.index(record)
+        self.database.commit()
+
+        threshold = self.schema.threshold
+        maybe = self.schema.maybe_threshold
+        corpus = self.database.corpus
+        live_rows = int(corpus.row_valid.sum() - corpus.row_deleted[
+            corpus.row_valid].sum())
+
+        for start in range(0, len(records), _QUERY_BUCKETS[-1]):
+            block = records[start:start + _QUERY_BUCKETS[-1]]
+            t1 = time.monotonic()
+            result = self._scorers.score_block(
+                block, group_filtering=self.group_filtering
+            )
+            t2 = time.monotonic()
+            self.stats.retrieval_seconds += t2 - t1
+
+            for qi, record in enumerate(block):
+                survivors = result.survivors(qi)
+                found = False
+                for row, _device_logit in survivors:
+                    rid = corpus.row_ids[row]
+                    candidate = self.database.records.get(rid)
+                    if candidate is None or rid == record.record_id:
+                        continue
+                    prob = self.compare(record, candidate)
+                    if prob > threshold:
+                        found = True
+                        self._emit("matches", record, candidate, prob)
+                    elif maybe is not None and maybe != 0.0 and prob > maybe:
+                        found = True
+                        self._emit("matches_perhaps", record, candidate, prob)
+                if not found:
+                    for listener in self.listeners:
+                        listener.no_match_for(record)
+                self.stats.records_processed += 1
+                self.stats.candidates_retrieved += len(survivors)
+                # the device scored this query against every live corpus row
+                self.stats.pairs_compared += live_rows
+            self.stats.compare_seconds += time.monotonic() - t2
+
+        self.stats.batches += 1
+        for listener in self.listeners:
+            listener.batch_done()
+        if self.profile:
+            logger.info(
+                "batch=%d records, corpus=%d, %.3fs",
+                len(records), corpus.size, time.monotonic() - t0,
+            )
+
+    def _emit(self, event: str, r1: Record, r2: Record, prob: float) -> None:
+        for listener in self.listeners:
+            getattr(listener, event)(r1, r2, prob)

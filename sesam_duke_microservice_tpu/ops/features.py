@@ -26,10 +26,13 @@ from ..core.records import Record
 
 # Static shape defaults (device tensors are padded to these; values are
 # truncated — the only intended divergence from the host oracle, documented
-# in tests/test_ops.py).
-MAX_CHARS = 64       # chars per value for edit-distance comparators
-MAX_GRAMS = 64       # distinct q-grams per value (64 >= MAX_CHARS - q + 1)
-MAX_TOKENS = 16      # distinct whitespace tokens per value
+# in tests/test_ops.py).  Env-tunable: the CPU test backend uses smaller
+# shapes (tests/conftest.py) since it executes the kernels without an MXU.
+import os as _os
+
+MAX_CHARS = int(_os.environ.get("DEVICE_MAX_CHARS", "64"))
+MAX_GRAMS = int(_os.environ.get("DEVICE_MAX_GRAMS", "64"))
+MAX_TOKENS = int(_os.environ.get("DEVICE_MAX_TOKENS", "16"))
 
 _FNV_OFFSET = 0xCBF29CE484222325
 _FNV_PRIME = 0x100000001B3

@@ -196,6 +196,75 @@ class ScoreResult:
     count_above: np.ndarray  # (Q,) candidates whose optimistic prob clears min threshold
 
 
+def scan_topk(
+    pair_logits: Callable,
+    qfeats,
+    corpus_feats,
+    corpus_valid,
+    corpus_deleted,
+    corpus_group,
+    query_group,
+    query_row,
+    min_logit,
+    *,
+    chunk: int,
+    top_k: int,
+    group_filtering: bool,
+    row_offset=0,
+):
+    """The blockwise scan core: scores Q queries against a (local) corpus.
+
+    ``row_offset`` maps local corpus rows to global row ids — 0 on a single
+    device; ``shard_index * shard_capacity`` inside ``shard_map`` (see
+    parallel.sharded), so self-exclusion via ``query_row`` and the returned
+    ``top_index`` stay global.  Traced (non-static) offsets are fine.
+    """
+    first = next(iter(qfeats.values()))
+    q = first["valid"].shape[0]
+    cap = corpus_valid.shape[0]
+    nchunks = cap // chunk
+
+    init_logit = jnp.full((q, top_k), NEG_INF, jnp.float32)
+    init_index = jnp.full((q, top_k), -1, jnp.int32)
+    init_count = jnp.zeros((q,), jnp.int32)
+
+    def body(carry, ci):
+        top_logit, top_index, count = carry
+        start = ci * chunk
+        cf = jax.tree_util.tree_map(
+            lambda a: lax.dynamic_slice_in_dim(a, start, chunk, axis=0),
+            corpus_feats,
+        )
+        logits = pair_logits(qfeats, cf)  # (Q, chunk)
+
+        cvalid = lax.dynamic_slice_in_dim(corpus_valid, start, chunk)
+        cdel = lax.dynamic_slice_in_dim(corpus_deleted, start, chunk)
+        cgroup = lax.dynamic_slice_in_dim(corpus_group, start, chunk)
+        cidx = row_offset + start + jnp.arange(chunk, dtype=jnp.int32)
+
+        mask = cvalid & ~cdel
+        if group_filtering:
+            mask = mask & (cgroup[None, :] != query_group[:, None])
+        mask = mask & (cidx[None, :] != query_row[:, None])
+        logits = jnp.where(mask, logits, NEG_INF)
+
+        count = count + (logits > min_logit).sum(axis=1).astype(jnp.int32)
+
+        merged_logit = jnp.concatenate([top_logit, logits], axis=1)
+        merged_index = jnp.concatenate(
+            [top_index, jnp.broadcast_to(cidx[None, :], (q, chunk))], axis=1
+        )
+        top_logit, sel = lax.top_k(merged_logit, top_k)
+        top_index = jnp.take_along_axis(merged_index, sel, axis=1)
+        return (top_logit, top_index, count), None
+
+    (top_logit, top_index, count), _ = lax.scan(
+        body, (init_logit, init_index, init_count),
+        jnp.arange(nchunks, dtype=jnp.int32),
+    )
+    return top_logit, top_index, count
+
+
 def build_corpus_scorer(
     plan: F.SchemaFeatures,
     *,
@@ -222,50 +291,11 @@ def build_corpus_scorer(
     @partial(jax.jit, static_argnames=())
     def score(qfeats, corpus_feats, corpus_valid, corpus_deleted, corpus_group,
               query_group, query_row, min_logit):
-        first = next(iter(qfeats.values()))
-        q = first["valid"].shape[0]
-        cap = corpus_valid.shape[0]
-        nchunks = cap // chunk
-
-        init_logit = jnp.full((q, top_k), NEG_INF, jnp.float32)
-        init_index = jnp.full((q, top_k), -1, jnp.int32)
-        init_count = jnp.zeros((q,), jnp.int32)
-
-        def body(carry, ci):
-            top_logit, top_index, count = carry
-            start = ci * chunk
-            cf = jax.tree_util.tree_map(
-                lambda a: lax.dynamic_slice_in_dim(a, start, chunk, axis=0),
-                corpus_feats,
-            )
-            logits = pair_logits(qfeats, cf)  # (Q, chunk)
-
-            cvalid = lax.dynamic_slice_in_dim(corpus_valid, start, chunk)
-            cdel = lax.dynamic_slice_in_dim(corpus_deleted, start, chunk)
-            cgroup = lax.dynamic_slice_in_dim(corpus_group, start, chunk)
-            cidx = start + jnp.arange(chunk, dtype=jnp.int32)
-
-            mask = cvalid & ~cdel
-            if group_filtering:
-                mask = mask & (cgroup[None, :] != query_group[:, None])
-            mask = mask & (cidx[None, :] != query_row[:, None])
-            logits = jnp.where(mask, logits, NEG_INF)
-
-            count = count + (logits > min_logit).sum(axis=1).astype(jnp.int32)
-
-            merged_logit = jnp.concatenate([top_logit, logits], axis=1)
-            merged_index = jnp.concatenate(
-                [top_index, jnp.broadcast_to(cidx[None, :], (q, chunk))], axis=1
-            )
-            top_logit, sel = lax.top_k(merged_logit, top_k)
-            top_index = jnp.take_along_axis(merged_index, sel, axis=1)
-            return (top_logit, top_index, count), None
-
-        (top_logit, top_index, count), _ = lax.scan(
-            body, (init_logit, init_index, init_count),
-            jnp.arange(nchunks, dtype=jnp.int32),
+        return scan_topk(
+            pair_logits, qfeats, corpus_feats, corpus_valid, corpus_deleted,
+            corpus_group, query_group, query_row, min_logit,
+            chunk=chunk, top_k=top_k, group_filtering=group_filtering,
         )
-        return top_logit, top_index, count
 
     return score
 

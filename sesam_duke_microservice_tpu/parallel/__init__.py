@@ -1,0 +1,14 @@
+"""Mesh-parallel scale-out: sharded corpus scoring over ICI collectives.
+
+The reference has no distributed backend of any kind (SURVEY.md section 2
+component #16 — one JVM, one thread pool).  This package is its TPU-native
+replacement: the corpus feature tensors are sharded across a
+``jax.sharding.Mesh``, every device scores the replicated query block
+against its local shard keeping a local top-K, and one ``all_gather`` over
+the mesh axis merges the per-shard top-Ks into the global result — the
+ring-structured candidate merge sketched in SURVEY.md section 5.7.
+"""
+
+from .sharded import ShardedCorpus, build_sharded_scorer, corpus_mesh
+
+__all__ = ["ShardedCorpus", "build_sharded_scorer", "corpus_mesh"]

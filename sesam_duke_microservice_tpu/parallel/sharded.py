@@ -1,0 +1,158 @@
+"""Sharded corpus scoring: shard_map over a device mesh.
+
+Data layout (the scaling-book recipe — pick a mesh, annotate shardings, let
+XLA insert collectives):
+
+  * corpus feature tensors: sharded along the record axis over mesh axis
+    ``"shard"`` — each device holds ``capacity / n_devices`` rows in HBM;
+  * query block: replicated — every device scores the same queries against
+    its local rows (no query-side communication at all);
+  * merge: each device's local top-K is ``all_gather``ed over ICI
+    ((D, Q, K) — K is tiny, so the collective moves Q*K*D*8 bytes, not the
+    candidate matrix) and reduced to the global top-K on every device.
+
+This scales the O(Q x N) pair-scoring work linearly in device count while
+the communication stays O(Q x K x D): the framework's counterpart of
+ring-attention-style sequence parallelism for the corpus axis (SURVEY.md
+section 5.7 — "sharded candidate retrieval").
+
+The reference's single-JVM design has no equivalent (SURVEY.md section 2
+rows 16-17); parity obligations stop at "same results as one device", which
+``tests/test_parallel.py`` checks on a virtual 8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import scoring as S
+
+SHARD_AXIS = "shard"
+
+
+def corpus_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """A 1-D mesh over all (or the given) devices; the single sharding axis
+    carries the corpus record dimension."""
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (SHARD_AXIS,))
+
+
+def build_sharded_scorer(
+    plan,
+    mesh: Mesh,
+    *,
+    chunk: int = 512,
+    top_k: int = 64,
+    group_filtering: bool = False,
+) -> Callable:
+    """Like ``ops.scoring.build_corpus_scorer`` but over a sharded corpus.
+
+    Input contract matches the single-device scorer, except the ``corpus_*``
+    arrays must have their leading (record) axis divisible by
+    ``mesh.size * chunk`` and be placed with ``ShardedCorpus`` (record-axis
+    sharded).  Row indices in ``top_index`` and ``query_row`` are global.
+    """
+    pair_logits = S.build_pair_logits(plan)
+    ndev = mesh.size
+
+    corpus_spec = P(SHARD_AXIS)
+    repl = P()
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(repl, corpus_spec, corpus_spec, corpus_spec, corpus_spec,
+                  repl, repl, repl),
+        out_specs=(repl, repl, repl),
+        # the scan carry starts from replicated zeros but becomes
+        # shard-varying once per-shard corpus data folds in; skip the
+        # varying-manual-axes typecheck rather than pcast every init
+        check_vma=False,
+    )
+    def score_shard(qfeats, corpus_feats, corpus_valid, corpus_deleted,
+                    corpus_group, query_group, query_row, min_logit):
+        local_cap = corpus_valid.shape[0]
+        shard = lax.axis_index(SHARD_AXIS)
+        row_offset = shard.astype(jnp.int32) * jnp.int32(local_cap)
+
+        top_logit, top_index, count = S.scan_topk(
+            pair_logits, qfeats, corpus_feats, corpus_valid, corpus_deleted,
+            corpus_group, query_group, query_row, min_logit,
+            chunk=chunk, top_k=top_k, group_filtering=group_filtering,
+            row_offset=row_offset,
+        )
+
+        # merge: (D, Q, K) gathered over ICI, reduced to global top-K
+        all_logit = lax.all_gather(top_logit, SHARD_AXIS)   # (D, Q, K)
+        all_index = lax.all_gather(top_index, SHARD_AXIS)
+        q = top_logit.shape[0]
+        merged_logit = jnp.transpose(all_logit, (1, 0, 2)).reshape(q, ndev * top_k)
+        merged_index = jnp.transpose(all_index, (1, 0, 2)).reshape(q, ndev * top_k)
+        out_logit, sel = lax.top_k(merged_logit, top_k)
+        out_index = jnp.take_along_axis(merged_index, sel, axis=1)
+        total_count = lax.psum(count, SHARD_AXIS)
+        return out_logit, out_index, total_count
+
+    return jax.jit(score_shard)
+
+
+class ShardedCorpus:
+    """Places host corpus arrays onto the mesh, record-axis sharded.
+
+    The capacity is padded up to a multiple of ``mesh.size * chunk`` so
+    every shard gets the same number of whole scan chunks (padding rows are
+    ``valid=False`` and masked out by the scorer).
+    """
+
+    def __init__(self, mesh: Mesh, *, chunk: int = 512):
+        self.mesh = mesh
+        self.chunk = chunk
+        self.granule = mesh.size * chunk
+        self._sharding_cache: Dict[int, NamedSharding] = {}
+
+    def padded_capacity(self, size: int) -> int:
+        g = self.granule
+        return max(g, ((size + g - 1) // g) * g)
+
+    def _sharding(self, ndim: int) -> NamedSharding:
+        if ndim not in self._sharding_cache:
+            spec = P(SHARD_AXIS, *([None] * (ndim - 1)))
+            self._sharding_cache[ndim] = NamedSharding(self.mesh, spec)
+        return self._sharding_cache[ndim]
+
+    def place(self, feats: Dict[str, Dict[str, np.ndarray]],
+              row_valid: np.ndarray, row_deleted: np.ndarray,
+              row_group: np.ndarray):
+        """Pad to the shard granule and device_put with record-axis sharding.
+
+        Returns (feats, valid, deleted, group) as sharded device arrays.
+        """
+        size = row_valid.shape[0]
+        cap = self.padded_capacity(size)
+
+        def pad(arr: np.ndarray, fill=0) -> np.ndarray:
+            if arr.shape[0] == cap:
+                return arr
+            out = np.full((cap,) + arr.shape[1:], fill, dtype=arr.dtype)
+            out[:size] = arr[:size]
+            return out
+
+        dev_feats = {
+            prop: {
+                name: jax.device_put(pad(arr), self._sharding(arr.ndim))
+                for name, arr in tensors.items()
+            }
+            for prop, tensors in feats.items()
+        }
+        valid = jax.device_put(pad(row_valid, False), self._sharding(1))
+        deleted = jax.device_put(pad(row_deleted, False), self._sharding(1))
+        group = jax.device_put(pad(row_group, -1), self._sharding(1))
+        return dev_feats, valid, deleted, group

@@ -9,7 +9,16 @@ must happen before jax initializes, hence the env mutation at import time.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Small device-matcher shapes: the CPU backend executes the brute-force
+# scorer orders of magnitude slower than a TPU; production defaults
+# (chunk=512, buckets up to 256) are sized for the MXU/VPU.
+os.environ.setdefault("DEVICE_CHUNK", "64")
+os.environ.setdefault("DEVICE_QUERY_BUCKETS", "8,32")
+os.environ.setdefault("DEVICE_TOP_K", "16")
+os.environ.setdefault("DEVICE_MAX_CHARS", "24")
+os.environ.setdefault("DEVICE_MAX_GRAMS", "24")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,3 +26,11 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The axon sitecustomize hook imports jax at interpreter startup (before
+# this conftest runs), so the JAX_PLATFORMS env mutation above is too late
+# for jax's config read.  The backend itself initializes lazily — forcing
+# the platform via config still works as long as no computation has run.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

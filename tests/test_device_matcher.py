@@ -1,0 +1,339 @@
+"""Differential tests: DeviceProcessor vs the host Processor oracle.
+
+The device path must produce the same matches/matches_perhaps/no_match_for
+event stream as the host engine (SURVEY.md section 7 hard part 4 — exact
+semantic parity), modulo candidate *retrieval*: the host InvertedIndex can
+miss candidates (Lucene-parity recall), the device path is exact brute
+force.  So the oracle here is the host Processor run over a brute-force
+index that returns everything — same scoring semantics, total recall.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from sesam_duke_microservice_tpu.core import comparators as C
+from sesam_duke_microservice_tpu.core.config import DukeSchema, MatchTunables
+from sesam_duke_microservice_tpu.core.records import (
+    DELETED_PROPERTY_NAME,
+    GROUP_NO_PROPERTY_NAME,
+    ID_PROPERTY_NAME,
+    Lookup,
+    Property,
+    Record,
+)
+from sesam_duke_microservice_tpu.engine.device_matcher import (
+    DeviceIndex,
+    DeviceProcessor,
+)
+from sesam_duke_microservice_tpu.engine.listeners import MatchListener
+from sesam_duke_microservice_tpu.engine.processor import Processor
+from sesam_duke_microservice_tpu.index.base import CandidateIndex
+
+
+class BruteForceIndex(CandidateIndex):
+    """Total-recall host index: every live record is a candidate."""
+
+    def __init__(self):
+        self.records = {}
+        self.indexing_disabled = False
+
+    def index(self, record):
+        if not self.indexing_disabled:
+            self.records[record.record_id] = record
+
+    def commit(self):
+        pass
+
+    def find_record_by_id(self, record_id):
+        return self.records.get(record_id)
+
+    def find_candidate_matches(self, record, group_filtering=False):
+        group = record.get_value(GROUP_NO_PROPERTY_NAME)
+        out = []
+        for r in self.records.values():
+            if r.get_value(DELETED_PROPERTY_NAME) == "true":
+                continue
+            if group_filtering and r.get_value(GROUP_NO_PROPERTY_NAME) == group:
+                continue
+            out.append(r)
+        return out
+
+    def delete(self, record):
+        self.records.pop(record.record_id, None)
+
+    def set_indexing_disabled(self, disabled):
+        self.indexing_disabled = disabled
+
+
+class EventLog(MatchListener):
+    def __init__(self):
+        self.events = []
+
+    def matches(self, r1, r2, confidence):
+        self.events.append(("match", r1.record_id, r2.record_id, round(confidence, 5)))
+
+    def matches_perhaps(self, r1, r2, confidence):
+        self.events.append(("maybe", r1.record_id, r2.record_id, round(confidence, 5)))
+
+    def no_match_for(self, record):
+        self.events.append(("none", record.record_id))
+
+    def match_set(self):
+        return {e for e in self.events if e[0] != "none"}
+
+    def none_set(self):
+        return {e for e in self.events if e[0] == "none"}
+
+
+def make_record(rid, group=None, **props):
+    r = Record()
+    r.add_value(ID_PROPERTY_NAME, rid)
+    if group is not None:
+        r.add_value(GROUP_NO_PROPERTY_NAME, str(group))
+    for k, v in props.items():
+        if isinstance(v, list):
+            for item in v:
+                r.add_value(k, item)
+        else:
+            r.add_value(k, v)
+    return r
+
+
+NAMES = [
+    "acme corp", "acme corporation", "globex", "globex inc", "initech",
+    "initech llc", "umbrella", "umbrela", "stark industries", "stark ind",
+    "wayne enterprises", "wayne ent", "hooli", "hooli xyz", "pied piper",
+]
+CITIES = ["oslo", "bergen", "trondheim", "stavanger", "tromso"]
+
+
+def random_records(n, seed, with_group=False):
+    rng = random.Random(seed)
+    records = []
+    for i in range(n):
+        base = rng.choice(NAMES)
+        # perturb to create near-duplicates at a known rate
+        if rng.random() < 0.4:
+            pos = rng.randrange(len(base))
+            base = base[:pos] + rng.choice("abcdefgh") + base[pos + 1:]
+        rec = make_record(
+            f"r{i}",
+            group=(1 + i % 2) if with_group else None,
+            name=base,
+            city=rng.choice(CITIES),
+            amount=str(rng.choice([100, 200, 200, 300, 1000])),
+        )
+        records.append(rec)
+    return records
+
+
+def dedup_schema(threshold=0.8, maybe=None):
+    numeric = C.Numeric()
+    numeric.min_ratio = 0.5
+    return DukeSchema(
+        threshold=threshold,
+        maybe_threshold=maybe,
+        properties=[
+            Property(ID_PROPERTY_NAME, id_property=True),
+            Property("name", C.Levenshtein(), 0.3, 0.9),
+            Property("city", C.Exact(), 0.4, 0.8),
+            Property("amount", numeric, 0.4, 0.7),
+        ],
+        data_sources=[],
+    )
+
+
+def run_host(schema, batches, group_filtering=False):
+    index = BruteForceIndex()
+    proc = Processor(schema, index, group_filtering=group_filtering)
+    log = EventLog()
+    proc.add_match_listener(log)
+    for batch in batches:
+        proc.deduplicate(batch)
+    return log
+
+
+def run_device(schema, batches, group_filtering=False):
+    index = DeviceIndex(schema, tunables=MatchTunables())
+    proc = DeviceProcessor(schema, index, group_filtering=group_filtering)
+    log = EventLog()
+    proc.add_match_listener(log)
+    for batch in batches:
+        proc.deduplicate(batch)
+    return log, index, proc
+
+
+class TestDeviceVsHostParity:
+    def test_small_batch_exact_events(self):
+        schema = dedup_schema()
+        records = random_records(40, seed=7)
+        host = run_host(schema, [records])
+        device, _, _ = run_device(schema, [records])
+        assert device.match_set() == host.match_set()
+        assert device.none_set() == host.none_set()
+
+    def test_multi_batch_incremental(self):
+        schema = dedup_schema()
+        b1 = random_records(30, seed=1)
+        b2 = random_records(25, seed=2)
+        # distinct ids for the second batch
+        for i, r in enumerate(b2):
+            r._values[ID_PROPERTY_NAME] = [f"s{i}"]
+        host = run_host(schema, [b1, b2])
+        device, _, _ = run_device(schema, [b1, b2])
+        assert device.match_set() == host.match_set()
+
+    def test_maybe_threshold_events(self):
+        schema = dedup_schema(threshold=0.92, maybe=0.6)
+        records = random_records(35, seed=3)
+        host = run_host(schema, [records])
+        device, _, _ = run_device(schema, [records])
+        assert device.match_set() == host.match_set()
+
+    def test_group_filtering_record_linkage(self):
+        schema = dedup_schema()
+        records = random_records(40, seed=11, with_group=True)
+        host = run_host(schema, [records], group_filtering=True)
+        device, _, _ = run_device(schema, [records], group_filtering=True)
+        assert device.match_set() == host.match_set()
+
+    def test_missing_group_raises_under_group_filtering(self):
+        # host-engine parity: InvertedIndex raises when a record lacks
+        # dukeGroupNo in record-linkage mode; the device path must match
+        schema = dedup_schema()
+        with_group = make_record("a", group=1, name="acme", city="oslo",
+                                 amount="1")
+        without_group = make_record("b", name="acme", city="oslo", amount="1")
+        index = DeviceIndex(schema)
+        proc = DeviceProcessor(schema, index, group_filtering=True)
+        proc.add_match_listener(EventLog())
+        proc.deduplicate([with_group])
+        with pytest.raises(ValueError, match="dukeGroupNo"):
+            proc.deduplicate([without_group])
+
+    def test_reindex_same_id_replaces(self):
+        schema = dedup_schema()
+        r1 = make_record("a", name="acme corp", city="oslo", amount="100")
+        r2 = make_record("b", name="acme corp", city="oslo", amount="100")
+        updated = make_record("a", name="zzzz totally different", city="tromso",
+                              amount="9999")
+        device, index, proc = run_device(schema, [[r1, r2]])
+        assert {(e[1], e[2]) for e in device.match_set()} == {("a", "b"), ("b", "a")}
+        # update record a: must stop matching b
+        log2 = EventLog()
+        proc.listeners = [log2]
+        proc.deduplicate([updated])
+        assert all(e[0] == "none" for e in log2.events)
+        # corpus has a tombstoned row
+        assert index.corpus.row_valid.sum() == 2
+
+    def test_deleted_records_excluded(self):
+        schema = dedup_schema()
+        r1 = make_record("a", name="acme corp", city="oslo", amount="100")
+        r2 = make_record("b", name="acme corp", city="oslo", amount="100")
+        dead = make_record("a", name="acme corp", city="oslo", amount="100")
+        dead.add_value(DELETED_PROPERTY_NAME, "true")
+        device, index, proc = run_device(schema, [[r1, r2]])
+        assert len(device.match_set()) > 0
+        # workload flow (engine.workload.process_batch): deleted records are
+        # tombstoned via index+commit, never passed through deduplicate()
+        index.index(dead)
+        index.commit()
+        # the deleted record stays resolvable by id (GET feed point lookups)
+        assert index.find_record_by_id("a") is not None
+        # and is excluded as a candidate for future queries
+        log3 = EventLog()
+        proc.listeners = [log3]
+        proc.deduplicate([make_record("c", name="acme corp", city="oslo",
+                                      amount="100")])
+        matched = {e[2] for e in log3.match_set()}
+        assert "a" not in matched
+        assert "b" in matched
+
+    def test_k_escalation_many_duplicates(self):
+        # 100 identical records: every query has 99 candidates above the
+        # bound, forcing K-escalation past the initial 64
+        schema = dedup_schema()
+        records = [
+            make_record(f"r{i}", name="acme corp", city="oslo", amount="100")
+            for i in range(100)
+        ]
+        host = run_host(schema, [records])
+        device, _, _ = run_device(schema, [records])
+        assert device.match_set() == host.match_set()
+        assert len(device.match_set()) == 100 * 99
+
+    def test_multi_value_records(self):
+        # device plan v=1 truncates value lists; use v=2 to hold both values
+        schema = dedup_schema()
+        records = [
+            make_record("a", name=["acme corp", "acme inc"], city="oslo",
+                        amount="100"),
+            make_record("b", name="acme inc", city="oslo", amount="100"),
+            make_record("c", name="nothing alike", city="bergen", amount="777"),
+        ]
+        host = run_host(schema, [records])
+        index = DeviceIndex(schema, values_per_record=2)
+        proc = DeviceProcessor(schema, index)
+        log = EventLog()
+        proc.add_match_listener(log)
+        proc.deduplicate(records)
+        assert log.match_set() == host.match_set()
+
+    def test_host_only_comparator_hybrid(self):
+        # PersonNameComparator has no device kernel -> host-prop hybrid path
+        class Weird:
+            def compare(self, v1, v2):
+                return 1.0 if v1[::-1] == v2 else 0.0
+
+        schema = DukeSchema(
+            threshold=0.75,
+            maybe_threshold=None,
+            properties=[
+                Property(ID_PROPERTY_NAME, id_property=True),
+                Property("name", C.Levenshtein(), 0.3, 0.9),
+                Property("code", Weird(), 0.2, 0.8),
+            ],
+            data_sources=[],
+        )
+        records = [
+            make_record("a", name="acme corp", code="abc"),
+            make_record("b", name="acme corp", code="cba"),
+            make_record("c", name="acme corp", code="xyz"),
+            make_record("d", name="other thing", code="zyx"),
+        ]
+        host = run_host(schema, [records])
+        device, index, _ = run_device(schema, [records])
+        assert len(index.plan.host_props) == 1
+        assert device.match_set() == host.match_set()
+        assert device.none_set() == host.none_set()
+
+    def test_find_candidate_matches_interface(self):
+        schema = dedup_schema()
+        records = random_records(20, seed=5)
+        _, index, _ = run_device(schema, [records])
+        probe = make_record("probe", name=records[0].get_value("name"),
+                            city=records[0].get_value("city"),
+                            amount=records[0].get_value("amount"))
+        cands = index.find_candidate_matches(probe)
+        assert records[0].record_id in {c.record_id for c in cands}
+
+
+class TestDeviceCorpus:
+    def test_capacity_doubles_and_preserves(self):
+        schema = dedup_schema()
+        index = DeviceIndex(schema)
+        proc = DeviceProcessor(schema, index)
+        proc.add_match_listener(EventLog())
+        for start in range(0, 600, 200):
+            batch = [
+                make_record(f"n{i}", name=f"name {i}", city="oslo", amount="1")
+                for i in range(start, start + 200)
+            ]
+            proc.deduplicate(batch)
+        assert index.corpus.size == 600
+        assert index.corpus.capacity >= 600
+        assert index.corpus.capacity % 512 == 0
+        assert index.corpus.row_valid[:600].all()
