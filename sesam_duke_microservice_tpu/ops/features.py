@@ -28,9 +28,12 @@ from ..core.records import Record
 # truncated — the only intended divergence from the host oracle, documented
 # in tests/test_ops.py).  Env-tunable: the CPU test backend uses smaller
 # shapes (tests/conftest.py) since it executes the kernels without an MXU.
+# MAX_CHARS defaults to 32 so edit distance rides the Myers bit-parallel
+# kernel (one uint32 word per pattern, ~100x the scan-DP throughput);
+# DEVICE_MAX_CHARS=64 restores 64-char fidelity via the general DP.
 import os as _os
 
-MAX_CHARS = int(_os.environ.get("DEVICE_MAX_CHARS", "64"))
+MAX_CHARS = int(_os.environ.get("DEVICE_MAX_CHARS", "32"))
 MAX_GRAMS = int(_os.environ.get("DEVICE_MAX_GRAMS", "64"))
 MAX_TOKENS = int(_os.environ.get("DEVICE_MAX_TOKENS", "16"))
 

@@ -1,0 +1,14 @@
+"""Durable host-side record store.
+
+The framework's source of truth for indexed records (SURVEY.md section 7
+"State"): the reference's durable state is its on-disk Lucene index
+(IncrementalLuceneDatabase.java:233-244, opened in APPEND mode so a
+restarted container resumes where it left off).  Here durability is split
+TPU-natively: records persist in a host SQLite store; the blocking index
+(host inverted index or device-resident corpus) is a rebuildable cache
+replayed from the store at startup.
+"""
+
+from .records import InMemoryRecordStore, RecordStore, SqliteRecordStore
+
+__all__ = ["InMemoryRecordStore", "RecordStore", "SqliteRecordStore"]

@@ -244,6 +244,37 @@ def test_levenshtein_distance_exact():
     np.testing.assert_array_equal(dist, want)
 
 
+def test_levenshtein_myers_exact():
+    # the bit-parallel kernel must agree with the oracle for every pair,
+    # including boundary lengths at the full 32-bit word
+    import jax.numpy as jnp
+
+    pairs = [(a[:32], b[:32]) for a, b in make_pairs(300)]
+    pairs += [
+        ("a" * 32, "a" * 31 + "b"),
+        ("a" * 32, "a" * 32),
+        ("a" * 31, "b" * 32),
+        ("a", "b" * 32),
+        ("ab" * 16, "ba" * 16),
+    ]
+    n = len(pairs)
+    c1 = np.zeros((n, 32), np.int32)
+    c2 = np.zeros((n, 32), np.int32)
+    l1 = np.zeros((n,), np.int32)
+    l2 = np.zeros((n,), np.int32)
+    for i, (a, b) in enumerate(pairs):
+        l1[i], l2[i] = len(a), len(b)
+        c1[i, : len(a)] = [ord(ch) for ch in a]
+        c2[i, : len(b)] = [ord(ch) for ch in b]
+    dist = np.asarray(
+        pw.levenshtein_distance_myers(
+            jnp.asarray(c1), jnp.asarray(l1), jnp.asarray(c2), jnp.asarray(l2)
+        )
+    )
+    want = np.array([C.levenshtein_distance(a, b) for a, b in pairs])
+    np.testing.assert_array_equal(dist, want)
+
+
 # -- the assembled scoring program ------------------------------------------
 
 
