@@ -37,6 +37,7 @@ from ..index.inverted import InvertedIndex
 from ..links import create_link_database
 from ..links.base import LinkDatabase, LinkStatus
 from ..service.datasource import IncrementalDataSource
+from ..store.records import RecordStore
 from .listeners import ServiceMatchListener
 from .processor import Processor
 
@@ -44,7 +45,8 @@ from .processor import Processor
 class Workload:
     def __init__(self, config: WorkloadConfig, index: CandidateIndex,
                  processor: Processor, listener: ServiceMatchListener,
-                 link_database: LinkDatabase):
+                 link_database: LinkDatabase,
+                 record_store: Optional[RecordStore] = None):
         self.config = config
         self.name = config.name
         self.kind = config.kind
@@ -52,7 +54,11 @@ class Workload:
         self.processor = processor
         self.listener = listener
         self.link_database = link_database
+        self.record_store = record_store
         self.lock = threading.Lock()
+        # set under self.lock when a config reload replaces this workload;
+        # handlers that resolved a stale reference re-check after locking
+        self.closed = False
         self.datasources: Dict[str, IncrementalDataSource] = {
             ds.dataset_id: IncrementalDataSource(ds)
             for ds in config.duke.data_sources
@@ -74,6 +80,10 @@ class Workload:
                 self.index.set_indexing_disabled(True)
                 self.listener.set_link_database_updates_disabled(True)
             else:
+                if self.record_store is not None:
+                    # durable source of truth first; the blocking index is a
+                    # replayable cache of this store (SURVEY.md section 7)
+                    self.record_store.put_many(records)
                 for record in deleted:
                     # tombstone in the index (still resolvable by the GET
                     # feed's point lookups), then retract its links
@@ -128,8 +138,11 @@ class Workload:
     def close(self) -> None:
         """Release index/link-db resources (the reference leaks these on hot
         reload — SURVEY.md quirk Q7; fixed by calling this on config swap)."""
+        self.closed = True
         self.index.close()
         self.link_database.close()
+        if self.record_store is not None:
+            self.record_store.close()
 
 
 def build_workload(wc: WorkloadConfig, sc: ServiceConfig, *,
@@ -166,4 +179,23 @@ def build_workload(wc: WorkloadConfig, sc: ServiceConfig, *,
     )
     listener = ServiceMatchListener(wc.name, link_database, kind=wc.kind)
     processor.add_match_listener(listener)
-    return Workload(wc, index, processor, listener, link_database)
+
+    record_store: Optional[RecordStore] = None
+    if persistent and wc.data_folder:
+        import os
+
+        from ..store.records import SqliteRecordStore
+
+        record_store = SqliteRecordStore(
+            os.path.join(wc.data_folder, "records.sqlite")
+        )
+        # resume: rebuild the blocking index from the durable store (the
+        # reference resumes by reopening its Lucene dir in APPEND mode —
+        # IncrementalLuceneDatabase.java:233-244)
+        replayed = 0
+        for record in record_store.all_records():
+            index.index(record)
+            replayed += 1
+        if replayed:
+            index.commit()
+    return Workload(wc, index, processor, listener, link_database, record_store)

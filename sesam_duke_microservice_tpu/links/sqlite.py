@@ -9,11 +9,10 @@ Safe for multi-threaded use (one connection per thread).
 
 from __future__ import annotations
 
-import os
 import sqlite3
-import threading
 from typing import List
 
+from ..utils.sqlite import SqliteConnectionPool
 from .base import Link, LinkDatabase, LinkKind, LinkStatus, is_same_assertion
 
 _SCHEMA = """
@@ -34,19 +33,12 @@ CREATE INDEX IF NOT EXISTS links_id2 ON links (id2);
 class SqliteLinkDatabase(LinkDatabase):
     def __init__(self, path: str):
         self.path = path
-        if path != ":memory:":
-            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        self._local = threading.local()
+        self._pool = SqliteConnectionPool(path)
         with self._conn() as conn:
             conn.executescript(_SCHEMA)
 
     def _conn(self) -> sqlite3.Connection:
-        conn = getattr(self._local, "conn", None)
-        if conn is None:
-            conn = sqlite3.connect(self.path)
-            conn.execute("PRAGMA journal_mode=WAL")
-            self._local.conn = conn
-        return conn
+        return self._pool.conn()
 
     @staticmethod
     def _row_to_link(row) -> Link:
@@ -96,7 +88,4 @@ class SqliteLinkDatabase(LinkDatabase):
         return [self._row_to_link(r) for r in cur.fetchall()]
 
     def close(self) -> None:
-        conn = getattr(self._local, "conn", None)
-        if conn is not None:
-            conn.close()
-            self._local.conn = None
+        self._pool.close()

@@ -70,28 +70,59 @@ class DukeApp:
         return self.config.config_string if self.config else ""
 
     def apply_config(self, sc: ServiceConfig) -> None:
-        """Build all workloads, then atomically swap (App.java:543-546) and
-        close the replaced ones (quirk Q7 fix)."""
-        new_dedups = {
-            name: build_workload(wc, sc, backend=self.backend,
-                                 persistent=self.persistent)
-            for name, wc in sc.deduplications.items()
-        }
-        new_linkages = {
-            name: build_workload(wc, sc, backend=self.backend,
-                                 persistent=self.persistent)
-            for name, wc in sc.record_linkages.items()
-        }
+        """Quiesce, rebuild, atomically swap (App.java:543-546), close.
+
+        The reference swaps its registries without taking the workload locks
+        (quirk Q9), so an in-flight batch can commit records after the new
+        workloads snapshot their state.  Here every old workload's lock is
+        held while the replacements replay the durable stores, so nothing
+        lands between the replay cursor and the swap; the replaced
+        workloads' resources are then closed (quirk Q7 fix).
+
+        Reload is stop-the-world for its duration (large corpora replay
+        under the locks).  That is the deliberate trade: reload is a rare
+        admin operation and the reference's reload pauses service the same
+        way while offering weaker consistency.
+        """
         with self._swap_lock:
             old = list(self.deduplications.values()) + list(self.record_linkages.values())
-            self.config = sc
-            self.deduplications = new_dedups
-            self.record_linkages = new_linkages
-        for wl in old:
+            for wl in old:
+                wl.lock.acquire()
             try:
-                wl.close()
-            except Exception:
-                logger.exception("Error closing replaced workload")
+                built = []
+                try:
+                    new_dedups = {}
+                    for name, wc in sc.deduplications.items():
+                        new_dedups[name] = build_workload(
+                            wc, sc, backend=self.backend,
+                            persistent=self.persistent)
+                        built.append(new_dedups[name])
+                    new_linkages = {}
+                    for name, wc in sc.record_linkages.items():
+                        new_linkages[name] = build_workload(
+                            wc, sc, backend=self.backend,
+                            persistent=self.persistent)
+                        built.append(new_linkages[name])
+                except Exception:
+                    # failed reload keeps the old config (App.java:543-546);
+                    # release whatever the partial build already opened
+                    for wl in built:
+                        try:
+                            wl.close()
+                        except Exception:
+                            logger.exception("Error closing partially-built workload")
+                    raise
+                self.config = sc
+                self.deduplications = new_dedups
+                self.record_linkages = new_linkages
+                for wl in old:
+                    try:
+                        wl.close()
+                    except Exception:
+                        logger.exception("Error closing replaced workload")
+            finally:
+                for wl in old:
+                    wl.lock.release()
 
     def reload_from_string(self, config_string: str) -> None:
         self.apply_config(parse_config(config_string))
@@ -210,7 +241,6 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
         return kind, workload, dataset_id, transform
 
     def _handle_post_batch(self, m, body: bytes) -> None:
-        kind, workload, dataset_id, transform = self._validate_entity_path(m)
         try:
             payload = json.loads(body.decode("utf-8"))
         except (ValueError, UnicodeDecodeError):
@@ -225,12 +255,20 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
             if not isinstance(entity, dict):
                 raise _HttpError(400, "Batch elements must be JSON objects")
 
-        with workload.lock:
-            try:
-                rows = workload.process_batch(dataset_id, batch, http_transform=transform)
-            except Exception as e:
-                logger.exception("Batch processing failed")
-                raise _HttpError(500, f"Batch processing failed: {e}")
+        while True:
+            # re-resolve until we hold the lock on a live workload: a config
+            # reload can replace the registry entry between lookup and lock
+            kind, workload, dataset_id, transform = self._validate_entity_path(m)
+            with workload.lock:
+                if workload.closed:
+                    continue
+                try:
+                    rows = workload.process_batch(dataset_id, batch,
+                                                  http_transform=transform)
+                except Exception as e:
+                    logger.exception("Batch processing failed")
+                    raise _HttpError(500, f"Batch processing failed: {e}")
+                break
 
         if transform:
             out = rows[0] if single and len(rows) == 1 else rows
@@ -243,13 +281,6 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
         label = "deduplication" if kind == "deduplication" else "recordLinkage"
         if not name:
             raise _HttpError(400, f"The {label}Name cannot be an empty string!")
-        workload = self._workloads(kind).get(name)
-        if workload is None:
-            raise _HttpError(
-                400,
-                f"Unknown {label} '{name}'! (All {label}s must be specified in "
-                f"the configuration)",
-            )
         since = 0
         since_params = query.get("since")
         if since_params and since_params[0]:
@@ -258,12 +289,23 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
             except ValueError:
                 raise _HttpError(400, f"Invalid since value '{since_params[0]}'")
 
-        if not workload.lock.acquire(timeout=READ_LOCK_TIMEOUT_SECONDS):
-            raise _HttpError(503, _BUSY_TEMPLATE.format(kind=label))
-        try:
-            rows = workload.links_since(since)
-        finally:
-            workload.lock.release()
+        while True:
+            workload = self._workloads(kind).get(name)
+            if workload is None:
+                raise _HttpError(
+                    400,
+                    f"Unknown {label} '{name}'! (All {label}s must be specified in "
+                    f"the configuration)",
+                )
+            if not workload.lock.acquire(timeout=READ_LOCK_TIMEOUT_SECONDS):
+                raise _HttpError(503, _BUSY_TEMPLATE.format(kind=label))
+            try:
+                if workload.closed:
+                    continue
+                rows = workload.links_since(since)
+                break
+            finally:
+                workload.lock.release()
         body = "[" + ",\n".join(json.dumps(r) for r in rows) + "]"
         self._reply(200, body.encode("utf-8"))
 

@@ -1,0 +1,144 @@
+"""Durable host-side record store — the framework's source of truth.
+
+The reference's durable record state is its on-disk Lucene index, opened in
+APPEND mode so a restarted container resumes where it left off
+(IncrementalLuceneDatabase.java:233-244; paths wired at App.java:331-341,
+452-462).  The TPU-native split is different (SURVEY.md section 7 "State"):
+records persist here, in a host SQLite table keyed by the synthesized
+``ID`` property; the blocking index — host inverted index or HBM-resident
+device corpus — is a *rebuildable cache* replayed from this store at
+startup.  Re-putting an existing id replaces the previous row, matching
+Lucene's delete-then-readd (IncrementalLuceneDatabase.java:507-517).
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence
+
+from ..core.records import Record
+from ..utils.sqlite import SqliteConnectionPool
+
+
+class RecordStore:
+    """Interface: durable map ``record_id -> Record`` with replay."""
+
+    def put(self, record: Record) -> None:
+        raise NotImplementedError
+
+    def put_many(self, records: Sequence[Record]) -> None:
+        for record in records:
+            self.put(record)
+
+    def get(self, record_id: str) -> Optional[Record]:
+        raise NotImplementedError
+
+    def all_records(self) -> Iterator[Record]:
+        """Iterate every stored record in insertion (rowid) order."""
+        raise NotImplementedError
+
+    def count(self) -> int:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryRecordStore(RecordStore):
+    """Non-durable store; the counterpart of Lucene's RAMDirectory fallback
+    (IncrementalLuceneDatabase.java:218-231, used when no path is set)."""
+
+    def __init__(self):
+        self._records: Dict[str, Record] = {}
+        self._lock = threading.Lock()
+
+    def put(self, record: Record) -> None:
+        rid = record.record_id
+        if rid is None:
+            raise ValueError("record has no ID property")
+        with self._lock:
+            # preserve replay order on replace, like a rowid reinsert
+            self._records.pop(rid, None)
+            self._records[rid] = record
+
+    def get(self, record_id: str) -> Optional[Record]:
+        with self._lock:
+            return self._records.get(record_id)
+
+    def all_records(self) -> Iterator[Record]:
+        with self._lock:
+            snapshot = list(self._records.values())
+        return iter(snapshot)
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+
+class SqliteRecordStore(RecordStore):
+    """SQLite-backed durable store (one row per record, JSON payload).
+
+    WAL mode so the single-writer/concurrent-reader discipline of the
+    service layer (one lock per workload, readers with 1 s timeout —
+    App.java:96,145,718-725) maps cleanly onto SQLite's locking.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._pool = SqliteConnectionPool(path)
+        with self._conn() as conn:
+            conn.execute(
+                "CREATE TABLE IF NOT EXISTS records ("
+                " id TEXT PRIMARY KEY,"
+                " data TEXT NOT NULL)"
+            )
+
+    def _conn(self) -> sqlite3.Connection:
+        return self._pool.conn()
+
+    @staticmethod
+    def _encode(record: Record) -> tuple:
+        rid = record.record_id
+        if rid is None:
+            raise ValueError("record has no ID property")
+        return rid, json.dumps(record.to_dict(), separators=(",", ":"))
+
+    def put(self, record: Record) -> None:
+        self.put_many([record])
+
+    def put_many(self, records: Sequence[Record]) -> None:
+        rows = [self._encode(r) for r in records]
+        conn = self._conn()
+        with conn:
+            # REPLACE deletes-then-inserts under the hood, assigning a fresh
+            # rowid so replay order tracks last write — mirroring Lucene's
+            # delete-then-readd on reindex; one transaction per batch, and
+            # duplicate ids within a batch resolve to the last occurrence
+            conn.executemany(
+                "INSERT OR REPLACE INTO records (id, data) VALUES (?, ?)", rows
+            )
+
+    def get(self, record_id: str) -> Optional[Record]:
+        row = self._conn().execute(
+            "SELECT data FROM records WHERE id = ?", (record_id,)
+        ).fetchone()
+        return self._decode(row[0]) if row else None
+
+    def all_records(self) -> Iterator[Record]:
+        for (data,) in self._conn().execute(
+            "SELECT data FROM records ORDER BY rowid"
+        ):
+            yield self._decode(data)
+
+    def count(self) -> int:
+        return self._conn().execute("SELECT COUNT(*) FROM records").fetchone()[0]
+
+    def close(self) -> None:
+        self._pool.close()
+
+    @staticmethod
+    def _decode(data: str) -> Record:
+        values: Dict[str, List[str]] = json.loads(data)
+        return Record(values)

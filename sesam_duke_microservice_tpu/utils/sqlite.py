@@ -1,0 +1,77 @@
+"""Thread-aware SQLite connection management shared by the durable stores.
+
+Both durable backends (links.sqlite.SqliteLinkDatabase and
+store.records.SqliteRecordStore) serve the HTTP layer's threading model:
+one writer at a time per workload but many reader/writer *threads* over the
+process lifetime (ThreadingHTTPServer spawns one per connection).  SQLite
+connections are cheap but per-thread, so the pool hands out one connection
+per thread and tracks them all, guaranteeing close() releases every handle
+— the reference leaks its Lucene/H2 handles on hot reload (SURVEY.md quirk
+Q7) and this is half of that fix.
+
+``':memory:'`` gets a single shared serialized connection instead (a
+per-thread ``:memory:`` connection would be a *different* empty database
+per thread); the sqlite3 module serializes access when the underlying
+library is built threadsafe, which CPython requires since 3.11.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+from typing import Optional, Tuple
+
+
+class SqliteConnectionPool:
+    def __init__(self, path: str,
+                 pragmas: Tuple[str, ...] = ("journal_mode=WAL",
+                                             "synchronous=NORMAL")):
+        self.path = path
+        self._pragmas = pragmas
+        self._lock = threading.Lock()
+        self._conns: list = []
+        self._closed = False
+        self._local = threading.local()
+        self._shared: Optional[sqlite3.Connection] = None
+        if path == ":memory:":
+            self._shared = sqlite3.connect(path, check_same_thread=False)
+        else:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    def conn(self) -> sqlite3.Connection:
+        if self._closed:
+            raise sqlite3.ProgrammingError(
+                f"connection pool for {self.path!r} is closed"
+            )
+        if self._shared is not None:
+            return self._shared
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            # check_same_thread=False so close() can release every tracked
+            # connection from the reload thread; usage stays per-thread
+            conn = sqlite3.connect(self.path, check_same_thread=False)
+            for pragma in self._pragmas:
+                conn.execute("PRAGMA " + pragma)
+            with self._lock:
+                if self._closed:
+                    conn.close()
+                    raise sqlite3.ProgrammingError(
+                        f"connection pool for {self.path!r} is closed"
+                    )
+                self._conns.append(conn)
+            self._local.conn = conn
+        return conn
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            conns, self._conns = self._conns, []
+        if self._shared is not None:
+            self._shared.close()
+            self._shared = None
+        for conn in conns:
+            try:
+                conn.close()
+            except sqlite3.Error:
+                pass
