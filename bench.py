@@ -86,7 +86,13 @@ def bench_schema():
 
 
 def cpu_baseline_pairs_per_sec(schema, records) -> float:
-    """Exact host pair scoring rate (Duke-style scalar hot loop)."""
+    """Exact host pair scoring rate (Duke-style scalar hot loop).
+
+    The baseline stands in for the reference's per-pair scalar engine, so
+    the native C++ comparator library is pinned OFF here — it belongs to
+    the new framework's side of the comparison, not the baseline's.
+    """
+    from sesam_duke_microservice_tpu.core import comparators as C
     from sesam_duke_microservice_tpu.engine.processor import Processor
 
     proc = Processor(schema, database=None)
@@ -96,11 +102,16 @@ def cpu_baseline_pairs_per_sec(schema, records) -> float:
         (records[rng.randrange(n)], records[rng.randrange(n)])
         for _ in range(CPU_SAMPLE_PAIRS)
     ]
-    t0 = time.perf_counter()
-    acc = 0.0
-    for r1, r2 in pairs:
-        acc += proc.compare(r1, r2)
-    dt = time.perf_counter() - t0
+    saved = C._NATIVE
+    C._NATIVE = None
+    try:
+        t0 = time.perf_counter()
+        acc = 0.0
+        for r1, r2 in pairs:
+            acc += proc.compare(r1, r2)
+        dt = time.perf_counter() - t0
+    finally:
+        C._NATIVE = saved
     assert acc >= 0.0
     return CPU_SAMPLE_PAIRS / dt
 

@@ -25,6 +25,28 @@ import re
 from typing import Dict, List, Optional, Sequence, Type
 
 
+def _native_module():
+    """The C++ comparator library (native/), or None.
+
+    Resolved lazily on first compare so importing this module never pays
+    the compile; pure-Python bodies below stay authoritative as oracles
+    (tests/test_native.py pins native<->Python parity).
+    """
+    global _NATIVE
+    if _NATIVE is _UNRESOLVED:
+        try:
+            from .. import native
+
+            _NATIVE = native if native.available() else None
+        except Exception:  # toolchain/load problems: stay pure-Python
+            _NATIVE = None
+    return _NATIVE
+
+
+_UNRESOLVED = object()
+_NATIVE = _UNRESOLVED
+
+
 class Comparator:
     is_tokenized = True
 
@@ -99,6 +121,9 @@ class Levenshtein(Comparator):
         # property maps to `low` anyway.
         if (longer - shorter) * 2 > shorter:
             return 0.0
+        native = _native_module()
+        if native is not None:
+            return native.lev_sim(v1, v2)
         dist = min(levenshtein_distance(v1, v2, limit=shorter), shorter)
         return 1.0 - (dist / shorter)
 
@@ -146,6 +171,12 @@ class WeightedLevenshtein(Comparator):
         shorter = min(len(v1), len(v2))
         if shorter == 0:
             return 0.0
+        native = _native_module()
+        # native classifies characters by ASCII class only, so non-ASCII
+        # values (where isdigit/isalpha diverge) stay on the Python path
+        if native is not None and v1.isascii() and v2.isascii():
+            return native.weighted_lev(v1, v2, self.digit_weight,
+                                       self.letter_weight, self.other_weight)
         # weighted distance over *unweighted* min length: edits to heavy
         # characters (digits) genuinely cost more similarity
         dist = min(self._distance(v1, v2), float(shorter))
@@ -190,6 +221,10 @@ class JaroWinkler(Comparator):
     def compare(self, v1: str, v2: str) -> float:
         if v1 == v2:
             return 1.0
+        native = _native_module()
+        if native is not None:
+            return native.jaro_winkler(v1, v2, self.prefix_scale,
+                                       self.boost_threshold, self.max_prefix)
         j = _jaro(v1, v2)
         if j < self.boost_threshold:
             return j

@@ -172,30 +172,42 @@ def build_workload(wc: WorkloadConfig, sc: ServiceConfig, *,
             profile=sc.profile,
         )
 
-    link_database = create_link_database(
-        wc.link_database_type,
-        wc.data_folder if persistent else None,
-        is_record_linkage=wc.is_record_linkage,
-    )
-    listener = ServiceMatchListener(wc.name, link_database, kind=wc.kind)
-    processor.add_match_listener(listener)
-
+    link_database = None
     record_store: Optional[RecordStore] = None
-    if persistent and wc.data_folder:
-        import os
-
-        from ..store.records import SqliteRecordStore
-
-        record_store = SqliteRecordStore(
-            os.path.join(wc.data_folder, "records.sqlite")
+    try:
+        link_database = create_link_database(
+            wc.link_database_type,
+            wc.data_folder if persistent else None,
+            is_record_linkage=wc.is_record_linkage,
         )
-        # resume: rebuild the blocking index from the durable store (the
-        # reference resumes by reopening its Lucene dir in APPEND mode —
-        # IncrementalLuceneDatabase.java:233-244)
-        replayed = 0
-        for record in record_store.all_records():
-            index.index(record)
-            replayed += 1
-        if replayed:
-            index.commit()
+        listener = ServiceMatchListener(wc.name, link_database, kind=wc.kind)
+        processor.add_match_listener(listener)
+
+        if persistent and wc.data_folder:
+            import os
+
+            from ..store.records import SqliteRecordStore
+
+            record_store = SqliteRecordStore(
+                os.path.join(wc.data_folder, "records.sqlite")
+            )
+            # resume: rebuild the blocking index from the durable store (the
+            # reference resumes by reopening its Lucene dir in APPEND mode —
+            # IncrementalLuceneDatabase.java:233-244)
+            replayed = 0
+            for record in record_store.all_records():
+                index.index(record)
+                replayed += 1
+            if replayed:
+                index.commit()
+    except BaseException:
+        # a half-built workload never reaches the caller; release whatever
+        # opened so a failing hot reload cannot leak handles (quirk Q7)
+        for resource in (index, link_database, record_store):
+            if resource is not None:
+                try:
+                    resource.close()
+                except Exception:
+                    pass
+        raise
     return Workload(wc, index, processor, listener, link_database, record_store)

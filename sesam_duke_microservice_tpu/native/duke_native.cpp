@@ -1,0 +1,274 @@
+// Native host hot path: batched string comparators.
+//
+// The reference's hot loop is per-pair per-property Comparator.compare inside
+// the Duke 1.2 jar (driven at App.java:1005/1159; SURVEY.md section 3.2 "hot
+// loops").  In this framework the TPU scores candidate blocks, but host paths
+// still burn CPU on scalar string comparison — the host reference engine
+// (engine/processor.py) and the device matcher's host-exact finalization both
+// dispatch through core/comparators.py, whose Levenshtein/JaroWinkler/
+// WeightedLevenshtein route here via the SCALAR entry points at the bottom of
+// this file.  The *_batch entry points are the library's bulk API (one call,
+// many pairs — amortizes the FFI boundary ~10x over scalar) for tooling and
+// bulk rescoring; tests/test_native.py pins both shapes to the pure-Python
+// oracles.  Levenshtein is Myers/Hyyro bit-parallel for patterns <= 64
+// codepoints with a plain-DP fallback.
+//
+// Strings cross the boundary as UTF-32 codepoints (uint32) in one contiguous
+// buffer with an int64 offsets array: pair i is a[a_off[i]:a_off[i+1]] vs
+// b[b_off[i]:b_off[i+1]].  Pure C ABI for ctypes.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Levenshtein distance, exact.  Myers bit-parallel O(n) per text char for
+// patterns up to 64 codepoints (Hyyro's formulation); banded-free plain DP
+// rows otherwise.  Both return the exact distance.
+
+int64_t lev_plain(const uint32_t* s1, int64_t n1, const uint32_t* s2,
+                  int64_t n2) {
+    std::vector<int64_t> prev(n2 + 1), cur(n2 + 1);
+    for (int64_t j = 0; j <= n2; ++j) prev[j] = j;
+    for (int64_t i = 1; i <= n1; ++i) {
+        cur[0] = i;
+        const uint32_t c1 = s1[i - 1];
+        for (int64_t j = 1; j <= n2; ++j) {
+            const int64_t cost = (c1 == s2[j - 1]) ? 0 : 1;
+            cur[j] = std::min({prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost});
+        }
+        std::swap(prev, cur);
+    }
+    return prev[n2];
+}
+
+int64_t lev_myers64(const uint32_t* pat, int64_t m, const uint32_t* text,
+                    int64_t n) {
+    // peq: ASCII fast path in a flat table, map for the rest
+    uint64_t peq_ascii[128];
+    std::memset(peq_ascii, 0, sizeof(peq_ascii));
+    std::unordered_map<uint32_t, uint64_t> peq_other;
+    for (int64_t i = 0; i < m; ++i) {
+        const uint32_t c = pat[i];
+        if (c < 128) peq_ascii[c] |= 1ULL << i;
+        else peq_other[c] |= 1ULL << i;
+    }
+    uint64_t pv = ~0ULL, mv = 0;
+    int64_t score = m;
+    const uint64_t high = 1ULL << (m - 1);
+    for (int64_t j = 0; j < n; ++j) {
+        const uint32_t c = text[j];
+        uint64_t eq;
+        if (c < 128) {
+            eq = peq_ascii[c];
+        } else {
+            auto it = peq_other.find(c);
+            eq = (it == peq_other.end()) ? 0 : it->second;
+        }
+        const uint64_t xv = eq | mv;
+        const uint64_t xh = (((eq & pv) + pv) ^ pv) | eq;
+        uint64_t ph = mv | ~(xh | pv);
+        uint64_t mh = pv & xh;
+        if (ph & high) ++score;
+        if (mh & high) --score;
+        ph = (ph << 1) | 1;
+        mh = mh << 1;
+        pv = mh | ~(xv | ph);
+        mv = ph & xv;
+    }
+    return score;
+}
+
+int64_t lev_distance(const uint32_t* s1, int64_t n1, const uint32_t* s2,
+                     int64_t n2) {
+    if (n1 == 0) return n2;
+    if (n2 == 0) return n1;
+    // pattern = shorter string for the bit-parallel path
+    const uint32_t* pat = s1;
+    int64_t m = n1;
+    const uint32_t* text = s2;
+    int64_t n = n2;
+    if (m > n) { std::swap(pat, text); std::swap(m, n); }
+    if (m <= 64) return lev_myers64(pat, m, text, n);
+    return lev_plain(pat, m, text, n);
+}
+
+// Duke Levenshtein similarity semantics (core/comparators.py Levenshtein):
+// equal -> 1; empty shorter -> 0; length-ratio early exit -> 0;
+// sim = 1 - min(dist, shorter)/shorter.
+double lev_sim(const uint32_t* a, int64_t na, const uint32_t* b, int64_t nb) {
+    if (na == nb && std::memcmp(a, b, na * sizeof(uint32_t)) == 0) return 1.0;
+    const int64_t shorter = std::min(na, nb);
+    const int64_t longer = std::max(na, nb);
+    if (shorter == 0) return 0.0;
+    if ((longer - shorter) * 2 > shorter) return 0.0;
+    const int64_t dist = std::min(lev_distance(a, na, b, nb), shorter);
+    return 1.0 - static_cast<double>(dist) / static_cast<double>(shorter);
+}
+
+// ---------------------------------------------------------------------------
+// Jaro-Winkler (core/comparators.py _jaro/JaroWinkler parity).
+
+double jaro(const uint32_t* s1, int64_t n1, const uint32_t* s2, int64_t n2,
+            std::vector<uint8_t>& matched2, std::vector<uint32_t>& m1) {
+    if (n1 == 0 || n2 == 0) return 0.0;
+    const int64_t window = std::max<int64_t>(std::max(n1, n2) / 2 - 1, 0);
+    matched2.assign(n2, 0);
+    m1.clear();
+    int64_t matches = 0;
+    for (int64_t i = 0; i < n1; ++i) {
+        const uint32_t c = s1[i];
+        const int64_t lo = std::max<int64_t>(0, i - window);
+        const int64_t hi = std::min(n2, i + window + 1);
+        for (int64_t j = lo; j < hi; ++j) {
+            if (!matched2[j] && s2[j] == c) {
+                matched2[j] = 1;
+                ++matches;
+                m1.push_back(c);
+                break;
+            }
+        }
+    }
+    if (matches == 0) return 0.0;
+    int64_t transpositions = 0;
+    int64_t k = 0;
+    for (int64_t j = 0; j < n2; ++j) {
+        if (matched2[j]) {
+            if (m1[k] != s2[j]) ++transpositions;
+            ++k;
+        }
+    }
+    transpositions /= 2;
+    const double m = static_cast<double>(matches);
+    return (m / n1 + m / n2 + (m - transpositions) / m) / 3.0;
+}
+
+double jaro_winkler(const uint32_t* a, int64_t na, const uint32_t* b,
+                   int64_t nb, double prefix_scale, double boost_threshold,
+                   int64_t max_prefix, std::vector<uint8_t>& matched2,
+                   std::vector<uint32_t>& m1) {
+    if (na == nb && std::memcmp(a, b, na * sizeof(uint32_t)) == 0) return 1.0;
+    const double j = jaro(a, na, b, nb, matched2, m1);
+    if (j < boost_threshold) return j;
+    int64_t prefix = 0;
+    const int64_t lim = std::min(na, nb);
+    for (int64_t i = 0; i < lim; ++i) {
+        if (a[i] != b[i] || prefix == max_prefix) break;
+        ++prefix;
+    }
+    return j + prefix * prefix_scale * (1.0 - j);
+}
+
+// ---------------------------------------------------------------------------
+// Weighted Levenshtein (core/comparators.py WeightedLevenshtein parity):
+// per-character class weights; substitution costs max(w1, w2).
+
+double wl_weight(uint32_t c, double dw, double lw, double ow) {
+    // ASCII classes only, matching Python str.isdigit/isalpha for the ASCII
+    // range the comparator is used on (id-ish fields); non-ASCII letters are
+    // classed by a conservative alpha check
+    if (c >= '0' && c <= '9') return dw;
+    if ((c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z')) return lw;
+    if (c >= 128) return lw;  // treat non-ASCII as letters (Python isalpha-ish)
+    return ow;
+}
+
+double weighted_lev_sim(const uint32_t* a, int64_t na, const uint32_t* b,
+                       int64_t nb, double dw, double lw, double ow) {
+    if (na == nb && std::memcmp(a, b, na * sizeof(uint32_t)) == 0) return 1.0;
+    const int64_t shorter = std::min(na, nb);
+    if (shorter == 0) return 0.0;
+    std::vector<double> prev(nb + 1), cur(nb + 1);
+    prev[0] = 0.0;
+    for (int64_t j = 1; j <= nb; ++j)
+        prev[j] = prev[j - 1] + wl_weight(b[j - 1], dw, lw, ow);
+    for (int64_t i = 1; i <= na; ++i) {
+        const double w1 = wl_weight(a[i - 1], dw, lw, ow);
+        cur[0] = prev[0] + w1;
+        for (int64_t j = 1; j <= nb; ++j) {
+            const double w2 = wl_weight(b[j - 1], dw, lw, ow);
+            const double sub = (a[i - 1] == b[j - 1]) ? 0.0 : std::max(w1, w2);
+            cur[j] = std::min({prev[j] + w1, cur[j - 1] + w2, prev[j - 1] + sub});
+        }
+        std::swap(prev, cur);
+    }
+    const double dist = std::min(prev[nb], static_cast<double>(shorter));
+    return 1.0 - dist / shorter;
+}
+
+}  // namespace
+
+extern "C" {
+
+void duke_lev_sim_batch(const uint32_t* a_buf, const int64_t* a_off,
+                        const uint32_t* b_buf, const int64_t* b_off,
+                        int64_t n, double* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        out[i] = lev_sim(a_buf + a_off[i], a_off[i + 1] - a_off[i],
+                         b_buf + b_off[i], b_off[i + 1] - b_off[i]);
+    }
+}
+
+void duke_jaro_winkler_batch(const uint32_t* a_buf, const int64_t* a_off,
+                             const uint32_t* b_buf, const int64_t* b_off,
+                             int64_t n, double prefix_scale,
+                             double boost_threshold, int64_t max_prefix,
+                             double* out) {
+    std::vector<uint8_t> matched2;
+    std::vector<uint32_t> m1;
+    for (int64_t i = 0; i < n; ++i) {
+        out[i] = jaro_winkler(a_buf + a_off[i], a_off[i + 1] - a_off[i],
+                              b_buf + b_off[i], b_off[i + 1] - b_off[i],
+                              prefix_scale, boost_threshold, max_prefix,
+                              matched2, m1);
+    }
+}
+
+void duke_weighted_lev_batch(const uint32_t* a_buf, const int64_t* a_off,
+                             const uint32_t* b_buf, const int64_t* b_off,
+                             int64_t n, double digit_weight,
+                             double letter_weight, double other_weight,
+                             double* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        out[i] = weighted_lev_sim(a_buf + a_off[i], a_off[i + 1] - a_off[i],
+                                  b_buf + b_off[i], b_off[i + 1] - b_off[i],
+                                  digit_weight, letter_weight, other_weight);
+    }
+}
+
+int64_t duke_lev_distance(const uint32_t* a, int64_t na, const uint32_t* b,
+                          int64_t nb) {
+    return lev_distance(a, na, b, nb);
+}
+
+// Scalar entry points for the per-pair comparator dispatch: take the raw
+// UTF-32 byte buffers straight from str.encode() so the Python side skips
+// numpy packing (the batch functions amortize that cost; a scalar call
+// cannot).
+
+double duke_lev_sim(const uint32_t* a, int64_t na, const uint32_t* b,
+                    int64_t nb) {
+    return lev_sim(a, na, b, nb);
+}
+
+double duke_jaro_winkler(const uint32_t* a, int64_t na, const uint32_t* b,
+                         int64_t nb, double prefix_scale,
+                         double boost_threshold, int64_t max_prefix) {
+    std::vector<uint8_t> matched2;
+    std::vector<uint32_t> m1;
+    return jaro_winkler(a, na, b, nb, prefix_scale, boost_threshold,
+                        max_prefix, matched2, m1);
+}
+
+double duke_weighted_lev(const uint32_t* a, int64_t na, const uint32_t* b,
+                         int64_t nb, double digit_weight,
+                         double letter_weight, double other_weight) {
+    return weighted_lev_sim(a, na, b, nb, digit_weight, letter_weight,
+                            other_weight);
+}
+
+}  // extern "C"

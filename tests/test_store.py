@@ -61,6 +61,34 @@ def test_sqlite_store_survives_reopen(tmp_path):
     store2.close()
 
 
+def test_connection_pool_prunes_dead_threads(tmp_path):
+    import threading
+
+    from sesam_duke_microservice_tpu.utils.sqlite import SqliteConnectionPool
+
+    pool = SqliteConnectionPool(str(tmp_path / "p.sqlite"))
+    pool.conn()
+
+    def worker():
+        pool.conn()
+
+    for _ in range(8):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    # a fresh thread's acquisition prunes the 8 dead threads' connections,
+    # leaving its own entry plus the main thread's
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert len(pool._conns) == 2
+    pool.close()
+    import pytest as _pytest
+    import sqlite3 as _sqlite3
+    with _pytest.raises(_sqlite3.ProgrammingError):
+        pool.conn()
+
+
 DEDUP_XML = """
 <DukeMicroService dataFolder="{folder}">
   <Deduplication name="people" link-database-type="h2">
