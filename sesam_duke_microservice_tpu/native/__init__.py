@@ -114,7 +114,7 @@ def _pack(strings: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
     chunks = []
     total = 0
     for i, s in enumerate(strings):
-        chunk = s.encode("utf-32-le")
+        chunk = s.encode("utf-32-le", "surrogatepass")
         chunks.append(chunk)
         total += len(chunk) // 4
         offsets[i + 1] = total
@@ -171,15 +171,15 @@ def weighted_lev_batch(a: Sequence[str], b: Sequence[str], *,
 
 def lev_sim(a: str, b: str) -> float:
     lib = _load()
-    return lib.duke_lev_sim(a.encode("utf-32-le"), len(a),
-                            b.encode("utf-32-le"), len(b))
+    return lib.duke_lev_sim(a.encode("utf-32-le", "surrogatepass"), len(a),
+                            b.encode("utf-32-le", "surrogatepass"), len(b))
 
 
 def jaro_winkler(a: str, b: str, prefix_scale: float = 0.1,
                  boost_threshold: float = 0.7, max_prefix: int = 4) -> float:
     lib = _load()
-    return lib.duke_jaro_winkler(a.encode("utf-32-le"), len(a),
-                                 b.encode("utf-32-le"), len(b),
+    return lib.duke_jaro_winkler(a.encode("utf-32-le", "surrogatepass"), len(a),
+                                 b.encode("utf-32-le", "surrogatepass"), len(b),
                                  prefix_scale, boost_threshold, max_prefix)
 
 
@@ -187,15 +187,15 @@ def weighted_lev(a: str, b: str, digit_weight: float = 2.0,
                  letter_weight: float = 1.0,
                  other_weight: float = 1.0) -> float:
     lib = _load()
-    return lib.duke_weighted_lev(a.encode("utf-32-le"), len(a),
-                                 b.encode("utf-32-le"), len(b),
+    return lib.duke_weighted_lev(a.encode("utf-32-le", "surrogatepass"), len(a),
+                                 b.encode("utf-32-le", "surrogatepass"), len(b),
                                  digit_weight, letter_weight, other_weight)
 
 
 def lev_distance(a: str, b: str) -> int:
     lib = _load()
     assert lib is not None
-    abuf = np.frombuffer(a.encode("utf-32-le"), dtype="<u4") if a else np.zeros(1, dtype=np.uint32)
-    bbuf = np.frombuffer(b.encode("utf-32-le"), dtype="<u4") if b else np.zeros(1, dtype=np.uint32)
+    abuf = np.frombuffer(a.encode("utf-32-le", "surrogatepass"), dtype="<u4") if a else np.zeros(1, dtype=np.uint32)
+    bbuf = np.frombuffer(b.encode("utf-32-le", "surrogatepass"), dtype="<u4") if b else np.zeros(1, dtype=np.uint32)
     return int(lib.duke_lev_distance(
         abuf.ctypes.data_as(_U32P), len(a), bbuf.ctypes.data_as(_U32P), len(b)))

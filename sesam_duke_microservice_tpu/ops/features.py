@@ -47,7 +47,9 @@ SET_PAD = np.int32(2**31 - 1)
 
 def fnv1a64(value: str) -> int:
     h = _FNV_OFFSET
-    for b in value.encode("utf-8"):
+    # surrogatepass: json.loads accepts lone surrogates, so record values can
+    # contain them; hashing must be total (cf. native/__init__.py utf-32)
+    for b in value.encode("utf-8", "surrogatepass"):
         h ^= b
         h = (h * _FNV_PRIME) & _MASK64
     return h

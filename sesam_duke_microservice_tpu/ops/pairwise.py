@@ -124,23 +124,33 @@ def levenshtein_distance(c1, l1, c2, l2):
     return result
 
 
+def levenshtein_sim_from_distance(dist, l1, l2, equal):
+    """Duke's distance -> similarity map (core.comparators.Levenshtein).
+
+    Shared by the flat XLA path below and the Pallas tiled path
+    (ops.pallas_kernels) so the two scoring paths cannot desync; operands
+    broadcast, so (P,) and (Q, 1) x (1, C) shapes both work.
+    """
+    shorter = jnp.minimum(l1, l2)
+    longer = jnp.maximum(l1, l2)
+    dist = jnp.minimum(dist, shorter)
+    sim = 1.0 - dist.astype(jnp.float32) / jnp.maximum(shorter, 1).astype(jnp.float32)
+    sim = jnp.where((longer - shorter) * 2 > shorter, 0.0, sim)
+    sim = jnp.where(shorter == 0, 0.0, sim)
+    return jnp.where(equal, 1.0, sim)
+
+
 def levenshtein_sim(c1, l1, c2, l2, equal):
     """Duke Levenshtein similarity (core.comparators.Levenshtein.compare).
 
     ``equal``: (P,) bool — exact string equality (from value hashes), the
     comparators' shared v1==v2 early exit.
     """
-    shorter = jnp.minimum(l1, l2)
-    longer = jnp.maximum(l1, l2)
     if c1.shape[1] <= 32:
         dist = levenshtein_distance_myers(c1, l1, c2, l2)
     else:
         dist = levenshtein_distance(c1, l1, c2, l2)
-    dist = jnp.minimum(dist, shorter)
-    sim = 1.0 - dist.astype(jnp.float32) / jnp.maximum(shorter, 1).astype(jnp.float32)
-    sim = jnp.where((longer - shorter) * 2 > shorter, 0.0, sim)
-    sim = jnp.where(shorter == 0, 0.0, sim)
-    return jnp.where(equal, 1.0, sim)
+    return levenshtein_sim_from_distance(dist, l1, l2, equal)
 
 
 def weighted_levenshtein_sim(

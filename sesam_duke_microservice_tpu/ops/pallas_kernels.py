@@ -1,0 +1,196 @@
+"""Pallas TPU kernels for the pairwise hot ops.
+
+The XLA path (ops.pairwise + ops.scoring._pair_expand) materializes expanded
+``(Q*C, L)`` codepoint operands in HBM for every corpus chunk — O(Q*C*L)
+memory traffic for O(Q*C*L) compute.  The kernels here tile the pair matrix
+flash-attention style instead: a grid over (query-tile x corpus-tile) loads
+``O(T*L)`` characters into VMEM once and computes the full ``(TQ, TC)``
+distance tile on-chip, so HBM traffic drops from O(Q*C*L) to
+O((Q/TQ + C/TC) * T * L) while all O(Q*C*L) bit-parallel work stays in
+VMEM/registers.  This is the "comparators become batched Pallas kernels"
+component of the north-star plan (BASELINE.json) — the reference's scalar
+per-pair ``Comparator.compare`` hot loop (reference App.java:1005 ->
+Duke Processor.compare) becomes one device program.
+
+Kernel inventory:
+
+  * ``myers_distance_tiles`` — batched Levenshtein distance over all
+    query x corpus pairs via Myers/Hyyro bit-parallel DP (pattern <= 32
+    codepoints, one uint32 word per pair).  Differentially tested against
+    ``ops.pairwise.levenshtein_distance_myers`` and the scalar oracle.
+
+Enabling: ``pallas_enabled()`` — env ``DUKE_TPU_PALLAS`` ("1" force on,
+"0" force off); default on only when the active JAX backend is TPU.  On
+non-TPU backends kernels run in interpreter mode (slow, test-only).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on all platforms; guard anyway
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover - non-TPU builds without pltpu
+    pltpu = None
+    _VMEM = None
+
+
+def _backend() -> str:
+    try:
+        return jax.default_backend()
+    except Exception:  # pragma: no cover
+        return "cpu"
+
+
+def pallas_enabled() -> bool:
+    """Should the scoring program route char kernels through Pallas?"""
+    flag = os.environ.get("DUKE_TPU_PALLAS", "").strip().lower()
+    if flag in ("1", "true", "yes", "on"):
+        return True
+    if flag in ("0", "false", "no", "off"):
+        return False
+    return _backend() == "tpu"
+
+
+def _interpret() -> bool:
+    return _backend() != "tpu"
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+# -- Myers bit-parallel Levenshtein, tiled over the pair matrix --------------
+
+
+def _myers_tile_kernel(qc_ref, ql_ref, cct_ref, cl_ref, out_ref, *, L: int):
+    """One (TQ, TC) distance tile.
+
+    qc_ref:  (TQ, L)  query codepoints (pattern), 0-padded
+    ql_ref:  (TQ, 1)  query lengths
+    cct_ref: (L, TC)  corpus codepoints, transposed (text)
+    cl_ref:  (1, TC)  corpus lengths
+    out_ref: (TQ, TC) int32 distances
+    """
+    tq = qc_ref.shape[0]
+    tc = cct_ref.shape[1]
+    qc = qc_ref[...]                      # (TQ, L)
+    ql = ql_ref[...][:, :1]               # (TQ, 1)
+    cl = cl_ref[...][:1, :]               # (1, TC)
+
+    one = jnp.uint32(1)
+    full = jnp.uint32(0xFFFFFFFF)
+    # min/max on int32 (Mosaic lacks unsigned vector min), then cast to
+    # uint32 for the shifts.  bit j of pv0 set iff j < ql (ql <= 32; guard
+    # the undefined <<32).
+    pv0 = jnp.where(
+        ql >= 32, full, (one << jnp.minimum(ql, 31).astype(jnp.uint32)) - one
+    )                                     # (TQ, 1)
+    hibit = one << (jnp.maximum(ql, 1) - 1).astype(jnp.uint32)  # (TQ, 1)
+
+    pv = jnp.broadcast_to(pv0, (tq, tc))
+    mv = jnp.zeros((tq, tc), jnp.uint32)
+    score = jnp.broadcast_to(ql.astype(jnp.int32), (tq, tc))
+
+    def step(i, carry):
+        pv, mv, score = carry
+        t = cct_ref[pl.ds(i, 1), :]                        # (1, TC)
+        eq = jnp.zeros((tq, tc), jnp.uint32)
+        for j in range(L):  # static unroll: disjoint bits, pure VPU work
+            eq = eq | jnp.where(qc[:, j : j + 1] == t, jnp.uint32(1 << j), 0)
+        xv = eq | mv
+        xh = (((eq & pv) + pv) ^ pv) | eq
+        ph = mv | ~(xh | pv)
+        mh = pv & xh
+        active = i < cl                                    # (1, TC)
+        score = score + jnp.where(active & ((ph & hibit) != 0), 1, 0)
+        score = score - jnp.where(active & ((mh & hibit) != 0), 1, 0)
+        ph = (ph << one) | one
+        mh = mh << one
+        pv = jnp.where(active, mh | ~(xv | ph), pv)
+        mv = jnp.where(active, ph & xv, mv)
+        return (pv, mv, score)
+
+    pv, mv, score = lax.fori_loop(0, L, step, (pv, mv, score))
+    # empty pattern: distance is the text length
+    out_ref[...] = jnp.where(
+        ql == 0, jnp.broadcast_to(cl.astype(jnp.int32), (tq, tc)), score
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tile_q", "tile_c", "interpret")
+)
+def _myers_tiles_padded(qc, ql2, cct, cl2, *, tile_q, tile_c, interpret):
+    qp, l = qc.shape
+    cp = cct.shape[1]
+    grid = (qp // tile_q, cp // tile_c)
+    kernel = functools.partial(_myers_tile_kernel, L=l)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((qp, cp), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_q, l), lambda i, j: (i, 0), memory_space=_VMEM),
+            pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0), memory_space=_VMEM),
+            pl.BlockSpec((l, tile_c), lambda i, j: (0, j), memory_space=_VMEM),
+            pl.BlockSpec((1, tile_c), lambda i, j: (0, j), memory_space=_VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (tile_q, tile_c), lambda i, j: (i, j), memory_space=_VMEM
+        ),
+        interpret=interpret,
+    )(qc, ql2, cct, cl2)
+
+
+def myers_distance_tiles(qchars, qlen, cchars, clen, *, interpret=None):
+    """All-pairs Levenshtein distance d(query_i, corpus_j) -> (Q, C) int32.
+
+    qchars: (Q, L) int32 codepoints (0-padded), L <= 32; qlen: (Q,) int32
+    cchars: (C, L) int32; clen: (C,) int32
+
+    Pads Q up to a sublane multiple and C up to a lane multiple; padded rows
+    compute garbage distances that callers mask via their validity bits.
+    """
+    q, l = qchars.shape
+    c = cchars.shape[0]
+    if l > 32:
+        raise ValueError(f"Myers pallas kernel needs L <= 32, got {l}")
+    if interpret is None:
+        interpret = _interpret()
+
+    tile_q = min(128, _round_up(max(q, 1), 8))
+    tile_c = min(512, _round_up(max(c, 1), 128))
+    qp = _round_up(max(q, 1), tile_q)
+    cp = _round_up(max(c, 1), tile_c)
+
+    qc = jnp.zeros((qp, l), jnp.int32).at[:q].set(qchars)
+    ql2 = jnp.zeros((qp, 1), jnp.int32).at[:q, 0].set(qlen)
+    cct = jnp.zeros((l, cp), jnp.int32).at[:, :c].set(cchars.T)
+    cl2 = jnp.zeros((1, cp), jnp.int32).at[0, :c].set(clen)
+
+    out = _myers_tiles_padded(
+        qc, ql2, cct, cl2, tile_q=tile_q, tile_c=tile_c, interpret=interpret
+    )
+    return out[:q, :c]
+
+
+def levenshtein_sim_tiles(qchars, qlen, cchars, clen, equal, *, interpret=None):
+    """Duke Levenshtein similarity over all query x corpus pairs: (Q, C) f32.
+
+    Mirrors ops.pairwise.levenshtein_sim (core.comparators.Levenshtein
+    semantics) on tiled pair distances; ``equal`` is the (Q, C) exact
+    string-equality mask (from value hashes).
+    """
+    from .pairwise import levenshtein_sim_from_distance
+
+    dist = myers_distance_tiles(qchars, qlen, cchars, clen, interpret=interpret)
+    return levenshtein_sim_from_distance(dist, qlen[:, None], clen[None, :], equal)

@@ -34,6 +34,7 @@ from jax import lax
 from ..core import comparators as C
 from . import features as F
 from . import pairwise as pw
+from . import pallas_kernels as pk
 
 # Sentinel for empty top-K slots (logit scale).
 NEG_INF = -3.0e38
@@ -80,6 +81,32 @@ def _property_sim(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict) -> tuple:
 
     kind = spec.kind
     cmp = spec.comparator
+    if (
+        kind == F.CHARS
+        and not isinstance(cmp, C.JaroWinkler)
+        and qf["chars"].shape[2] <= 32
+        and pk.pallas_enabled()
+    ):
+        # Pallas tiled path: (TQ, TC) distance tiles computed in VMEM from
+        # O(T*L) operands — no expanded (Q*C, L) pair arrays in HBM.
+        q = qf["valid"].shape[0]
+        c = cf["valid"].shape[0]
+        vq = qf["chars"].shape[1]
+        vc = cf["chars"].shape[1]
+        eq4 = equal.reshape(q, c, vq, vc)
+        rows = []
+        for a in range(vq):
+            cols = [
+                pk.levenshtein_sim_tiles(
+                    qf["chars"][:, a], qf["length"][:, a],
+                    cf["chars"][:, b], cf["length"][:, b],
+                    eq4[:, :, a, b],
+                )
+                for b in range(vc)
+            ]
+            rows.append(jnp.stack(cols, axis=-1))        # (Q, C, Vc)
+        sim = jnp.stack(rows, axis=-2).reshape(-1)       # (Q, C, Vq, Vc)
+        return sim, combo_valid
     if kind == F.CHARS:
         c1, c2 = _pair_expand(qf["chars"], cf["chars"])
         l1, l2 = _pair_expand(qf["length"], cf["length"])

@@ -120,3 +120,29 @@ def test_lev_distance_exact():
     assert native.lev_distance("kitten", "sitting") == 3
     assert native.lev_distance("", "abc") == 3
     assert native.lev_distance("a" * 80, "a" * 79 + "b") == 1
+
+
+def test_native_handles_lone_surrogates():
+    """json.loads accepts lone surrogates ('"\\ud800abc"'); the native path
+    must score them identically to pure Python instead of raising
+    UnicodeEncodeError (utf-32 surrogatepass encoding)."""
+    from sesam_duke_microservice_tpu.core import comparators as C
+
+    s1 = "\ud800abc"
+    s2 = "xabc"
+    lev = C.Levenshtein()
+    jw = C.JaroWinkler()
+    saved = C._NATIVE
+    C._NATIVE = None
+    try:
+        want_lev = lev.compare(s1, s2)
+        want_jw = jw.compare(s1, s2)
+    finally:
+        C._NATIVE = saved
+    assert lev.compare(s1, s2) == pytest.approx(want_lev)
+    assert jw.compare(s1, s2) == pytest.approx(want_jw)
+
+    from sesam_duke_microservice_tpu import native
+
+    if native.available():
+        assert native.lev_sim(s1, s2) == pytest.approx(want_lev)

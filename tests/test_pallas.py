@@ -1,0 +1,154 @@
+"""Pallas tiled kernels: differential tests vs the XLA path and the scalar
+oracle, in interpreter mode on the CPU test backend (tests/conftest.py)."""
+
+import numpy as np
+import pytest
+
+from sesam_duke_microservice_tpu.core import comparators as C
+from sesam_duke_microservice_tpu.ops import pairwise as pw
+from sesam_duke_microservice_tpu.ops import pallas_kernels as pk
+
+import jax.numpy as jnp
+
+
+def _encode(strings, max_chars=16):
+    n = len(strings)
+    chars = np.zeros((n, max_chars), np.int32)
+    lens = np.zeros((n,), np.int32)
+    for i, s in enumerate(strings):
+        cps = [ord(ch) for ch in s][:max_chars]
+        chars[i, : len(cps)] = cps
+        lens[i] = len(cps)
+    return jnp.asarray(chars), jnp.asarray(lens)
+
+
+QUERIES = ["kitten", "saturday", "abc", "", "flaw", "ab", "identical",
+           "a" * 16, "xyzzy"]
+CORPUS = ["sitting", "sunday", "abc", "lawn", "", "b", "identical",
+          "a" * 12 + "bbbb", "plugh", "kitten"]
+
+
+def test_myers_tiles_vs_flat_myers():
+    qc, ql = _encode(QUERIES)
+    cc, cl = _encode(CORPUS)
+    got = np.asarray(
+        pk.myers_distance_tiles(qc, ql, cc, cl, interpret=True)
+    )
+    # flat reference: expand pairs and run the XLA Myers kernel
+    nq, nc = len(QUERIES), len(CORPUS)
+    c1 = jnp.repeat(qc, nc, axis=0)
+    l1 = jnp.repeat(ql, nc)
+    c2 = jnp.tile(cc, (nq, 1))
+    l2 = jnp.tile(cl, (nq,))
+    want = np.asarray(pw.levenshtein_distance_myers(c1, l1, c2, l2)).reshape(
+        nq, nc
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_myers_tiles_vs_scalar_oracle():
+    qc, ql = _encode(QUERIES)
+    cc, cl = _encode(CORPUS)
+    got = np.asarray(
+        pk.myers_distance_tiles(qc, ql, cc, cl, interpret=True)
+    )
+    for i, s1 in enumerate(QUERIES):
+        for j, s2 in enumerate(CORPUS):
+            assert got[i, j] == C.levenshtein_distance(s1, s2), (s1, s2)
+
+
+def test_myers_tiles_padding_sizes():
+    # non-multiple-of-tile shapes round-trip through padding
+    rng = np.random.default_rng(7)
+    strings = [
+        "".join(chr(97 + rng.integers(4)) for _ in range(rng.integers(0, 16)))
+        for _ in range(13)
+    ]
+    qc, ql = _encode(strings[:5])
+    cc, cl = _encode(strings)
+    got = np.asarray(pk.myers_distance_tiles(qc, ql, cc, cl, interpret=True))
+    assert got.shape == (5, 13)
+    for i in range(5):
+        for j in range(13):
+            assert got[i, j] == C.levenshtein_distance(strings[i], strings[j])
+
+
+def test_levenshtein_sim_tiles_matches_comparator():
+    qc, ql = _encode(QUERIES)
+    cc, cl = _encode(CORPUS)
+    equal = np.zeros((len(QUERIES), len(CORPUS)), bool)
+    for i, s1 in enumerate(QUERIES):
+        for j, s2 in enumerate(CORPUS):
+            equal[i, j] = s1 == s2
+    sim = np.asarray(
+        pk.levenshtein_sim_tiles(
+            qc, ql, cc, cl, jnp.asarray(equal), interpret=True
+        )
+    )
+    lev = C.Levenshtein()
+    for i, s1 in enumerate(QUERIES):
+        for j, s2 in enumerate(CORPUS):
+            want = lev.compare(s1, s2)
+            assert sim[i, j] == pytest.approx(want, abs=1e-6), (s1, s2)
+
+
+def test_scoring_program_with_pallas_enabled(monkeypatch):
+    """End-to-end: the scoring program routed through the pallas path agrees
+    with the XLA path on top-K results."""
+    monkeypatch.setenv("DUKE_TPU_PALLAS", "0")
+    import jax
+
+    from sesam_duke_microservice_tpu.core.config import DukeSchema
+    from sesam_duke_microservice_tpu.core.records import (
+        ID_PROPERTY_NAME,
+        Property,
+        Record,
+    )
+    from sesam_duke_microservice_tpu.ops import features as F
+    from sesam_duke_microservice_tpu.ops import scoring as S
+
+    schema = DukeSchema(
+        threshold=0.8,
+        maybe_threshold=None,
+        properties=[
+            Property(ID_PROPERTY_NAME, id_property=True),
+            Property("NAME", C.Levenshtein(), 0.3, 0.88),
+        ],
+        data_sources=[],
+    )
+    plan = F.SchemaFeatures.plan(schema)
+    names = ["oslo", "osло", "bergen", "bergn", "trondheim", "stavanger",
+             "stavangr", "tromso"]
+    records = []
+    for i, nm in enumerate(names):
+        r = Record()
+        r.add_value(ID_PROPERTY_NAME, f"d__{i}")
+        r.add_value("NAME", nm)
+        records.append(r)
+    feats = F.extract_batch(plan, records)
+    to_dev = lambda t: {p: {k: jnp.asarray(a) for k, a in d.items()}
+                        for p, d in t.items()}
+    dev = to_dev(feats)
+    n = len(records)
+    valid = jnp.ones((n,), bool)
+    deleted = jnp.zeros((n,), bool)
+    group = jnp.full((n,), -1, jnp.int32)
+    qrow = jnp.arange(n, dtype=jnp.int32)
+    qgroup = jnp.full((n,), -2, jnp.int32)
+
+    def run():
+        pair_logits = S.build_pair_logits(plan)
+        return jax.tree_util.tree_map(
+            np.asarray,
+            S.scan_topk(
+                pair_logits, dev, dev, valid, deleted, group, qgroup, qrow,
+                jnp.float32(0.0), chunk=4, top_k=4, group_filtering=False,
+            ),
+        )
+
+    base = run()
+    monkeypatch.setenv("DUKE_TPU_PALLAS", "1")
+    pal = run()
+    np.testing.assert_allclose(pal[0], base[0], rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(pal[1], base[1])
+    np.testing.assert_array_equal(pal[2], base[2])
