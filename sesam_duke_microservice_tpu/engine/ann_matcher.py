@@ -1,0 +1,152 @@
+"""Embedding-ANN matching backend: cosine blocking + exact rescoring.
+
+The third blocking backend (after the host inverted index and the device
+brute-force corpus): candidate retrieval is a cosine top-C search over
+hashed-n-gram record embeddings (``ops.encoder``), and only the retrieved
+candidates are scored with the exact per-property kernels
+(``ops.scoring.build_ann_scorer``).  Per query the device work drops from
+O(N * L^2) comparator FLOPs to O(N * D) matmul FLOPs + O(C * L^2)
+rescoring — the configuration for corpora where brute force stops being
+free (BASELINE.json configs[3-4]).
+
+Semantics vs the brute-force backend: emitted probabilities for retrieved
+pairs are identical (same exact rescoring + host finalization path through
+``DeviceProcessor``); the candidate *set* is approximate, bounded below by
+recall escalation — when every retrieved candidate clears the pruning
+threshold the search re-runs with doubled C, so a saturated result can
+never silently truncate.  Recall against brute force is measured in
+``tests/test_ann.py`` and the bench harness, mirroring how the reference's
+Lucene blocking bounds work per record via ``max_search_hits`` without a
+recall guarantee (IncrementalLuceneDatabase.java:349-423).
+
+The embedding matrix rides inside the ``DeviceCorpus`` feature tree as a
+pseudo-property (``ops.encoder.ANN_PROP``), so append/growth/tombstone and
+the incremental device-mirror update apply to it unchanged.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core.config import DukeSchema, MatchTunables
+from ..core.records import Record
+from ..ops import encoder as E
+from .device_matcher import (
+    DeviceIndex,
+    DeviceProcessor,
+    _BlockResult,
+    _ScorerCache,
+    _CHUNK,
+)
+
+logger = logging.getLogger("ann-matcher")
+
+_ANN_DIM = int(os.environ.get("DEVICE_ANN_DIM", "256"))
+_ANN_TOP_C = int(os.environ.get("DEVICE_ANN_CANDIDATES", "64"))
+
+
+class AnnIndex(DeviceIndex):
+    """``CandidateIndex`` with embedding-ANN candidate retrieval.
+
+    Everything corpus-side is inherited from ``DeviceIndex``; this class
+    adds the per-record embedding (computed at ingest, appended as a
+    pseudo-property tensor) and swaps the scorer for the two-stage ANN
+    program.
+    """
+
+    def __init__(self, schema: DukeSchema, *,
+                 tunables: Optional[MatchTunables] = None,
+                 values_per_record: Optional[int] = None,
+                 dim: int = _ANN_DIM,
+                 initial_top_c: int = _ANN_TOP_C):
+        super().__init__(
+            schema, tunables=tunables, values_per_record=values_per_record
+        )
+        self.dim = dim
+        self.initial_top_c = initial_top_c
+        self.encoder = E.RecordEncoder(schema, dim)
+
+    def _extract(self, records: Sequence[Record]):
+        feats = super()._extract(records)
+        feats[E.ANN_PROP] = {
+            E.ANN_TENSOR: self.encoder.encode_batch(records)
+        }
+        return feats
+
+    @property
+    def scorer_cache(self) -> "_AnnScorerCache":
+        if self._scorer_cache is None:
+            self._scorer_cache = _AnnScorerCache(self)
+        return self._scorer_cache
+
+
+class _AnnScorerCache(_ScorerCache):
+    """Caches jitted ANN scorers per (top_c, group_filtering) and runs the
+    recall-escalation loop."""
+
+    def _scorer(self, top_c: int, group_filtering: bool):
+        from ..ops import scoring as S
+
+        key = (top_c, group_filtering)
+        if key not in self._scorers:
+            self._scorers[key] = S.build_ann_scorer(
+                self.index.plan, chunk=_CHUNK, top_c=top_c,
+                group_filtering=group_filtering,
+            )
+        return self._scorers[key]
+
+    def score_block(self, records: Sequence[Record], *,
+                    group_filtering: bool) -> _BlockResult:
+        from ..ops import scoring as S
+        import jax.numpy as jnp
+
+        index = self.index
+        corpus = index.corpus
+        n = len(records)
+        min_logit = self._min_logit()
+
+        if corpus.size == 0:
+            return _BlockResult(
+                np.full((n, 1), S.NEG_INF, np.float32),
+                np.full((n, 1), -1, np.int32), min_logit,
+            )
+
+        qfeats, query_row_j, query_group_j = self._prepare_queries(
+            records, group_filtering
+        )
+        q_emb = qfeats.pop(E.ANN_PROP)[E.ANN_TENSOR]
+
+        cfeats_all, cvalid, cdeleted, cgroup = corpus.device_arrays()
+        corpus_emb = cfeats_all[E.ANN_PROP][E.ANN_TENSOR]
+        corpus_feats = {
+            prop: tensors for prop, tensors in cfeats_all.items()
+            if prop != E.ANN_PROP
+        }
+
+        top_c = index.initial_top_c
+        while True:
+            c = min(top_c, corpus.capacity)
+            scorer = self._scorer(c, group_filtering)
+            top_logit, top_index, count = scorer(
+                q_emb, qfeats, corpus_emb, corpus_feats, cvalid, cdeleted,
+                cgroup, query_group_j, query_row_j, jnp.float32(min_logit),
+            )
+            count_np = np.asarray(count)[:n]
+            if c >= corpus.capacity or count_np.max(initial=0) < c:
+                return _BlockResult(
+                    np.asarray(top_logit), np.asarray(top_index), min_logit
+                )
+            top_c = c * 2
+            logger.info(
+                "recall escalation: all %d retrieved candidates cleared the "
+                "bound, retrying with C=%d", int(count_np.max()), top_c,
+            )
+
+
+class AnnProcessor(DeviceProcessor):
+    """DeviceProcessor over an AnnIndex (alias — the processor logic is
+    identical; the index's scorer_cache supplies the ANN program)."""

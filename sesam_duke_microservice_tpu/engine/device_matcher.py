@@ -290,9 +290,14 @@ class DeviceIndex(CandidateIndex):
         with self._lock:
             self._pending.append(record)
 
-    def commit(self) -> None:
+    def _extract(self, records: Sequence[Record]):
+        """Feature extraction for a record batch; subclasses may add pseudo-
+        properties (the ANN backend rides its embedding matrix in here)."""
         from ..ops import features as F
 
+        return F.extract_batch(self.plan, records)
+
+    def commit(self) -> None:
         with self._lock:
             pending, self._pending = self._pending, []
         if not pending:
@@ -306,7 +311,7 @@ class DeviceIndex(CandidateIndex):
             old = self.id_to_row.get(r.record_id)
             if old is not None:
                 self.corpus.tombstone(old)
-        feats = F.extract_batch(self.plan, records)
+        feats = self._extract(records)
         deleted = np.array([r.is_deleted() for r in records], dtype=bool)
         group = np.array(
             [int(r.get_value(GROUP_NO_PROPERTY_NAME) or -1) for r in records],
@@ -388,17 +393,11 @@ class _ScorerCache:
             )
         return self._scorers[key]
 
-    def score_block(self, records: Sequence[Record], *,
-                    group_filtering: bool) -> _BlockResult:
-        from ..ops import features as F
+    def _min_logit(self) -> float:
         from ..ops import scoring as S
-        import jax.numpy as jnp
 
         index = self.index
         schema = index.schema
-        corpus = index.corpus
-        n = len(records)
-
         thresholds = [schema.threshold]
         if schema.maybe_threshold:
             thresholds.append(schema.maybe_threshold)
@@ -407,15 +406,17 @@ class _ScorerCache:
         # 1e-3 safety margin covers float32 kernel error at the bound; the
         # surviving pairs are re-scored host-exact, so the margin only costs
         # a few extra finalizations, never correctness.
-        min_logit = S.probability_to_logit(min_threshold) - host_bound - 1e-3
+        return S.probability_to_logit(min_threshold) - host_bound - 1e-3
 
-        if corpus.size == 0:
-            return _BlockResult(
-                np.full((n, 1), S.NEG_INF, np.float32),
-                np.full((n, 1), -1, np.int32), min_logit,
-            )
+    def _prepare_queries(self, records: Sequence[Record],
+                         group_filtering: bool):
+        """Query-side arrays for a block: (qfeats device tree, padded to the
+        query bucket; query_row; query_group)."""
+        import jax.numpy as jnp
 
-        bucket = _bucket_for(n)
+        index = self.index
+        corpus = index.corpus
+        bucket = _bucket_for(len(records))
         # (a block larger than the biggest bucket is split by the caller)
         rows = [index.id_to_row.get(r.record_id, -1) for r in records]
         if all(row >= 0 for row in rows):
@@ -430,7 +431,7 @@ class _ScorerCache:
             }
         else:
             # http-transform: queries are not in the corpus
-            qfeats_np = F.extract_batch(index.plan, records)
+            qfeats_np = index._extract(records)
         qfeats = {
             prop: {
                 name: jnp.asarray(_pad_rows(arr, bucket))
@@ -450,8 +451,27 @@ class _ScorerCache:
                     "or empty!"
                 )
             query_group[i] = int(group_no) if group_no else -2
-        query_row_j = jnp.asarray(query_row)
-        query_group_j = jnp.asarray(query_group)
+        return qfeats, jnp.asarray(query_row), jnp.asarray(query_group)
+
+    def score_block(self, records: Sequence[Record], *,
+                    group_filtering: bool) -> _BlockResult:
+        from ..ops import scoring as S
+        import jax.numpy as jnp
+
+        index = self.index
+        corpus = index.corpus
+        n = len(records)
+        min_logit = self._min_logit()
+
+        if corpus.size == 0:
+            return _BlockResult(
+                np.full((n, 1), S.NEG_INF, np.float32),
+                np.full((n, 1), -1, np.int32), min_logit,
+            )
+
+        qfeats, query_row_j, query_group_j = self._prepare_queries(
+            records, group_filtering
+        )
 
         cfeats, cvalid, cdeleted, cgroup = corpus.device_arrays()
         top_k = _INITIAL_TOP_K

@@ -151,8 +151,10 @@ def build_workload(wc: WorkloadConfig, sc: ServiceConfig, *,
     """Assemble a workload: blocking index + processor + listener + link DB.
 
     ``backend``: 'host' (inverted index + scalar scoring — the conformance/
-    baseline path) or 'device' (TPU-resident corpus + batched kernels, see
-    engine.device_matcher).
+    baseline path), 'device' (TPU-resident corpus + batched kernels, exact
+    brute-force blocking, see engine.device_matcher), or 'ann' (embedding
+    cosine blocking + exact rescoring, see engine.ann_matcher — for corpora
+    where brute force stops being free).
     """
     group_filtering = wc.is_record_linkage
     if backend == "device":
@@ -160,6 +162,13 @@ def build_workload(wc: WorkloadConfig, sc: ServiceConfig, *,
 
         index = DeviceIndex(wc.duke, tunables=sc.tunables)
         processor = DeviceProcessor(
+            wc.duke, index, group_filtering=group_filtering, profile=sc.profile
+        )
+    elif backend == "ann":
+        from .ann_matcher import AnnIndex, AnnProcessor
+
+        index = AnnIndex(wc.duke, tunables=sc.tunables)
+        processor = AnnProcessor(
             wc.duke, index, group_filtering=group_filtering, profile=sc.profile
         )
     else:

@@ -1,0 +1,183 @@
+"""Hashed character-n-gram record embeddings for ANN candidate blocking.
+
+The embedding-ANN backend (``engine.ann_matcher``) replaces exhaustive
+brute-force blocking with a two-stage program: a cosine top-C retrieval over
+a dense embedding matrix (one bf16 matmul per corpus chunk — pure MXU work),
+followed by exact rescoring of only the retrieved candidates.  This is the
+TPU-native counterpart of the reference's Lucene token blocking
+(IncrementalLuceneDatabase.java:459-492): where Lucene ORs analyzed tokens
+into a BooleanQuery and scores tf-idf overlap, we hash character n-grams of
+every comparison property into a signed D-dimensional feature vector
+(Weinberger et al.'s hashing trick) and let cosine similarity rank the
+corpus.  Character n-grams — not word tokens — so the blocking stage is
+robust to exactly the typo classes the comparators (Levenshtein,
+Jaro-Winkler, q-gram) are configured to tolerate.
+
+Encoding runs on host (numpy scatter-add; O(len) per record, once per
+ingest) because it is tiny next to retrieval; retrieval runs on device where
+the corpus-sized work is.  No learned weights, no external model downloads —
+the encoder is deterministic from the schema alone.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.records import Record
+from . import features as F
+
+# Pseudo-property under which the corpus embedding matrix rides inside the
+# DeviceCorpus feature tree (so growth/upload/incremental-update machinery in
+# engine.device_matcher applies to it unchanged).
+ANN_PROP = "__ann__"
+ANN_TENSOR = "emb"
+
+_NGRAM = 3
+
+# Vectorized n-gram hashing: three odd multipliers for the codepoint window
+# plus a murmur3-style finalizer, all in wrapping uint64 numpy arithmetic —
+# the whole record hashes in a handful of array ops instead of a per-byte
+# Python loop (ingest-side hot path for large corpora).
+_H_A = np.uint64(0x9E3779B97F4A7C15)
+_H_B = np.uint64(0xC2B2AE3D27D4EB4F)
+_H_C = np.uint64(0x165667B19E3779F9)
+_FM1 = np.uint64(0xFF51AFD7ED558CCD)
+_FM2 = np.uint64(0xC4CEB9FE1A85EC53)
+
+_SALTS: Dict[str, np.uint64] = {}
+
+
+def _salt(prop: str) -> np.uint64:
+    # separate salt per property so "oslo" in NAME and "oslo" in CAPITAL
+    # hash to different buckets — field-tagged n-grams, like Lucene's
+    # per-field terms
+    s = _SALTS.get(prop)
+    if s is None:
+        s = _SALTS[prop] = np.uint64(F.fnv1a64(prop))
+    return s
+
+
+def _hash_ngrams(value: str, salt: np.uint64) -> np.ndarray:
+    """uint64 hashes of all character n-grams of `` value `` (padded)."""
+    padded = f" {value.lower()} "
+    cp = np.frombuffer(
+        padded.encode("utf-32-le", "surrogatepass"), dtype=np.uint32
+    ).astype(np.uint64)
+    if cp.size < _NGRAM:
+        cp = np.pad(cp, (0, _NGRAM - cp.size))
+    with np.errstate(over="ignore"):
+        h = (cp[:-2] * _H_A) ^ (cp[1:-1] * _H_B) ^ (cp[2:] * _H_C) ^ salt
+        h ^= h >> np.uint64(33)
+        h *= _FM1
+        h ^= h >> np.uint64(29)
+        h *= _FM2
+        h ^= h >> np.uint64(32)
+    return h
+
+
+def embed_values(prop_values: Sequence[tuple], dim: int) -> np.ndarray:
+    """One L2-normalized signed-hash embedding from (property, value) pairs."""
+    vec = np.zeros((dim,), dtype=np.float32)
+    hashes = [
+        _hash_ngrams(value, _salt(prop)) for prop, value in prop_values
+    ]
+    if not hashes:
+        return vec
+    uniq, counts = np.unique(np.concatenate(hashes), return_counts=True)
+    buckets = (uniq % np.uint64(dim)).astype(np.int64)
+    signs = np.where(
+        (uniq >> np.uint64(32)) & np.uint64(1), 1.0, -1.0
+    ).astype(np.float32)
+    # sublinear tf weighting
+    np.add.at(vec, buckets, signs * np.sqrt(counts).astype(np.float32))
+    norm = float(np.linalg.norm(vec))
+    if norm > 0.0:
+        vec /= norm
+    return vec
+
+
+class RecordEncoder:
+    """Schema-bound encoder: Record -> (dim,) normalized f32 embedding."""
+
+    def __init__(self, schema, dim: int):
+        self.dim = dim
+        # every comparison property contributes to blocking; recall against
+        # brute force is measured, not assumed (SURVEY.md section 7 hard
+        # part 5), and more fields can only add evidence
+        self.props: List[str] = [p.name for p in schema.comparison_properties()]
+
+    def encode(self, record: Record) -> np.ndarray:
+        pairs = []
+        for name in self.props:
+            for value in record.get_values(name):
+                if value:
+                    pairs.append((name, value))
+        return embed_values(pairs, self.dim)
+
+    def encode_batch(self, records: Sequence[Record]) -> np.ndarray:
+        if not records:
+            return np.zeros((0, self.dim), dtype=np.float32)
+        return np.stack([self.encode(r) for r in records])
+
+
+def retrieval_scan(q_emb, corpus_emb, corpus_valid, corpus_deleted,
+                   corpus_group, query_group, query_row, *,
+                   chunk: int, top_c: int, group_filtering: bool,
+                   row_offset=0):
+    """Blockwise cosine top-C over the corpus embedding matrix.
+
+    Same scan/mask/merge skeleton as ``ops.scoring.scan_topk`` but the chunk
+    score is a single (Q, D) x (D, chunk) matmul in bf16 with f32
+    accumulation — the MXU path.  Returns (top_sim, top_index) with global
+    row indices (``row_offset`` as in scan_topk for sharded use).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from . import scoring
+
+    q = q_emb.shape[0]
+    cap = corpus_valid.shape[0]
+    nchunks = cap // chunk
+    qb = q_emb.astype(jnp.bfloat16)
+
+    neg = jnp.float32(scoring.NEG_INF)
+    init_sim = jnp.full((q, top_c), neg, jnp.float32)
+    init_idx = jnp.full((q, top_c), -1, jnp.int32)
+
+    def body(carry, ci):
+        top_sim, top_idx = carry
+        start = ci * chunk
+        emb_c = lax.dynamic_slice_in_dim(corpus_emb, start, chunk, axis=0)
+        sims = jax.lax.dot_general(
+            qb, emb_c.astype(jnp.bfloat16),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (Q, chunk)
+
+        cvalid = lax.dynamic_slice_in_dim(corpus_valid, start, chunk)
+        cdel = lax.dynamic_slice_in_dim(corpus_deleted, start, chunk)
+        cgroup = lax.dynamic_slice_in_dim(corpus_group, start, chunk)
+        cidx = row_offset + start + jnp.arange(chunk, dtype=jnp.int32)
+
+        mask = scoring.candidate_mask(
+            cvalid, cdel, cgroup, cidx, query_group, query_row,
+            group_filtering,
+        )
+        sims = jnp.where(mask, sims, neg)
+
+        merged_sim = jnp.concatenate([top_sim, sims], axis=1)
+        merged_idx = jnp.concatenate(
+            [top_idx, jnp.broadcast_to(cidx[None, :], (q, chunk))], axis=1
+        )
+        top_sim, sel = lax.top_k(merged_sim, top_c)
+        top_idx = jnp.take_along_axis(merged_idx, sel, axis=1)
+        return (top_sim, top_idx), None
+
+    (top_sim, top_idx), _ = lax.scan(
+        body, (init_sim, init_idx), jnp.arange(nchunks, dtype=jnp.int32)
+    )
+    return top_sim, top_idx

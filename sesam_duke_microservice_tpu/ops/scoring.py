@@ -68,21 +68,38 @@ def _pair_expand(qa: jnp.ndarray, ca: jnp.ndarray) -> tuple:
     return a.reshape((q * c * v * v,) + rq), b.reshape((q * c * v * v,) + rc)
 
 
-def _property_sim(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict) -> tuple:
+def _pair_expand_gathered(qa: jnp.ndarray, ca: jnp.ndarray) -> tuple:
+    """(Q, V, ...) x gathered (Q, C, V, ...) -> flat (Q*C*V*V, ...) operands.
+
+    The per-query candidate axis is already aligned (candidate row c of
+    query q, not a corpus cross product) — used by the ANN rescoring stage.
+    """
+    q, v = qa.shape[0], qa.shape[1]
+    c = ca.shape[1]
+    rq = qa.shape[2:]
+    rc = ca.shape[3:]
+    a = jnp.broadcast_to(qa[:, None, :, None], (q, c, v, v) + rq)
+    b = jnp.broadcast_to(ca[:, :, None, :], (q, c, v, v) + rc)
+    return a.reshape((q * c * v * v,) + rq), b.reshape((q * c * v * v,) + rc)
+
+
+def _property_sim(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict,
+                  expand=_pair_expand, pallas_ok: bool = True) -> tuple:
     """Pair similarity for one property.
 
     Returns (sim, combo_valid), both flat (Q*C*V*V,).
     """
-    hh1, hh2 = _pair_expand(qf["hash_hi"], cf["hash_hi"])
-    hl1, hl2 = _pair_expand(qf["hash_lo"], cf["hash_lo"])
-    v1, v2 = _pair_expand(qf["valid"], cf["valid"])
+    hh1, hh2 = expand(qf["hash_hi"], cf["hash_hi"])
+    hl1, hl2 = expand(qf["hash_lo"], cf["hash_lo"])
+    v1, v2 = expand(qf["valid"], cf["valid"])
     combo_valid = v1 & v2
     equal = (hh1 == hh2) & (hl1 == hl2) & combo_valid
 
     kind = spec.kind
     cmp = spec.comparator
     if (
-        kind == F.CHARS
+        pallas_ok
+        and kind == F.CHARS
         and not isinstance(cmp, C.JaroWinkler)
         and qf["chars"].shape[2] <= 32
         and pk.pallas_enabled()
@@ -108,8 +125,8 @@ def _property_sim(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict) -> tuple:
         sim = jnp.stack(rows, axis=-2).reshape(-1)       # (Q, C, Vq, Vc)
         return sim, combo_valid
     if kind == F.CHARS:
-        c1, c2 = _pair_expand(qf["chars"], cf["chars"])
-        l1, l2 = _pair_expand(qf["length"], cf["length"])
+        c1, c2 = expand(qf["chars"], cf["chars"])
+        l1, l2 = expand(qf["length"], cf["length"])
         if isinstance(cmp, C.JaroWinkler):
             sim = pw.jaro_winkler_sim(
                 c1, l1, c2, l2, equal,
@@ -120,9 +137,9 @@ def _property_sim(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict) -> tuple:
         else:
             sim = pw.levenshtein_sim(c1, l1, c2, l2, equal)
     elif kind == F.CHARS_WEIGHTED:
-        c1, c2 = _pair_expand(qf["chars"], cf["chars"])
-        k1, k2 = _pair_expand(qf["classes"], cf["classes"])
-        l1, l2 = _pair_expand(qf["length"], cf["length"])
+        c1, c2 = expand(qf["chars"], cf["chars"])
+        k1, k2 = expand(qf["classes"], cf["classes"])
+        l1, l2 = expand(qf["length"], cf["length"])
         sim = pw.weighted_levenshtein_sim(
             c1, k1, l1, c2, k2, l2, equal,
             digit_weight=cmp.digit_weight,
@@ -130,12 +147,12 @@ def _property_sim(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict) -> tuple:
             other_weight=cmp.other_weight,
         )
     elif kind == F.GRAM_SET:
-        g1, g2 = _pair_expand(qf["grams"], cf["grams"])
-        n1, n2 = _pair_expand(qf["gram_count"], cf["gram_count"])
+        g1, g2 = expand(qf["grams"], cf["grams"])
+        n1, n2 = expand(qf["gram_count"], cf["gram_count"])
         sim = pw.qgram_sim(g1, n1, g2, n2, equal, formula=cmp.formula)
     elif kind == F.TOKEN_SET:
-        t1, t2 = _pair_expand(qf["tokens"], cf["tokens"])
-        n1, n2 = _pair_expand(qf["token_count"], cf["token_count"])
+        t1, t2 = expand(qf["tokens"], cf["tokens"])
+        n1, n2 = expand(qf["token_count"], cf["token_count"])
         sim = pw.token_set_sim(
             t1, n1, t2, n2, equal, dice=isinstance(cmp, C.DiceCoefficient)
         )
@@ -146,18 +163,18 @@ def _property_sim(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict) -> tuple:
             else pw.exact_sim(equal)
         )
     elif kind == F.PHONETIC:
-        ch1, ch2 = _pair_expand(qf["code_hi"], cf["code_hi"])
-        cl1, cl2 = _pair_expand(qf["code_lo"], cf["code_lo"])
-        cv1, cv2 = _pair_expand(qf["code_valid"], cf["code_valid"])
+        ch1, ch2 = expand(qf["code_hi"], cf["code_hi"])
+        cl1, cl2 = expand(qf["code_lo"], cf["code_lo"])
+        cv1, cv2 = expand(qf["code_valid"], cf["code_valid"])
         sim = pw.phonetic_sim(equal, (ch1 == ch2) & (cl1 == cl2), cv1 & cv2)
     elif kind == F.NUMERIC:
-        d1, d2 = _pair_expand(qf["number"], cf["number"])
-        nv1, nv2 = _pair_expand(qf["number_valid"], cf["number_valid"])
+        d1, d2 = expand(qf["number"], cf["number"])
+        nv1, nv2 = expand(qf["number_valid"], cf["number_valid"])
         sim = pw.numeric_sim(d1, nv1, d2, nv2, min_ratio=cmp.min_ratio)
     elif kind == F.GEO:
-        la1, la2 = _pair_expand(qf["lat"], cf["lat"])
-        lo1, lo2 = _pair_expand(qf["lon"], cf["lon"])
-        gv1, gv2 = _pair_expand(qf["geo_valid"], cf["geo_valid"])
+        la1, la2 = expand(qf["lat"], cf["lat"])
+        lo1, lo2 = expand(qf["lon"], cf["lon"])
+        gv1, gv2 = expand(qf["geo_valid"], cf["geo_valid"])
         sim = pw.geoposition_sim(
             la1, lo1, gv1, la2, lo2, gv2, max_distance=cmp.max_distance
         )
@@ -167,7 +184,8 @@ def _property_sim(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict) -> tuple:
 
 
 def _property_logit(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict,
-                    q: int, c: int) -> jnp.ndarray:
+                    q: int, c: int, expand=_pair_expand,
+                    pallas_ok: bool = True) -> jnp.ndarray:
     """Per-pair clamped log-odds contribution of one property: (Q, C) f32.
 
     Duke's PropertyImpl.compare map (core.records.Property.compare_probability):
@@ -176,7 +194,7 @@ def _property_logit(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict,
     combos is taken in probability space — the map is applied per combo, so
     semantics match the host engine even for low > 0.5 configs.
     """
-    sim, combo_valid = _property_sim(spec, qf, cf)
+    sim, combo_valid = _property_sim(spec, qf, cf, expand, pallas_ok)
     v = spec.v
     prob = jnp.where(
         sim >= 0.5, (spec.high - 0.5) * sim * sim + 0.5, jnp.float32(spec.low)
@@ -209,6 +227,103 @@ def build_pair_logits(plan: F.SchemaFeatures) -> Callable:
         return total
 
     return pair_logits
+
+
+def candidate_mask(cvalid, cdeleted, cgroup, cidx, query_group, query_row,
+                   group_filtering: bool):
+    """(Q, chunk) candidate-eligibility mask shared by every retrieval path.
+
+    Policy (one place, so brute-force and ANN retrieval can never diverge):
+    live non-tombstoned rows only; linkage excludes same-group rows
+    (IncrementalLuceneDatabase.java:467-475); a query never matches its own
+    corpus row.
+    """
+    mask = cvalid & ~cdeleted
+    if group_filtering:
+        mask = mask & (cgroup[None, :] != query_group[:, None])
+    return mask & (cidx[None, :] != query_row[:, None])
+
+
+def build_gathered_pair_logits(plan: F.SchemaFeatures) -> Callable:
+    """Returns fn(qfeats (Q,...), cfeats gathered (Q, C, ...)) -> (Q, C).
+
+    The aligned-candidate variant of ``build_pair_logits`` used by the ANN
+    rescoring stage: candidate c of query q is a specific gathered corpus
+    row, not a cross product.  Flat (non-Pallas) kernels — the pair count
+    here is Q*C, already pruned by retrieval.
+    """
+    specs = list(plan.device_props)
+
+    def pair_logits(qfeats: Dict[str, Dict], cfeats: Dict[str, Dict]) -> jnp.ndarray:
+        first = next(iter(cfeats.values()))
+        q, c = first["valid"].shape[0], first["valid"].shape[1]
+        total = jnp.zeros((q, c), jnp.float32)
+        for spec in specs:
+            total = total + _property_logit(
+                spec, qfeats[spec.name], cfeats[spec.name], q, c,
+                expand=_pair_expand_gathered, pallas_ok=False,
+            )
+        return total
+
+    return pair_logits
+
+
+def build_ann_scorer(
+    plan: F.SchemaFeatures,
+    *,
+    chunk: int = 512,
+    top_c: int = 64,
+    group_filtering: bool = False,
+) -> Callable:
+    """Two-stage ANN scoring program: cosine retrieval + exact rescoring.
+
+    Stage 1 ranks the whole corpus by embedding cosine (ops.encoder — one
+    bf16 matmul per chunk, MXU) keeping the top ``top_c`` rows per query;
+    stage 2 gathers those rows' feature tensors and scores them with the
+    exact per-property kernels.  Returned logits are therefore on the same
+    scale (and with the same host-property bound semantics) as
+    ``build_corpus_scorer`` — only the candidate *set* is approximate.
+
+    Signature::
+
+        fn(q_emb, qfeats, corpus_emb, corpus_feats, corpus_valid,
+           corpus_deleted, corpus_group, query_group, query_row, min_logit)
+        -> (top_logit (Q, C), top_index (Q, C), count_above (Q,))
+
+    ``count_above`` saturating at ``top_c`` signals the caller to escalate C
+    (recall escalation — the ANN analogue of the brute-force K-escalation).
+    """
+    from . import encoder as E
+
+    pair_logits = build_gathered_pair_logits(plan)
+
+    @jax.jit
+    def score(q_emb, qfeats, corpus_emb, corpus_feats, corpus_valid,
+              corpus_deleted, corpus_group, query_group, query_row,
+              min_logit):
+        top_sim, top_index = E.retrieval_scan(
+            q_emb, corpus_emb, corpus_valid, corpus_deleted, corpus_group,
+            query_group, query_row,
+            chunk=chunk, top_c=top_c, group_filtering=group_filtering,
+        )
+        retrieved = top_index >= 0
+        rows = jnp.clip(top_index, 0).reshape(-1)
+        q = top_index.shape[0]
+        cfeats = {
+            prop: {
+                name: jnp.take(arr, rows, axis=0).reshape(
+                    (q, top_c) + arr.shape[1:]
+                )
+                for name, arr in tensors.items()
+            }
+            for prop, tensors in corpus_feats.items()
+        }
+        logits = pair_logits(qfeats, cfeats)
+        logits = jnp.where(retrieved, logits, NEG_INF)
+        count = (logits > min_logit).sum(axis=1).astype(jnp.int32)
+        return logits, top_index, count
+
+    return score
 
 
 # -- the blockwise corpus scorer --------------------------------------------
@@ -269,10 +384,10 @@ def scan_topk(
         cgroup = lax.dynamic_slice_in_dim(corpus_group, start, chunk)
         cidx = row_offset + start + jnp.arange(chunk, dtype=jnp.int32)
 
-        mask = cvalid & ~cdel
-        if group_filtering:
-            mask = mask & (cgroup[None, :] != query_group[:, None])
-        mask = mask & (cidx[None, :] != query_row[:, None])
+        mask = candidate_mask(
+            cvalid, cdel, cgroup, cidx, query_group, query_row,
+            group_filtering,
+        )
         logits = jnp.where(mask, logits, NEG_INF)
 
         count = count + (logits > min_logit).sum(axis=1).astype(jnp.int32)

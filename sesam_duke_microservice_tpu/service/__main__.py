@@ -17,7 +17,7 @@ def main() -> None:
     parser.add_argument("--port", type=int,
                         default=int(os.environ.get("PORT", DEFAULT_PORT)))
     parser.add_argument("--host", default="0.0.0.0")
-    parser.add_argument("--backend", choices=["host", "device"],
+    parser.add_argument("--backend", choices=["host", "device", "ann"],
                         default=os.environ.get("DUKE_TPU_BACKEND", "host"))
     parser.add_argument("--ephemeral", action="store_true",
                         help="keep all state in memory (no data folder writes)")

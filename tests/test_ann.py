@@ -1,0 +1,179 @@
+"""Embedding-ANN backend tests: encoder properties, retrieval recall vs the
+exact brute-force device backend, and event parity for retrieved pairs.
+
+The ANN candidate set is approximate by design (engine.ann_matcher), so the
+contract tested here is: (a) every pair the ANN path emits carries the same
+exact probability the host oracle computes; (b) on the bundled-stresstest-
+style corpus the ANN path finds the same matches as exhaustive scoring
+(high recall at these sizes since true duplicates are near in n-gram
+space); (c) mutation semantics (tombstones, deletes, groups) carry over
+from the shared DeviceCorpus machinery.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from sesam_duke_microservice_tpu.core import comparators as C
+from sesam_duke_microservice_tpu.core.config import DukeSchema, MatchTunables
+from sesam_duke_microservice_tpu.core.records import (
+    ID_PROPERTY_NAME,
+    Property,
+    Record,
+)
+from sesam_duke_microservice_tpu.engine.ann_matcher import (
+    AnnIndex,
+    AnnProcessor,
+)
+from sesam_duke_microservice_tpu.ops import encoder as E
+
+from test_device_matcher import (
+    EventLog,
+    dedup_schema,
+    make_record,
+    random_records,
+    run_device,
+    run_host,
+)
+
+
+def run_ann(schema, batches, group_filtering=False, **index_kw):
+    index = AnnIndex(schema, tunables=MatchTunables(), **index_kw)
+    proc = AnnProcessor(schema, index, group_filtering=group_filtering)
+    log = EventLog()
+    proc.add_match_listener(log)
+    for batch in batches:
+        proc.deduplicate(batch)
+    return log, index, proc
+
+
+class TestEncoder:
+    def test_normalized_and_deterministic(self):
+        v1 = E.embed_values([("name", "acme corp"), ("city", "oslo")], 128)
+        v2 = E.embed_values([("name", "acme corp"), ("city", "oslo")], 128)
+        assert np.allclose(v1, v2)
+        assert abs(np.linalg.norm(v1) - 1.0) < 1e-5
+
+    def test_similar_strings_closer_than_different(self):
+        a = E.embed_values([("name", "acme corporation")], 256)
+        b = E.embed_values([("name", "acme corpration")], 256)   # typo
+        c = E.embed_values([("name", "globex industries")], 256)
+        assert float(a @ b) > float(a @ c)
+
+    def test_field_salting_separates_properties(self):
+        # same value in different fields must not look identical
+        a = E.embed_values([("name", "oslo")], 256)
+        b = E.embed_values([("city", "oslo")], 256)
+        assert float(a @ b) < 0.99
+
+    def test_empty_record_is_zero(self):
+        v = E.embed_values([], 64)
+        assert np.all(v == 0.0)
+
+    def test_encoder_uses_comparison_properties(self):
+        schema = dedup_schema()
+        enc = E.RecordEncoder(schema, 64)
+        assert set(enc.props) == {"name", "city", "amount"}
+        r = make_record("x", name="acme", city="oslo", amount="100")
+        assert abs(np.linalg.norm(enc.encode(r)) - 1.0) < 1e-5
+
+
+class TestAnnVsBruteForce:
+    def test_match_events_equal_exhaustive(self):
+        schema = dedup_schema()
+        records = random_records(60, seed=7)
+        device, _, _ = run_device(schema, [records])
+        ann, _, _ = run_ann(schema, [records])
+        assert ann.match_set() == device.match_set()
+        assert ann.none_set() == device.none_set()
+
+    def test_probabilities_match_host_oracle(self):
+        schema = dedup_schema()
+        records = random_records(50, seed=13)
+        host = run_host(schema, [records])
+        ann, _, _ = run_ann(schema, [records])
+        # every ANN-emitted pair must appear in the host oracle with the
+        # identical (rounded) confidence — exact rescoring, no drift
+        assert ann.match_set() <= host.match_set()
+
+    def test_multi_batch_incremental(self):
+        schema = dedup_schema()
+        b1 = random_records(30, seed=1)
+        b2 = random_records(25, seed=2)
+        for i, r in enumerate(b2):
+            r._values[ID_PROPERTY_NAME] = [f"s{i}"]
+        device, _, _ = run_device(schema, [b1, b2])
+        ann, _, _ = run_ann(schema, [b1, b2])
+        assert ann.match_set() == device.match_set()
+
+    def test_group_filtering_record_linkage(self):
+        schema = dedup_schema()
+        records = random_records(40, seed=11, with_group=True)
+        device, _, _ = run_device(schema, [records], group_filtering=True)
+        ann, _, _ = run_ann(schema, [records], group_filtering=True)
+        assert ann.match_set() == device.match_set()
+
+    def test_maybe_threshold(self):
+        schema = dedup_schema(threshold=0.92, maybe=0.6)
+        records = random_records(35, seed=3)
+        device, _, _ = run_device(schema, [records])
+        ann, _, _ = run_ann(schema, [records])
+        assert ann.match_set() == device.match_set()
+
+    def test_recall_escalation_triggers(self):
+        # tiny C forces saturation: every retrieved candidate clears the
+        # bound, so the scorer must escalate instead of truncating
+        schema = dedup_schema(threshold=0.5)
+        records = [
+            make_record(f"d{i}", name="acme corp", city="oslo", amount="100")
+            for i in range(24)
+        ]
+        ann, index, _ = run_ann(schema, [records], initial_top_c=2)
+        # all 24 identical records must match each other despite C=2 start
+        match_pairs = {(e[1], e[2]) for e in ann.events if e[0] == "match"}
+        assert len(match_pairs) == 24 * 23
+
+
+class TestAnnMutation:
+    def test_reindex_tombstones_old_row(self):
+        schema = dedup_schema()
+        r1 = make_record("a", name="acme corp", city="oslo", amount="100")
+        r2 = make_record("b", name="acme corp", city="oslo", amount="100")
+        ann, index, proc = run_ann(schema, [[r1, r2]])
+        assert ("match", "a", "b") in {e[:3] for e in ann.match_set()}
+        # re-index "a" with a different name: old row tombstoned
+        r1b = make_record("a", name="zzz qqq ww", city="bergen", amount="900")
+        proc.deduplicate([r1b])
+        log2 = EventLog()
+        proc.listeners[:] = [log2]
+        proc.deduplicate(
+            [make_record("c", name="acme corp", city="oslo", amount="100")]
+        )
+        ids = {e[2] for e in log2.match_set()}
+        assert "b" in ids and "a" not in ids
+
+    def test_deleted_records_excluded(self):
+        schema = dedup_schema()
+        r1 = make_record("a", name="acme corp", city="oslo", amount="100")
+        ann, index, proc = run_ann(schema, [[r1]])
+        index.delete(r1)
+        log2 = EventLog()
+        proc.listeners[:] = [log2]
+        proc.deduplicate(
+            [make_record("c", name="acme corp", city="oslo", amount="100")]
+        )
+        assert log2.match_set() == set()
+
+    def test_find_candidate_matches_interface(self):
+        schema = dedup_schema()
+        records = [
+            make_record("a", name="acme corp", city="oslo", amount="100"),
+            make_record("b", name="acme corpo", city="oslo", amount="100"),
+            make_record("c", name="globex industries", city="tromso",
+                        amount="1000"),
+        ]
+        _, index, _ = run_ann(schema, [records])
+        cands = index.find_candidate_matches(records[0])
+        ids = {r.record_id for r in cands}
+        assert "b" in ids and "a" not in ids
