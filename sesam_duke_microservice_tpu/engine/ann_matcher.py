@@ -148,5 +148,9 @@ class _AnnScorerCache(_ScorerCache):
 
 
 class AnnProcessor(DeviceProcessor):
-    """DeviceProcessor over an AnnIndex (alias — the processor logic is
-    identical; the index's scorer_cache supplies the ANN program)."""
+    """DeviceProcessor over an AnnIndex — the processor logic is identical
+    (the index's scorer_cache supplies the ANN program); only the profiling
+    semantics differ: pairs_compared counts rescored candidates, not the
+    whole corpus."""
+
+    exhaustive = False

@@ -511,6 +511,11 @@ class DeviceProcessor:
     host finalization of the surviving top-K pairs.
     """
 
+    # brute force scores every live corpus row with the exact comparator
+    # kernels; the ANN subclass retrieves then rescores only top-C, so its
+    # pairs_compared stat must count the rescored candidates instead
+    exhaustive = True
+
     def __init__(self, schema: DukeSchema, database: DeviceIndex, *,
                  group_filtering: bool = False, profile: bool = False,
                  threads: int = 1):
@@ -580,8 +585,17 @@ class DeviceProcessor:
                         listener.no_match_for(record)
                 self.stats.records_processed += 1
                 self.stats.candidates_retrieved += len(survivors)
-                # the device scored this query against every live corpus row
-                self.stats.pairs_compared += live_rows
+                if self.exhaustive:
+                    # the device ran the exact comparator kernels against
+                    # every live corpus row for this query
+                    self.stats.pairs_compared += live_rows
+                else:
+                    # ANN: exact kernels ran only on the retrieved top-C
+                    # (the retrieval matmul touches every row, but that is
+                    # blocking work, not pair comparison)
+                    self.stats.pairs_compared += int(
+                        (result.top_index[qi] >= 0).sum()
+                    )
             self.stats.compare_seconds += time.monotonic() - t2
 
         self.stats.batches += 1

@@ -36,13 +36,18 @@ ANN_TENSOR = "emb"
 
 _NGRAM = 3
 
-# Vectorized n-gram hashing: three odd multipliers for the codepoint window
-# plus a murmur3-style finalizer, all in wrapping uint64 numpy arithmetic —
-# the whole record hashes in a handful of array ops instead of a per-byte
-# Python loop (ingest-side hot path for large corpora).
-_H_A = np.uint64(0x9E3779B97F4A7C15)
-_H_B = np.uint64(0xC2B2AE3D27D4EB4F)
-_H_C = np.uint64(0x165667B19E3779F9)
+# Vectorized n-gram hashing: one odd multiplier per codepoint position in
+# the window plus a murmur3-style finalizer, all in wrapping uint64 numpy
+# arithmetic — the whole record hashes in a handful of array ops instead of
+# a per-byte Python loop (ingest-side hot path for large corpora).
+_H_MULT = (
+    np.uint64(0x9E3779B97F4A7C15),
+    np.uint64(0xC2B2AE3D27D4EB4F),
+    np.uint64(0x165667B19E3779F9),
+    np.uint64(0x27D4EB2F165667C5),
+    np.uint64(0x85EBCA77C2B2AE63),
+)
+assert _NGRAM <= len(_H_MULT), "add a multiplier per n-gram position"
 _FM1 = np.uint64(0xFF51AFD7ED558CCD)
 _FM2 = np.uint64(0xC4CEB9FE1A85EC53)
 
@@ -68,7 +73,10 @@ def _hash_ngrams(value: str, salt: np.uint64) -> np.ndarray:
     if cp.size < _NGRAM:
         cp = np.pad(cp, (0, _NGRAM - cp.size))
     with np.errstate(over="ignore"):
-        h = (cp[:-2] * _H_A) ^ (cp[1:-1] * _H_B) ^ (cp[2:] * _H_C) ^ salt
+        nwin = cp.size - _NGRAM + 1
+        h = salt
+        for j in range(_NGRAM):
+            h = h ^ (cp[j:j + nwin] * _H_MULT[j])
         h ^= h >> np.uint64(33)
         h *= _FM1
         h ^= h >> np.uint64(29)

@@ -9,6 +9,12 @@ the mesh axis merges the per-shard top-Ks into the global result — the
 ring-structured candidate merge sketched in SURVEY.md section 5.7.
 """
 
+from .ann_sharded import build_sharded_ann_scorer
 from .sharded import ShardedCorpus, build_sharded_scorer, corpus_mesh
 
-__all__ = ["ShardedCorpus", "build_sharded_scorer", "corpus_mesh"]
+__all__ = [
+    "ShardedCorpus",
+    "build_sharded_ann_scorer",
+    "build_sharded_scorer",
+    "corpus_mesh",
+]

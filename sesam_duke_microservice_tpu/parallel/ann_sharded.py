@@ -1,0 +1,141 @@
+"""Mesh-sharded embedding-ANN scoring: retrieval + rescoring per shard,
+merge over ICI.
+
+Scale-out of the two-stage ANN program (``ops.scoring.build_ann_scorer``)
+over a 1-D device mesh, following the same layout as the brute-force
+sharded scorer (``parallel.sharded``): corpus tensors (including the
+``ops.encoder`` embedding matrix riding as a pseudo-property) sharded on
+the record axis, queries replicated.
+
+Per-shard work is fully local: cosine top-C over the local embedding rows
+(one bf16 matmul per chunk), then exact rescoring of the local candidates —
+feature gathers never cross shards.  Only the (Q, C) scored results move:
+``all_gather`` over ICI collects every shard's (logit, global_row) pairs
+((D, Q, C) — C is tiny) and each device reduces them to the global top-C.
+Communication is O(Q * C * D) while compute scales 1/D — the candidate
+matrix never materializes anywhere, matching the design target of
+SURVEY.md §5.7 (ring/allgather sharded candidate retrieval at 10M-record
+scale, BASELINE.json configs[4]).
+
+Because every shard keeps its own local top-C before the merge, the merged
+candidate pool is a superset of the single-device pool (which keeps a
+global top-C by cosine): sharding can only improve blocking recall, never
+reduce it — asserted by ``tests/test_ann_sharded.py``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import encoder as E
+from ..ops import scoring as S
+from .sharded import SHARD_AXIS
+
+
+def build_sharded_ann_scorer(
+    plan,
+    mesh: Mesh,
+    *,
+    chunk: int = 512,
+    top_c: int = 64,
+    group_filtering: bool = False,
+) -> Callable:
+    """Like ``ops.scoring.build_ann_scorer`` but over a sharded corpus.
+
+    Signature::
+
+        fn(q_emb, qfeats, corpus_feats, corpus_valid, corpus_deleted,
+           corpus_group, query_group, query_row, min_logit)
+        -> (top_logit (Q, C), top_index (Q, C) global rows, count_sat (Q,))
+
+    ``corpus_feats`` must include the ``ops.encoder.ANN_PROP`` embedding
+    pseudo-property and be placed record-axis sharded (``ShardedCorpus``);
+    queries are replicated.  ``count_sat`` is the recall-escalation signal:
+    the max of (a) any shard's local above-``min_logit`` count (a saturated
+    local top-C may have truncated before the merge) and (b) the merged
+    pool's above-bound count (the merge itself truncates when more than
+    ``top_c`` survive).  The caller escalates when ``count_sat >= top_c``.
+    """
+    pair_logits = S.build_gathered_pair_logits(plan)
+    ndev = mesh.size
+
+    corpus_spec = P(SHARD_AXIS)
+    repl = P()
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(repl, repl, corpus_spec, corpus_spec, corpus_spec,
+                  corpus_spec, repl, repl, repl),
+        out_specs=(repl, repl, repl),
+        # scan carries start replicated and become shard-varying when local
+        # corpus data folds in; skip the varying-manual-axes typecheck
+        check_vma=False,
+    )
+    def score_shard(q_emb, qfeats, corpus_feats, corpus_valid,
+                    corpus_deleted, corpus_group, query_group, query_row,
+                    min_logit):
+        local_cap = corpus_valid.shape[0]
+        shard = lax.axis_index(SHARD_AXIS)
+        row_offset = shard.astype(jnp.int32) * jnp.int32(local_cap)
+
+        corpus_emb = corpus_feats[E.ANN_PROP][E.ANN_TENSOR]
+        feats = {
+            prop: tensors for prop, tensors in corpus_feats.items()
+            if prop != E.ANN_PROP
+        }
+
+        # stage 1: local cosine top-C (global row ids via row_offset)
+        top_sim, top_index = E.retrieval_scan(
+            q_emb, corpus_emb, corpus_valid, corpus_deleted, corpus_group,
+            query_group, query_row,
+            chunk=chunk, top_c=top_c, group_filtering=group_filtering,
+            row_offset=row_offset,
+        )
+        retrieved = top_index >= 0
+
+        # stage 2: exact rescoring of the local candidates (local gather)
+        local_rows = jnp.clip(top_index - row_offset, 0).reshape(-1)
+        q = top_index.shape[0]
+        cfeats = {
+            prop: {
+                name: jnp.take(arr, local_rows, axis=0).reshape(
+                    (q, top_c) + arr.shape[1:]
+                )
+                for name, arr in tensors.items()
+            }
+            for prop, tensors in feats.items()
+        }
+        logits = pair_logits(qfeats, cfeats)
+        logits = jnp.where(retrieved, logits, S.NEG_INF)
+        local_count = (logits > min_logit).sum(axis=1).astype(jnp.int32)
+
+        # merge: (D, Q, C) gathered over ICI, reduced to global top-C
+        all_logit = lax.all_gather(logits, SHARD_AXIS)
+        all_index = lax.all_gather(top_index, SHARD_AXIS)
+        merged_logit = jnp.transpose(all_logit, (1, 0, 2)).reshape(
+            q, ndev * top_c
+        )
+        merged_index = jnp.transpose(all_index, (1, 0, 2)).reshape(
+            q, ndev * top_c
+        )
+        out_logit, sel = lax.top_k(merged_logit, top_c)
+        out_index = jnp.take_along_axis(merged_index, sel, axis=1)
+        # escalation signal must see BOTH truncation modes: a shard whose
+        # local top-C saturated (may have dropped above-bound rows before
+        # the merge), and a merged pool with more above-bound rows than the
+        # merge keeps (indices are unique across shards, so counting the
+        # merged pool counts each candidate once)
+        merged_above = (merged_logit > min_logit).sum(axis=1).astype(jnp.int32)
+        count_sat = jnp.maximum(
+            lax.pmax(local_count, SHARD_AXIS), merged_above
+        )
+        return out_logit, out_index, count_sat
+
+    return jax.jit(score_shard)
