@@ -122,6 +122,11 @@ def device_pairs_per_sec(schema, corpus_records, query_records) -> float:
         DeviceIndex,
         DeviceProcessor,
     )
+    from sesam_duke_microservice_tpu.utils.jit_cache import (
+        enable_persistent_cache,
+    )
+
+    enable_persistent_cache()
 
     index = DeviceIndex(schema)
     proc = DeviceProcessor(schema, index)

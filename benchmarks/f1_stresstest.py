@@ -1,0 +1,274 @@
+"""Dedup F1 stresstest harness: seeded corpus with known ground truth.
+
+The reference validates matching quality only through the external Sesam
+stresstest pipes (sesam_node_deduplication_stresstest_config.conf.json:
+86-106 — 10,000 fake entities per source, seed 1234, value pools sized so
+duplicates occur at a known rate, SURVEY.md section 4).  This harness is
+the in-process equivalent with a *measurable* ground truth: every record
+derives from a true underlying identity, field values are perturbed with
+seeded noise (typos, digit swaps, missing fields), and two records are true
+duplicates iff they share the identity.  That turns the BASELINE.json
+metric ("dedup F1 @ fixed wall-clock") into a number.
+
+Usage::
+
+    python benchmarks/f1_stresstest.py [--backend host|device|ann]
+        [--entities 2000] [--dup-rate 0.3] [--batch 500]
+
+Prints one JSON line: {"backend", "f1", "precision", "recall",
+"wall_s", "records_per_sec", "true_pairs", "emitted_pairs"}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import random
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+FIRST = ["ole", "kari", "per", "anne", "nils", "ingrid", "lars", "berit",
+         "jan", "liv", "arne", "astrid", "knut", "solveig", "odd", "randi",
+         "gunnar", "turid", "leif", "marit"]
+LAST = ["hansen", "johansen", "olsen", "larsen", "andersen", "pedersen",
+        "nilsen", "kristiansen", "jensen", "karlsen", "johnsen", "pettersen",
+        "eriksen", "berg", "haugen", "hagen"]
+CITIES = ["oslo", "bergen", "trondheim", "stavanger", "tromso", "drammen",
+          "fredrikstad", "kristiansand", "sandnes", "sarpsborg"]
+
+
+def _typo(rng: random.Random, s: str) -> str:
+    if len(s) < 2:
+        return s
+    op = rng.randrange(3)
+    pos = rng.randrange(len(s))
+    if op == 0:    # substitute
+        return s[:pos] + rng.choice("abcdefghijklmnop") + s[pos + 1:]
+    if op == 1:    # delete
+        return s[:pos] + s[pos + 1:]
+    return s[:pos] + rng.choice("abcdefghijklmnop") + s[pos:]  # insert
+
+
+_SYL = ["ba", "be", "bo", "da", "de", "di", "ga", "go", "ha", "he", "jo",
+        "ka", "ke", "ko", "la", "le", "li", "ma", "me", "mo", "na", "ne",
+        "no", "ra", "re", "ro", "sa", "se", "so", "ta", "te", "to", "va",
+        "ve", "vi"]
+
+
+def _surname(rng: random.Random) -> str:
+    # syllable-generated surnames: enough entropy that coincidental
+    # full-name collisions between DIFFERENT identities stay rare at 10k+
+    # scale (the fixed LAST pool saturates and poisons precision with
+    # generator artifacts rather than matcher errors)
+    return "".join(rng.choice(_SYL) for _ in range(rng.randint(2, 4))) + \
+        rng.choice(["sen", "berg", "vik", "dal", "nes", "stad"])
+
+
+def generate(n_entities: int, dup_rate: float, seed: int = 1234):
+    """Seeded corpus: ``n_entities`` records over ~n*(1-dup_rate) identities.
+
+    Returns (records_as_dicts, truth) where truth maps record _id -> true
+    identity.  Mirrors the reference stresstest's seeded-pool construction
+    but with derived (not independent) fields so duplicate pairs are
+    *near*-duplicates the comparators must actually work for.
+    """
+    rng = random.Random(seed)
+    n_identities = max(1, int(n_entities * (1.0 - dup_rate)))
+    identities = {}
+    for ident in range(n_identities):
+        identities[ident] = {
+            "name": f"{rng.choice(FIRST)} {_surname(rng)}",
+            "city": rng.choice(CITIES),
+            "ssn": str(rng.randint(10_000_000, 99_999_999)),
+        }
+    rows, truth = [], {}
+    for i in range(n_entities):
+        # first n_identities records cover every identity once; the rest
+        # are duplicates of a random identity with perturbed fields
+        ident = i if i < n_identities else rng.randrange(n_identities)
+        base = identities[ident]
+        name, city, ssn = base["name"], base["city"], base["ssn"]
+        if i >= n_identities:
+            if rng.random() < 0.5:
+                name = _typo(rng, name)
+            if rng.random() < 0.2:
+                name = _typo(rng, name)
+            if rng.random() < 0.15:   # one digit wrong
+                pos = rng.randrange(len(ssn))
+                ssn = ssn[:pos] + str(rng.randrange(10)) + ssn[pos + 1:]
+        rid = f"e{i}"
+        rows.append({"_id": rid, "name": name, "city": city, "ssn": ssn})
+        truth[rid] = ident
+    return rows, truth
+
+
+def truth_pairs(truth):
+    by_ident = defaultdict(list)
+    for rid, ident in truth.items():
+        by_ident[ident].append(rid)
+    pairs = set()
+    for members in by_ident.values():
+        for a, b in itertools.combinations(sorted(members), 2):
+            pairs.add((a, b))
+    return pairs
+
+
+def stresstest_schema():
+    from sesam_duke_microservice_tpu.core import comparators as C
+    from sesam_duke_microservice_tpu.core.config import DukeSchema
+    from sesam_duke_microservice_tpu.core.records import (
+        ID_PROPERTY_NAME,
+        Property,
+    )
+
+    return DukeSchema(
+        threshold=0.8,
+        maybe_threshold=None,
+        properties=[
+            Property(ID_PROPERTY_NAME, id_property=True),
+            Property("name", C.Levenshtein(), 0.25, 0.85),
+            Property("city", C.Exact(), 0.45, 0.65),
+            Property("ssn", C.QGram(), 0.2, 0.9),
+        ],
+        data_sources=[],
+    )
+
+
+class PairCollector:
+    def __init__(self):
+        self.pairs = {}
+
+    def batch_ready(self, n):
+        pass
+
+    def batch_done(self):
+        pass
+
+    def matches(self, r1, r2, confidence):
+        a, b = sorted((r1.record_id, r2.record_id))
+        self.pairs[(a, b)] = confidence
+
+    def matches_perhaps(self, r1, r2, confidence):
+        pass
+
+    def no_match_for(self, record):
+        pass
+
+
+def build_processor(schema, backend: str):
+    from sesam_duke_microservice_tpu.core.config import MatchTunables
+
+    if backend in ("device", "ann"):
+        from sesam_duke_microservice_tpu.utils.jit_cache import (
+            enable_persistent_cache,
+        )
+
+        enable_persistent_cache()
+    if backend == "device":
+        from sesam_duke_microservice_tpu.engine.device_matcher import (
+            DeviceIndex,
+            DeviceProcessor,
+        )
+
+        index = DeviceIndex(schema, tunables=MatchTunables())
+        return DeviceProcessor(schema, index)
+    if backend == "ann":
+        from sesam_duke_microservice_tpu.engine.ann_matcher import (
+            AnnIndex,
+            AnnProcessor,
+        )
+
+        index = AnnIndex(schema, tunables=MatchTunables())
+        return AnnProcessor(schema, index)
+    from sesam_duke_microservice_tpu.engine.processor import Processor
+    from sesam_duke_microservice_tpu.index.inverted import InvertedIndex
+
+    index = InvertedIndex(schema, MatchTunables())
+    return Processor(schema, index)
+
+
+def to_records(rows):
+    from sesam_duke_microservice_tpu.core.records import (
+        DATASET_ID_PROPERTY_NAME,
+        ID_PROPERTY_NAME,
+        ORIGINAL_ENTITY_ID_PROPERTY_NAME,
+        Record,
+    )
+
+    records = []
+    for row in rows:
+        r = Record()
+        r.add_value(ID_PROPERTY_NAME, f"ds__{row['_id']}")
+        r.add_value(ORIGINAL_ENTITY_ID_PROPERTY_NAME, row["_id"])
+        r.add_value(DATASET_ID_PROPERTY_NAME, "ds")
+        for k in ("name", "city", "ssn"):
+            r.add_value(k, row[k])
+        records.append(r)
+    return records
+
+
+def run(backend: str, n_entities: int, dup_rate: float, batch: int,
+        seed: int = 1234):
+    rows, truth = generate(n_entities, dup_rate, seed)
+    records = to_records(rows)
+    schema = stresstest_schema()
+    proc = build_processor(schema, backend)
+    collector = PairCollector()
+    proc.add_match_listener(collector)
+
+    t0 = time.perf_counter()
+    for start in range(0, len(records), batch):
+        proc.deduplicate(records[start:start + batch])
+    wall = time.perf_counter() - t0
+
+    stats = getattr(proc, "stats", None)
+
+    emitted = {
+        (a.split("__", 1)[1], b.split("__", 1)[1])
+        for a, b in collector.pairs
+    }
+    expected = truth_pairs(truth)
+    tp = len(emitted & expected)
+    precision = tp / len(emitted) if emitted else 0.0
+    recall = tp / len(expected) if expected else 1.0
+    f1 = (2 * precision * recall / (precision + recall)
+          if precision + recall else 0.0)
+    out = {
+        "backend": backend,
+        "f1": round(f1, 4),
+        "precision": round(precision, 4),
+        "recall": round(recall, 4),
+        "wall_s": round(wall, 2),
+        "records_per_sec": round(len(records) / wall, 1),
+        "true_pairs": len(expected),
+        "emitted_pairs": len(emitted),
+    }
+    if stats is not None:
+        out["retrieval_s"] = round(stats.retrieval_seconds, 2)
+        out["compare_s"] = round(stats.compare_seconds, 2)
+        out["pairs_compared"] = stats.pairs_compared
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", default="host",
+                    choices=["host", "device", "ann"])
+    ap.add_argument("--entities", type=int, default=2000)
+    ap.add_argument("--dup-rate", type=float, default=0.3)
+    ap.add_argument("--batch", type=int, default=500)
+    ap.add_argument("--seed", type=int, default=1234)
+    args = ap.parse_args()
+    print(json.dumps(
+        run(args.backend, args.entities, args.dup_rate, args.batch,
+            args.seed)
+    ))
+
+
+if __name__ == "__main__":
+    main()

@@ -556,12 +556,15 @@ class DeviceProcessor:
         live_rows = int(corpus.row_valid.sum() - corpus.row_deleted[
             corpus.row_valid].sum())
 
+        from ..utils.profiling import trace_batch
+
         for start in range(0, len(records), _QUERY_BUCKETS[-1]):
             block = records[start:start + _QUERY_BUCKETS[-1]]
             t1 = time.monotonic()
-            result = self._scorers.score_block(
-                block, group_filtering=self.group_filtering
-            )
+            with trace_batch(f"score_block[{len(block)}]"):
+                result = self._scorers.score_block(
+                    block, group_filtering=self.group_filtering
+                )
             t2 = time.monotonic()
             self.stats.retrieval_seconds += t2 - t1
 

@@ -84,6 +84,11 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.duke_lev_distance.argtypes = [u32p, ctypes.c_int64, u32p,
                                           ctypes.c_int64]
         lib.duke_lev_distance.restype = ctypes.c_int64
+        lib.duke_embed_batch.argtypes = [
+            u32p, i64p, ctypes.POINTER(ctypes.c_uint64), i64p,
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float)]
+        lib.duke_embed_batch.restype = None
         # scalar entry points take the UTF-32 bytes object directly
         # (c_char_p), skipping numpy packing
         cc = ctypes.c_char_p
@@ -166,6 +171,31 @@ def weighted_lev_batch(a: Sequence[str], b: Sequence[str], *,
     lib.duke_weighted_lev_batch(*_ptrs(abuf, aoff), *_ptrs(bbuf, boff),
                                 len(a), digit_weight, letter_weight,
                                 other_weight, out.ctypes.data_as(_F64P))
+    return out
+
+
+def embed_batch(value_strings: Sequence[str], salts: np.ndarray,
+                rec_off: np.ndarray, dim: int) -> np.ndarray:
+    """Hashed-n-gram record embeddings (ops.encoder parity, bulk).
+
+    ``value_strings`` are the already padded+lowercased per-value strings
+    (concatenated across records), ``salts`` the per-value uint64 property
+    salts, ``rec_off`` the int64 record->value-range offsets (n_rec+1).
+    Returns (n_rec, dim) float32, rows L2-normalized.
+    """
+    lib = _load()
+    assert lib is not None
+    buf, off = _pack(value_strings)
+    salts = np.ascontiguousarray(salts, dtype=np.uint64)
+    rec_off = np.ascontiguousarray(rec_off, dtype=np.int64)
+    n_rec = len(rec_off) - 1
+    out = np.zeros((n_rec, dim), dtype=np.float32)
+    lib.duke_embed_batch(
+        *_ptrs(buf, off),
+        salts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        rec_off.ctypes.data_as(_I64P), n_rec, dim,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
     return out
 
 

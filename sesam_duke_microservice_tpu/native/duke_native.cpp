@@ -19,6 +19,7 @@
 
 #include <algorithm>
 #include <cstdint>
+#include <cmath>
 #include <cstring>
 #include <unordered_map>
 #include <vector>
@@ -200,9 +201,77 @@ double weighted_lev_sim(const uint32_t* a, int64_t na, const uint32_t* b,
     return 1.0 - dist / shorter;
 }
 
+// -- hashed-n-gram record embeddings (ops/encoder.py parity) ----------------
+// Trigram window hashing with the exact constants of the Python/numpy path
+// (ops.encoder._H_MULT/_FM1/_FM2): one odd multiplier per window position,
+// xor'd with a per-(property)-salt, then a murmur3-style finalizer.  The
+// Python implementation is the parity oracle (tests/test_native.py).
+
+constexpr uint64_t kEmbMult0 = 0x9E3779B97F4A7C15ULL;
+constexpr uint64_t kEmbMult1 = 0xC2B2AE3D27D4EB4FULL;
+constexpr uint64_t kEmbMult2 = 0x165667B19E3779F9ULL;
+constexpr uint64_t kEmbFm1 = 0xFF51AFD7ED558CCDULL;
+constexpr uint64_t kEmbFm2 = 0xC4CEB9FE1A85EC53ULL;
+
+inline uint64_t emb_fmix(uint64_t h) {
+    h ^= h >> 33;
+    h *= kEmbFm1;
+    h ^= h >> 29;
+    h *= kEmbFm2;
+    h ^= h >> 32;
+    return h;
+}
+
 }  // namespace
 
 extern "C" {
+
+// One embedding per record.  cp_buf holds the concatenated (already padded
+// + lowercased, see ops.encoder) codepoints of every value; val_off[v] /
+// val_off[v+1] bound value v; salts[v] is its property salt; rec_off[r]
+// bounds record r's value range.  out is (n_rec, dim) float32, L2
+// normalized per row.
+void duke_embed_batch(const uint32_t* cp_buf, const int64_t* val_off,
+                      const uint64_t* salts, const int64_t* rec_off,
+                      int64_t n_rec, int64_t dim, float* out) {
+    std::unordered_map<uint64_t, int64_t> counts;
+    std::vector<uint32_t> tiny;
+    for (int64_t r = 0; r < n_rec; ++r) {
+        counts.clear();
+        for (int64_t v = rec_off[r]; v < rec_off[r + 1]; ++v) {
+            const uint32_t* cp = cp_buf + val_off[v];
+            int64_t len = val_off[v + 1] - val_off[v];
+            if (len < 3) {  // zero-pad to one window (numpy np.pad parity)
+                tiny.assign(3, 0);
+                for (int64_t i = 0; i < len; ++i) tiny[i] = cp[i];
+                cp = tiny.data();
+                len = 3;
+            }
+            const uint64_t salt = salts[v];
+            for (int64_t i = 0; i + 2 < len; ++i) {
+                uint64_t h = salt;
+                h ^= static_cast<uint64_t>(cp[i]) * kEmbMult0;
+                h ^= static_cast<uint64_t>(cp[i + 1]) * kEmbMult1;
+                h ^= static_cast<uint64_t>(cp[i + 2]) * kEmbMult2;
+                ++counts[emb_fmix(h)];
+            }
+        }
+        float* vec = out + r * dim;
+        std::fill(vec, vec + dim, 0.0f);
+        double sq = 0.0;
+        for (const auto& kv : counts) {
+            const uint64_t h = kv.first;
+            const int64_t bucket = static_cast<int64_t>(h % static_cast<uint64_t>(dim));
+            const float sign = ((h >> 32) & 1ULL) ? 1.0f : -1.0f;
+            vec[bucket] += sign * std::sqrt(static_cast<float>(kv.second));
+        }
+        for (int64_t d = 0; d < dim; ++d) sq += static_cast<double>(vec[d]) * vec[d];
+        if (sq > 0.0) {
+            const float inv = static_cast<float>(1.0 / std::sqrt(sq));
+            for (int64_t d = 0; d < dim; ++d) vec[d] *= inv;
+        }
+    }
+}
 
 void duke_lev_sim_batch(const uint32_t* a_buf, const int64_t* a_off,
                         const uint32_t* b_buf, const int64_t* b_off,

@@ -27,6 +27,10 @@ def main() -> None:
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
     )
+    if args.backend in ("device", "ann"):
+        from ..utils.jit_cache import enable_persistent_cache
+
+        enable_persistent_cache()
     app = create_app(backend=args.backend, persistent=not args.ephemeral)
     server = serve(app, port=args.port, host=args.host)
     logging.getLogger("duke-tpu-service").info(

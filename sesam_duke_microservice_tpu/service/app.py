@@ -183,6 +183,10 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                 self._reply(200, render_homepage(self.app).encode("utf-8"), "text/html")
             elif path == "/config":
                 self._reply(200, self.app.config_string.encode("utf-8"), "application/xml")
+            elif path == "/health":
+                self._reply(200, b'{"status": "ok"}', "application/json")
+            elif path == "/stats":
+                self._handle_stats()
             elif m := _ENTITY_PATH.match(path):
                 self._validate_entity_path(m)
                 raise _HttpError(405, "This endpoint only supports POST requests.")
@@ -215,6 +219,37 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
             self._reply_text(500, "Internal server error")
 
     # -- handlers -----------------------------------------------------------
+
+    def _handle_stats(self):
+        """Observability endpoint (new in this build — the reference has no
+        metrics/health surface, SURVEY.md section 5.5): per-workload
+        ProfileStats counters plus corpus sizes."""
+        out = {"backend": self.app.backend, "workloads": []}
+        for kind, registry in (
+            ("deduplication", self.app.deduplications),
+            ("recordlinkage", self.app.record_linkages),
+        ):
+            for name, wl in registry.items():
+                stats = getattr(wl.processor, "stats", None)
+                corpus = getattr(wl.index, "corpus", None)
+                row = {
+                    "kind": kind,
+                    "name": name,
+                    "records_indexed": (
+                        corpus.size if corpus is not None else len(wl.index)
+                    ),
+                }
+                if stats is not None:
+                    row.update(
+                        batches=stats.batches,
+                        records_processed=stats.records_processed,
+                        candidates_retrieved=stats.candidates_retrieved,
+                        pairs_compared=stats.pairs_compared,
+                        retrieval_seconds=round(stats.retrieval_seconds, 3),
+                        compare_seconds=round(stats.compare_seconds, 3),
+                    )
+                out["workloads"].append(row)
+        self._reply(200, json.dumps(out).encode("utf-8"), "application/json")
 
     def _workloads(self, kind: str) -> Dict[str, Workload]:
         return (self.app.deduplications if kind == "deduplication"

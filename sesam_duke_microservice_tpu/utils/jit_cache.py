@@ -1,0 +1,42 @@
+"""Persistent XLA compilation cache.
+
+The matcher's jitted programs recompile on corpus-capacity growth and
+candidate-K escalation (O(log N) distinct shapes over a corpus's lifetime,
+engine.device_matcher).  On TPU each compile costs tens of seconds, which
+dominates cold-start and first-contact-with-new-shape latency.  Enabling
+jax's persistent compilation cache amortizes that across process restarts —
+the service counterpart of the reference reopening its Lucene index in
+APPEND mode instead of rebuilding (IncrementalLuceneDatabase.java:233-244),
+applied to compiled programs instead of data.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger("jit-cache")
+
+_DEFAULT = os.path.join(
+    os.path.expanduser("~"), ".cache", "sesam_duke_tpu_xla"
+)
+
+
+def enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point jax at an on-disk compilation cache; returns the path used.
+
+    Safe to call multiple times; a failure (read-only fs, old jax) only
+    logs — the cache is an optimization, never a requirement.
+    """
+    import jax
+
+    path = path or os.environ.get("JAX_COMPILATION_CACHE_DIR") or _DEFAULT
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        return path
+    except Exception as exc:  # pragma: no cover - depends on fs/jax version
+        logger.warning("persistent compilation cache disabled: %s", exc)
+        return None

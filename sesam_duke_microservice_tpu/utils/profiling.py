@@ -1,0 +1,79 @@
+"""Device-timeline tracing hooks (the TPU equivalent of the reference's
+``PROFILE=1`` -> ``Processor.setPerformanceProfiling`` per-phase timing,
+App.java:239-244,345,466 — SURVEY.md section 5.1).
+
+Two levels:
+
+  * ``PROFILE=1`` — per-batch wall-clock logs + ProfileStats counters
+    (engine.processor / engine.device_matcher), mirroring the reference's
+    listener-level logging (IncrementalRecordLinkageMatchListener.java:42-52).
+  * ``PROFILE_TRACE_DIR=/path`` — additionally capture ``jax.profiler``
+    traces (XLA op timeline, HBM usage, fusion view in TensorBoard /
+    xprof) for the first ``PROFILE_TRACE_BATCHES`` (default 3) scoring
+    batches.  Bounded by default: traces are large and the service is
+    long-running.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+
+logger = logging.getLogger("profiling")
+
+_lock = threading.Lock()
+_traced_batches = 0
+
+
+def trace_dir() -> str:
+    return os.environ.get("PROFILE_TRACE_DIR", "")
+
+
+def _trace_budget() -> int:
+    try:
+        return int(os.environ.get("PROFILE_TRACE_BATCHES", "3"))
+    except ValueError:
+        return 3
+
+
+@contextlib.contextmanager
+def trace_batch(label: str):
+    """Wrap one scoring batch in a jax.profiler trace when enabled.
+
+    No-op unless ``PROFILE_TRACE_DIR`` is set and the trace budget has not
+    been spent.  Only profiler setup/teardown failures are swallowed (they
+    log) — exceptions from the traced block itself propagate untouched, and
+    tracing must never take down a batch.
+    """
+    global _traced_batches
+    directory = trace_dir()
+    if not directory:
+        yield
+        return
+    with _lock:
+        if _traced_batches >= _trace_budget():
+            yield
+            return
+        _traced_batches += 1
+        n = _traced_batches
+    stack = contextlib.ExitStack()
+    try:
+        import jax
+
+        stack.enter_context(jax.profiler.trace(directory))
+        stack.enter_context(jax.profiler.TraceAnnotation(label))
+    except Exception:
+        logger.exception("device trace setup failed (batch continues)")
+    try:
+        yield
+    finally:
+        try:
+            stack.close()
+            logger.info("captured device trace %d/%d (%s) into %s",
+                        n, _trace_budget(), label, directory)
+        except Exception:
+            logger.exception(
+                "device trace teardown failed (batch continues)"
+            )

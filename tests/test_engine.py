@@ -234,3 +234,36 @@ def test_max_search_hits_caps_search(dedup_workload):
     # search cap limits candidates per record, so matching still works but
     # each record saw at most 3 candidates
     assert wl.processor.stats.candidates_retrieved <= 3 * 8
+
+
+def test_trace_batch_noop_and_budget(tmp_path, monkeypatch):
+    from sesam_duke_microservice_tpu.utils import profiling
+
+    # disabled: plain passthrough
+    monkeypatch.delenv("PROFILE_TRACE_DIR", raising=False)
+    with profiling.trace_batch("x"):
+        pass
+
+    # enabled: captures up to the budget, then passes through
+    monkeypatch.setenv("PROFILE_TRACE_DIR", str(tmp_path))
+    monkeypatch.setenv("PROFILE_TRACE_BATCHES", "1")
+    monkeypatch.setattr(profiling, "_traced_batches", 0)
+    import jax.numpy as jnp
+
+    with profiling.trace_batch("batch-one"):
+        jnp.zeros((4,)).block_until_ready()
+    with profiling.trace_batch("batch-two"):   # over budget: no-op
+        pass
+    assert profiling._traced_batches == 1
+    assert any(tmp_path.iterdir()), "trace directory should be populated"
+
+
+def test_trace_batch_propagates_body_exceptions(tmp_path, monkeypatch):
+    from sesam_duke_microservice_tpu.utils import profiling
+
+    monkeypatch.setenv("PROFILE_TRACE_DIR", str(tmp_path))
+    monkeypatch.setenv("PROFILE_TRACE_BATCHES", "5")
+    monkeypatch.setattr(profiling, "_traced_batches", 0)
+    with pytest.raises(ValueError, match="real scoring error"):
+        with profiling.trace_batch("failing"):
+            raise ValueError("real scoring error")

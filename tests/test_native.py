@@ -8,6 +8,7 @@ lengths crossing the Myers 64-codepoint boundary.
 
 import random
 
+import numpy as np
 import pytest
 
 from sesam_duke_microservice_tpu import native
@@ -146,3 +147,29 @@ def test_native_handles_lone_surrogates():
 
     if native.available():
         assert native.lev_sim(s1, s2) == pytest.approx(want_lev)
+
+
+def test_embed_batch_matches_numpy_oracle():
+    from sesam_duke_microservice_tpu import native
+    from sesam_duke_microservice_tpu.ops import encoder as E
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+
+    from test_device_matcher import dedup_schema, random_records
+
+    schema = dedup_schema()
+    enc = E.RecordEncoder(schema, 128)
+    records = random_records(120, seed=9)
+    # unicode + empty-field coverage
+    records[0]._values["name"] = ["åse blåbærsyltetøy 中文"]
+    records[1]._values["name"] = [""]
+
+    nat = enc.encode_batch(records)
+    saved = E._native_embed
+    try:
+        E._native_embed = lambda: None
+        ref = enc.encode_batch(records)
+    finally:
+        E._native_embed = saved
+    np.testing.assert_allclose(nat, ref, atol=1e-6)

@@ -294,3 +294,27 @@ def test_deleted_entity_retraction_over_http(server_url):
     _, _, body = request(server_url + "/deduplication/people?since=0")
     link_rows = [r for r in json.loads(body) if "d1" in r["_id"]]
     assert link_rows[0]["_deleted"] is True
+
+
+def test_health_endpoint(server_url):
+    status, _, body = request(f"{server_url}/health")
+    assert status == 200
+    assert json.loads(body) == {"status": "ok"}
+
+
+def test_stats_endpoint(server_url):
+    # ingest one batch so the counters move
+    post_json(f"{server_url}/deduplication/people/crm",
+              [{"_id": "st1", "name": "Stats Person", "email": "s@x.no"}])
+    status, _, body = request(f"{server_url}/stats")
+    assert status == 200
+    payload = json.loads(body)
+    assert payload["backend"] in ("host", "device", "ann")
+    names = {(w["kind"], w["name"]) for w in payload["workloads"]}
+    assert ("deduplication", "people") in names
+    assert ("recordlinkage", "pairing") in names
+    people = next(w for w in payload["workloads"]
+                  if w["name"] == "people")
+    assert people["records_indexed"] >= 1
+    assert people["batches"] >= 1
+    assert people["records_processed"] >= 1
