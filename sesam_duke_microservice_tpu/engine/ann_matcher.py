@@ -88,14 +88,15 @@ class _AnnScorerCache(_ScorerCache):
     """Caches jitted ANN scorers per (top_c, group_filtering) and runs the
     recall-escalation loop."""
 
-    def _scorer(self, top_c: int, group_filtering: bool):
+    def _scorer(self, top_c: int, group_filtering: bool,
+                from_rows: bool = False):
         from ..ops import scoring as S
 
-        key = (top_c, group_filtering)
+        key = (top_c, group_filtering, from_rows)
         if key not in self._scorers:
             self._scorers[key] = S.build_ann_scorer(
                 self.index.plan, chunk=_CHUNK, top_c=top_c,
-                group_filtering=group_filtering,
+                group_filtering=group_filtering, queries_from_rows=from_rows,
             )
         return self._scorers[key]
 
@@ -115,10 +116,15 @@ class _AnnScorerCache(_ScorerCache):
                 np.full((n, 1), -1, np.int32), min_logit,
             )
 
-        qfeats, query_row_j, query_group_j = self._prepare_queries(
+        qfeats, from_rows, query_row_j, query_group_j = self._prepare_queries(
             records, group_filtering
         )
-        q_emb = qfeats.pop(E.ANN_PROP)[E.ANN_TENSOR]
+        if from_rows:
+            # gathered on device by the scorer; placeholder keeps the jit
+            # signature stable for the cached from_rows variant
+            q_emb = jnp.float32(0.0)
+        else:
+            q_emb = qfeats.pop(E.ANN_PROP)[E.ANN_TENSOR]
 
         cfeats_all, cvalid, cdeleted, cgroup = corpus.device_arrays()
         corpus_emb = cfeats_all[E.ANN_PROP][E.ANN_TENSOR]
@@ -130,7 +136,7 @@ class _AnnScorerCache(_ScorerCache):
         top_c = index.initial_top_c
         while True:
             c = min(top_c, corpus.capacity)
-            scorer = self._scorer(c, group_filtering)
+            scorer = self._scorer(c, group_filtering, from_rows)
             top_logit, top_index, count = scorer(
                 q_emb, qfeats, corpus_emb, corpus_feats, cvalid, cdeleted,
                 cgroup, query_group_j, query_row_j, jnp.float32(min_logit),

@@ -181,14 +181,19 @@ class DeviceCorpus:
                 bucket *= 2
             bucket = min(bucket, self.capacity)
             start = min(start, self.capacity - bucket)
-            self._device = {
+            # ONE jitted call updates the whole tree (donated buffers):
+            # per-tensor dispatch would pay the device-link round-trip
+            # once per tensor per commit
+            upd = {
                 prop: {
-                    name: _updated_rows(dev, self.feats[prop][name], start,
-                                        bucket)
-                    for name, dev in tensors.items()
+                    name: arr[start:start + bucket]
+                    for name, arr in tensors.items()
                 }
-                for prop, tensors in self._device.items()
+                for prop, tensors in self.feats.items()
             }
+            self._device = _tree_updater()(
+                self._device, upd, np.int32(start)
+            )
             self._pending_update = None
         if self._mask_device is None or self._dirty_masks:
             self._mask_device = (
@@ -201,31 +206,31 @@ class DeviceCorpus:
         return self._device, valid, deleted, group
 
 
-def _updated_rows(dev, host_arr: np.ndarray, start: int, bucket: int):
-    """In-place-update rows [start, start+bucket) of a device array from the
-    host mirror.  Donation lets XLA reuse the existing device buffer."""
-    upd = host_arr[start:start + bucket]
-    return _row_updater(dev.dtype, dev.ndim)(dev, upd, np.int32(start))
+_TREE_UPDATER = None
 
 
-_ROW_UPDATERS: Dict = {}
+def _tree_updater():
+    """Jitted whole-tree row updater: one device dispatch per commit.
 
+    ``start`` stays a traced scalar (one compile per tree-structure/shape
+    combination, not per update position); donation lets XLA reuse every
+    existing device buffer in place.
+    """
+    global _TREE_UPDATER
+    if _TREE_UPDATER is None:
+        import jax
+        from jax import lax
 
-def _row_updater(dtype, ndim):
-    import jax
-    from jax import lax
-
-    key = (str(dtype), ndim)
-    if key not in _ROW_UPDATERS:
-        # start stays a traced scalar: one compile per (dtype, rank, shapes),
-        # not per update position
-        _ROW_UPDATERS[key] = jax.jit(
-            lambda dev, upd, start: lax.dynamic_update_slice_in_dim(
-                dev, upd, start, axis=0
+        _TREE_UPDATER = jax.jit(
+            lambda dev, upd, start: jax.tree_util.tree_map(
+                lambda d, u: lax.dynamic_update_slice_in_dim(
+                    d, u, start, axis=0
+                ),
+                dev, upd,
             ),
             donate_argnums=(0,),
         )
-    return _ROW_UPDATERS[key]
+    return _TREE_UPDATER
 
 
 def _grow_1d(arr: np.ndarray, cap: int, fill) -> np.ndarray:
@@ -382,14 +387,15 @@ class _ScorerCache:
         self.index = index
         self._scorers: Dict[Tuple[int, bool], object] = {}
 
-    def _scorer(self, top_k: int, group_filtering: bool):
+    def _scorer(self, top_k: int, group_filtering: bool,
+                from_rows: bool = False):
         from ..ops import scoring as S
 
-        key = (top_k, group_filtering)
+        key = (top_k, group_filtering, from_rows)
         if key not in self._scorers:
             self._scorers[key] = S.build_corpus_scorer(
                 self.index.plan, chunk=_CHUNK, top_k=top_k,
-                group_filtering=group_filtering,
+                group_filtering=group_filtering, queries_from_rows=from_rows,
             )
         return self._scorers[key]
 
@@ -410,35 +416,33 @@ class _ScorerCache:
 
     def _prepare_queries(self, records: Sequence[Record],
                          group_filtering: bool):
-        """Query-side arrays for a block: (qfeats device tree, padded to the
-        query bucket; query_row; query_group)."""
+        """Query-side arrays for a block: (qfeats device tree or {} when the
+        scorer gathers on device, from_rows flag, query_row, query_group)."""
         import jax.numpy as jnp
 
         index = self.index
-        corpus = index.corpus
         bucket = _bucket_for(len(records))
         # (a block larger than the biggest bucket is split by the caller)
         rows = [index.id_to_row.get(r.record_id, -1) for r in records]
-        if all(row >= 0 for row in rows):
+        from_rows = all(row >= 0 for row in rows)
+        if from_rows:
             # normal dedup/linkage path: the batch was just indexed, so its
-            # features already sit in the corpus host mirror — gather rows
-            # instead of re-running per-character extraction (the dominant
-            # host cost)
-            rows_np = np.asarray(rows)
-            qfeats_np = {
-                prop: {name: arr[rows_np] for name, arr in tensors.items()}
-                for prop, tensors in corpus.feats.items()
-            }
+            # features already sit on device in the corpus tensors — the
+            # scorer gathers them there from query_row, and the only
+            # query-side upload is the row-index array (host->device
+            # traffic is the dominant steady-state cost over a
+            # high-latency device link)
+            qfeats = {}
         else:
             # http-transform: queries are not in the corpus
             qfeats_np = index._extract(records)
-        qfeats = {
-            prop: {
-                name: jnp.asarray(_pad_rows(arr, bucket))
-                for name, arr in tensors.items()
+            qfeats = {
+                prop: {
+                    name: jnp.asarray(_pad_rows(arr, bucket))
+                    for name, arr in tensors.items()
+                }
+                for prop, tensors in qfeats_np.items()
             }
-            for prop, tensors in qfeats_np.items()
-        }
         query_row = np.full((bucket,), -1, dtype=np.int32)
         query_group = np.full((bucket,), -2, dtype=np.int32)
         for i, r in enumerate(records):
@@ -451,7 +455,8 @@ class _ScorerCache:
                     "or empty!"
                 )
             query_group[i] = int(group_no) if group_no else -2
-        return qfeats, jnp.asarray(query_row), jnp.asarray(query_group)
+        return (qfeats, from_rows, jnp.asarray(query_row),
+                jnp.asarray(query_group))
 
     def score_block(self, records: Sequence[Record], *,
                     group_filtering: bool) -> _BlockResult:
@@ -469,7 +474,7 @@ class _ScorerCache:
                 np.full((n, 1), -1, np.int32), min_logit,
             )
 
-        qfeats, query_row_j, query_group_j = self._prepare_queries(
+        qfeats, from_rows, query_row_j, query_group_j = self._prepare_queries(
             records, group_filtering
         )
 
@@ -477,7 +482,7 @@ class _ScorerCache:
         top_k = _INITIAL_TOP_K
         while True:
             k = min(top_k, corpus.capacity)
-            scorer = self._scorer(k, group_filtering)
+            scorer = self._scorer(k, group_filtering, from_rows)
             top_logit, top_index, count = scorer(
                 qfeats, cfeats, cvalid, cdeleted, cgroup,
                 query_group_j, query_row_j, jnp.float32(min_logit),

@@ -16,9 +16,11 @@ kernel has a differential test against them).  TPU-first design notes:
     axis; ``lax.scan`` walks rows.  O(L) steps of O(P*L) vector work instead
     of O(P*L^2) scalar work — the same wavefront idea a systolic algorithm
     uses, expressed in XLA ops.
-  * Set intersections (q-grams, tokens) use host-sorted hash arrays and a
-    batched binary search: O(S log S) vector ops and O(P*S) memory instead of
-    the O(P*S^2) equality matrix.
+  * Set intersections (q-grams, tokens) use a dense all-pairs equality
+    compare: O(P*S^2) fully-vectorized VPU work with zero gathers.  The
+    asymptotically better binary search loses by ~400x on TPU because its
+    per-row ``take_along_axis`` steps lower to serialized dynamic gathers
+    (see ``set_intersection_count``).
   * Jaro's greedy char matching is inherently sequential in the query string;
     we scan its <=L steps with all pairs advancing in lockstep, each step
     fully vectorized over P and the candidate axis.
@@ -26,7 +28,6 @@ kernel has a differential test against them).  TPU-first design notes:
 
 from __future__ import annotations
 
-import math
 from functools import partial
 
 import jax
@@ -270,27 +271,25 @@ def jaro_winkler_sim(
 
 
 def set_intersection_count(a, na, b, nb):
-    """|set(a[:na]) ∩ set(b[:nb])| for sorted, distinct int32 ids.
+    """|set(a[:na]) ∩ set(b[:nb])| for distinct int32 ids.
 
-    a: (P, Sa), b: (P, Sb) sorted ascending, padded with INT32_MAX.
-    Batched binary search of each element of a in b: O(Sa log Sb).
+    a: (P, Sa), b: (P, Sb), padded with INT32_MAX.
+
+    Dense all-pairs equality compare + reduce: O(Sa*Sb) elementwise work,
+    fully vectorized on the VPU with zero gathers.  The asymptotically
+    better batched binary search (O(Sa log Sb)) loses by ~400x on TPU
+    because its per-row ``take_along_axis`` steps lower to serialized
+    dynamic gathers along the minor dimension — measured 10.5 s vs 26 ms
+    per 2M-pair scoring call on v5e.  Elements are distinct within each
+    set, so counting equal (i, j) combinations counts the intersection.
     """
-    p, sa = a.shape
+    sa = a.shape[1]
     sb = b.shape[1]
-    # [0, Sb] has Sb+1 possible insertion points: ceil(log2(Sb+1)) halvings
-    steps = max(1, math.ceil(math.log2(sb + 1)))
-    lo = jnp.zeros((p, sa), jnp.int32)
-    hi = jnp.broadcast_to(jnp.int32(sb), (p, sa))
-    for _ in range(steps):
-        mid = (lo + hi) // 2
-        bv = jnp.take_along_axis(b, jnp.minimum(mid, sb - 1), axis=1)
-        go_right = bv < a
-        lo = jnp.where(go_right, mid + 1, lo)
-        hi = jnp.where(go_right, hi, mid)
-    bv = jnp.take_along_axis(b, jnp.minimum(lo, sb - 1), axis=1)
-    found = (lo < nb[:, None]) & (bv == a)
-    valid_a = jnp.arange(sa, dtype=jnp.int32) < na[:, None]
-    return (found & valid_a).sum(axis=1)
+    valid_a = jnp.arange(sa, dtype=jnp.int32) < na[:, None]      # (P, Sa)
+    valid_b = jnp.arange(sb, dtype=jnp.int32) < nb[:, None]      # (P, Sb)
+    eq = a[:, :, None] == b[:, None, :]                          # (P, Sa, Sb)
+    hits = eq & valid_a[:, :, None] & valid_b[:, None, :]
+    return hits.sum(axis=(1, 2)).astype(jnp.int32)
 
 
 def qgram_sim(g1, n1, g2, n2, equal, *, formula="overlap"):

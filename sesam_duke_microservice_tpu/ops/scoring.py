@@ -274,6 +274,7 @@ def build_ann_scorer(
     chunk: int = 512,
     top_c: int = 64,
     group_filtering: bool = False,
+    queries_from_rows: bool = False,
 ) -> Callable:
     """Two-stage ANN scoring program: cosine retrieval + exact rescoring.
 
@@ -292,6 +293,10 @@ def build_ann_scorer(
 
     ``count_above`` saturating at ``top_c`` signals the caller to escalate C
     (recall escalation — the ANN analogue of the brute-force K-escalation).
+
+    ``queries_from_rows``: as in ``build_corpus_scorer`` — ``q_emb`` and
+    ``qfeats`` are ignored (pass empty placeholders) and both are gathered
+    on device from the corpus at ``query_row``.
     """
     from . import encoder as E
 
@@ -301,6 +306,10 @@ def build_ann_scorer(
     def score(q_emb, qfeats, corpus_emb, corpus_feats, corpus_valid,
               corpus_deleted, corpus_group, query_group, query_row,
               min_logit):
+        if queries_from_rows:
+            qrows = jnp.clip(query_row, 0)
+            q_emb = jnp.take(corpus_emb, qrows, axis=0)
+            qfeats = gather_rows(corpus_feats, qrows)
         top_sim, top_index = E.retrieval_scan(
             q_emb, corpus_emb, corpus_valid, corpus_deleted, corpus_group,
             query_group, query_row,
@@ -407,12 +416,20 @@ def scan_topk(
     return top_logit, top_index, count
 
 
+def gather_rows(tree, rows: jnp.ndarray):
+    """Gather record rows out of a corpus feature tree (on device)."""
+    return jax.tree_util.tree_map(
+        lambda arr: jnp.take(arr, rows, axis=0), tree
+    )
+
+
 def build_corpus_scorer(
     plan: F.SchemaFeatures,
     *,
     chunk: int = 512,
     top_k: int = 64,
     group_filtering: bool = False,
+    queries_from_rows: bool = False,
 ) -> Callable:
     """Build the jitted query-block x corpus scorer.
 
@@ -426,6 +443,15 @@ def build_corpus_scorer(
     ``query_row`` is each query's own corpus row (-1 when not indexed, e.g.
     http-transform) for self-pair exclusion; ``min_logit`` is
     logit(min(threshold, maybe_threshold)) minus the host-property bound.
+
+    With ``queries_from_rows`` the ``qfeats`` argument is ignored (pass an
+    empty dict) and query features are gathered **on device** from the
+    corpus at ``query_row`` — the common dedup/linkage case where the query
+    batch was just indexed.  This keeps the per-batch host->device traffic
+    to one small int32 array instead of re-uploading every query feature
+    tensor (the dominant steady-state cost over a high-latency device
+    link).  Padding rows (-1) gather row 0; their results are discarded by
+    the caller.
     """
 
     pair_logits = build_pair_logits(plan)
@@ -433,6 +459,8 @@ def build_corpus_scorer(
     @partial(jax.jit, static_argnames=())
     def score(qfeats, corpus_feats, corpus_valid, corpus_deleted, corpus_group,
               query_group, query_row, min_logit):
+        if queries_from_rows:
+            qfeats = gather_rows(corpus_feats, jnp.clip(query_row, 0))
         return scan_topk(
             pair_logits, qfeats, corpus_feats, corpus_valid, corpus_deleted,
             corpus_group, query_group, query_row, min_logit,

@@ -231,12 +231,14 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
         ):
             for name, wl in registry.items():
                 stats = getattr(wl.processor, "stats", None)
-                corpus = getattr(wl.index, "corpus", None)
+                # device/ann: the live id->record map (corpus.size would
+                # count tombstoned/superseded rows); host: index length
+                live = getattr(wl.index, "records", None)
                 row = {
                     "kind": kind,
                     "name": name,
                     "records_indexed": (
-                        corpus.size if corpus is not None else len(wl.index)
+                        len(live) if live is not None else len(wl.index)
                     ),
                 }
                 if stats is not None:

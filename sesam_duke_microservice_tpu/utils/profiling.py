@@ -59,20 +59,26 @@ def trace_batch(label: str):
         _traced_batches += 1
         n = _traced_batches
     stack = contextlib.ExitStack()
+    entered = False
     try:
         import jax
 
         stack.enter_context(jax.profiler.trace(directory))
         stack.enter_context(jax.profiler.TraceAnnotation(label))
+        entered = True
     except Exception:
+        # return the unused budget slot so later healthy batches still trace
+        with _lock:
+            _traced_batches -= 1
         logger.exception("device trace setup failed (batch continues)")
     try:
         yield
     finally:
         try:
             stack.close()
-            logger.info("captured device trace %d/%d (%s) into %s",
-                        n, _trace_budget(), label, directory)
+            if entered:
+                logger.info("captured device trace %d/%d (%s) into %s",
+                            n, _trace_budget(), label, directory)
         except Exception:
             logger.exception(
                 "device trace teardown failed (batch continues)"
