@@ -1,0 +1,108 @@
+"""Large-corpus benchmark: ANN matching throughput at 10^5-10^7 rows.
+
+The workload BASELINE.json configs[4] points at ("10M-record synthetic
+dedup"): index N synthetic records into the embedding-ANN backend on one
+chip and measure steady-state incremental matching throughput — the
+service's hot loop once a big corpus is resident.  For corpora beyond one
+chip's HBM the same program shards over a mesh (parallel/ann_sharded.py;
+validated on the virtual CPU mesh by tests, dry-run by the driver).
+
+Usage::
+
+    python benchmarks/large_scale.py [--rows 1000000] [--batch 1024]
+        [--measure-batches 5]
+
+Prints one JSON line: {"rows", "ingest_rows_per_sec", "query_rows_per_sec",
+"effective_pairs_per_sec", "hbm_bytes_per_row"}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--measure-batches", type=int, default=5)
+    ap.add_argument("--dup-rate", type=float, default=0.3)
+    args = ap.parse_args()
+
+    from f1_stresstest import (
+        build_processor,
+        generate,
+        stresstest_schema,
+        to_records,
+    )
+
+    schema = stresstest_schema()
+    proc = build_processor(schema, "ann")
+    index = proc.database
+
+    # ingest in slabs to bound host memory
+    t0 = time.perf_counter()
+    slab = 100_000
+    remaining = args.rows
+    seed = 1000
+    while remaining > 0:
+        n = min(slab, remaining)
+        rows, _ = generate(n, args.dup_rate, seed)
+        records = to_records(rows)
+        # distinct ids per slab
+        for r in records:
+            r._values["ID"] = [f"s{seed}__{r.record_id}"]
+        for r in records:
+            index.index(r)
+        index.commit()
+        remaining -= n
+        seed += 1
+    ingest_s = time.perf_counter() - t0
+    ingest_rate = args.rows / ingest_s
+
+    # warm the scorer (compile + K/C settling)
+    qrows, _ = generate(args.batch, args.dup_rate, 7777)
+    warm = to_records(qrows)
+    for r in warm:
+        r._values["ID"] = [f"warm__{r.record_id}"]
+    proc.deduplicate(warm)
+
+    # steady-state incremental batches
+    times = []
+    for i in range(args.measure_batches):
+        qrows, _ = generate(args.batch, args.dup_rate, 8000 + i)
+        batch = to_records(qrows)
+        for r in batch:
+            r._values["ID"] = [f"q{i}__{r.record_id}"]
+        t0 = time.perf_counter()
+        proc.deduplicate(batch)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    corpus_rows = index.corpus.size
+
+    # device bytes per corpus row (features + embedding + masks)
+    per_row = 0
+    for tensors in index.corpus.feats.values():
+        for arr in tensors.values():
+            per_row += arr.dtype.itemsize * int(
+                arr.size // max(1, arr.shape[0])
+            )
+
+    print(json.dumps({
+        "rows": corpus_rows,
+        "ingest_rows_per_sec": round(ingest_rate, 1),
+        "query_rows_per_sec": round(args.batch / best, 1),
+        "effective_pairs_per_sec": round(args.batch * corpus_rows / best, 1),
+        "hbm_bytes_per_row": per_row,
+        "batch_seconds": round(best, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -137,6 +137,10 @@ class ServiceConfig:
     threads: int = 1
     profile: bool = False
     tunables: MatchTunables = field(default_factory=MatchTunables)
+    # opt-in one-to-one enforcement for record linkage (ONE_TO_ONE=1).
+    # The reference parses link-mode="one-to-one" but never reads it
+    # (App.java:113-120, SURVEY.md quirk Q5); default preserves that.
+    one_to_one: bool = False
 
 
 def _parse_number(text: str, what: str, label: str) -> float:
@@ -398,6 +402,7 @@ def parse_config(config_string: str, env=os.environ) -> ServiceConfig:
     if threads_env and re.fullmatch(r"\d+", threads_env):
         threads = int(threads_env)
     profile = env.get("PROFILE") == "1"
+    one_to_one = env.get("ONE_TO_ONE") == "1"
     tunables = MatchTunables.from_env(env)
 
     deduplications: Dict[str, WorkloadConfig] = {}
@@ -456,6 +461,7 @@ def parse_config(config_string: str, env=os.environ) -> ServiceConfig:
         threads=threads,
         profile=profile,
         tunables=tunables,
+        one_to_one=one_to_one,
     )
 
 

@@ -57,10 +57,18 @@ class ServiceMatchListener(MatchListener):
     response (``duke_links``)."""
 
     def __init__(self, workload_name: str, linkdb: LinkDatabase,
-                 kind: str = "deduplication"):
+                 kind: str = "deduplication", one_to_one: bool = False):
         self._wrapped = LinkMatchListener(linkdb)
         self.link_database_updates_disabled = False
         self._entity_matches: Dict[str, List[Tuple[Record, float]]] = {}
+        # one-to-one enforcement (opt-in): the reference parses
+        # link-mode="one-to-one" but never reads the flag (SURVEY.md quirk
+        # Q5), so by default every above-threshold pair links.  With
+        # ``one_to_one`` definite matches are buffered per batch and
+        # resolved greedily by descending confidence so each record links
+        # to at most one counterpart; maybe-matches pass through.
+        self.one_to_one = one_to_one
+        self._pending_matches: List[Tuple[float, Record, Record]] = []
         prefix = (
             "recordLinkageMatchListener" if kind == "recordlinkage"
             else "deduplicationMatchListener"
@@ -73,12 +81,15 @@ class ServiceMatchListener(MatchListener):
 
     def batch_ready(self, size: int) -> None:
         self._entity_matches = {}
+        self._pending_matches = []
         self._batch_start = time.monotonic()
         self.logger.info("batchReady(size=%d)", size)
         if not self.link_database_updates_disabled:
             self._wrapped.batch_ready(size)
 
     def batch_done(self) -> None:
+        if self.one_to_one:
+            self._flush_one_to_one()
         if not self.link_database_updates_disabled:
             self._wrapped.batch_done()
         if self._batch_start is not None:
@@ -87,7 +98,64 @@ class ServiceMatchListener(MatchListener):
                 time.monotonic() - self._batch_start,
             )
 
+    def _flush_one_to_one(self) -> None:
+        """Greedy max-confidence assignment: each record in at most one
+        definite link — within the batch AND against links asserted by
+        earlier batches (a stronger new pair retracts the weaker existing
+        link; a weaker one is suppressed).  Ties break on record ids so
+        the output is deterministic under threaded scoring."""
+        taken: set = set()
+        # secondary keys make equal-confidence ordering independent of
+        # listener-call interleaving (THREADS > 1)
+        for confidence, r1, r2 in sorted(
+            self._pending_matches,
+            key=lambda t: (-t[0], t[1].record_id, t[2].record_id),
+        ):
+            if r1.record_id in taken or r2.record_id in taken:
+                continue
+            if not self.link_database_updates_disabled:
+                blocked, to_retract = self._existing_conflicts(
+                    r1.record_id, r2.record_id, confidence
+                )
+                if blocked:
+                    continue
+                for link in to_retract:
+                    link.retract()
+                    self._wrapped.linkdb.assert_link(link)
+                self._wrapped.matches(r1, r2, confidence)
+            taken.add(r1.record_id)
+            taken.add(r2.record_id)
+            self._record_entity_match(r1, r2, confidence)
+        self._pending_matches = []
+
+    def _existing_conflicts(self, id1: str, id2: str, confidence: float):
+        """Definite links from earlier batches touching either record.
+
+        Returns (blocked, to_retract): blocked when an existing link with
+        >= confidence already claims one of the records; otherwise the
+        weaker existing links to retract before asserting the new pair.
+        """
+        pair = {id1, id2}
+        blocked = False
+        to_retract = []
+        for rid in pair:
+            for link in self._wrapped.linkdb.get_all_links_for(rid):
+                if link.kind != LinkKind.DUPLICATE:
+                    continue
+                if link.status == LinkStatus.RETRACTED:
+                    continue
+                if {link.id1, link.id2} == pair:
+                    continue  # same pair: plain re-assert
+                if link.confidence >= confidence:
+                    blocked = True
+                else:
+                    to_retract.append(link)
+        return blocked, to_retract
+
     def matches(self, r1: Record, r2: Record, confidence: float) -> None:
+        if self.one_to_one:
+            self._pending_matches.append((confidence, r1, r2))
+            return
         if not self.link_database_updates_disabled:
             self._wrapped.matches(r1, r2, confidence)
         self._record_entity_match(r1, r2, confidence)

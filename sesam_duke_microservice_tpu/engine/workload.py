@@ -189,7 +189,10 @@ def build_workload(wc: WorkloadConfig, sc: ServiceConfig, *,
             wc.data_folder if persistent else None,
             is_record_linkage=wc.is_record_linkage,
         )
-        listener = ServiceMatchListener(wc.name, link_database, kind=wc.kind)
+        listener = ServiceMatchListener(
+            wc.name, link_database, kind=wc.kind,
+            one_to_one=sc.one_to_one and wc.is_record_linkage,
+        )
         processor.add_match_listener(listener)
 
         if persistent and wc.data_folder:

@@ -10,6 +10,7 @@ ring-structured candidate merge sketched in SURVEY.md section 5.7.
 """
 
 from .ann_sharded import build_sharded_ann_scorer
+from .multihost import global_corpus_mesh, initialize as initialize_distributed
 from .sharded import ShardedCorpus, build_sharded_scorer, corpus_mesh
 
 __all__ = [
@@ -17,4 +18,6 @@ __all__ = [
     "build_sharded_ann_scorer",
     "build_sharded_scorer",
     "corpus_mesh",
+    "global_corpus_mesh",
+    "initialize_distributed",
 ]

@@ -1,0 +1,110 @@
+"""Multi-host scale-out: jax.distributed over DCN + mesh construction.
+
+The reference's only "distribution" is the Sesam node HTTP-polling one
+microservice container (SURVEY.md section 2 component #16).  The TPU-native
+replacement for real scale is the standard JAX multi-controller model: one
+Python process per host, ``jax.distributed.initialize`` over the
+coordinator (DCN), and a global mesh whose record-sharding axis spans every
+chip in the job.
+
+Layout policy for this workload (corpus-sharded matching,
+parallel/sharded.py + parallel/ann_sharded.py):
+
+  * the corpus axis shards over ALL devices, hosts included — each chip
+    holds ``N / total_chips`` rows and scores the replicated query block
+    against them locally;
+  * the only cross-device traffic is the per-shard top-K ``all_gather``
+    ((D, Q, K), K tiny).  Within a slice it rides ICI; across slices the
+    same collective rides DCN.  Because the merge payload is O(Q x K) per
+    device — not O(corpus) — DCN bandwidth is not a bottleneck, so a flat
+    1-D mesh is the right default (no need for the hierarchical
+    ICI-inner/DCN-outer factorization a bandwidth-bound workload needs);
+  * ingest is single-writer per workload (the service's lock discipline,
+    SURVEY.md section 1 L5): the frontend host extracts features and
+    ``device_put``s each shard slice; queries replicate.
+
+``initialize()`` wraps ``jax.distributed.initialize`` with env-var
+defaults (JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES, JAX_PROCESS_ID) and
+is a no-op for single-process runs, so the same entrypoint works on a
+laptop, one TPU VM, or a multi-host slice job.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger("multihost")
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> bool:
+    """Join (or skip) the multi-controller job; returns True if distributed.
+
+    Arguments default from the standard env vars; when neither arguments
+    nor env vars configure a coordinator, this is a single-process run and
+    nothing happens (returns False).
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if coordinator_address is None:
+        # Cloud TPU multi-host jobs usually carry no explicit coordinator —
+        # jax.distributed.initialize() auto-detects from the TPU/cluster
+        # metadata.  Only attempt it when that metadata is plainly present,
+        # so laptops/CI stay single-process without a failed probe.
+        if any(os.environ.get(v) for v in (
+            "TPU_WORKER_HOSTNAMES", "TPU_WORKER_ID", "CLOUD_TPU_TASK_ID",
+            "MEGASCALE_COORDINATOR_ADDRESS",
+        )):
+            try:
+                jax.distributed.initialize()
+                logger.info(
+                    "joined auto-detected distributed job: process %d/%d",
+                    jax.process_index(), jax.process_count(),
+                )
+                return True
+            except Exception:
+                logger.exception(
+                    "distributed auto-detect failed; continuing single-process"
+                )
+        return False
+    kwargs = {"coordinator_address": coordinator_address}
+    num_processes = num_processes or _int_env("JAX_NUM_PROCESSES")
+    process_id = process_id if process_id is not None else _int_env(
+        "JAX_PROCESS_ID"
+    )
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    logger.info(
+        "joined distributed job: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+    return True
+
+
+def _int_env(name: str) -> Optional[int]:
+    raw = os.environ.get(name)
+    return int(raw) if raw and raw.isdigit() else None
+
+
+def global_corpus_mesh():
+    """1-D corpus mesh over every device in the job (all hosts).
+
+    Single-host this equals ``corpus_mesh()``; multi-host it spans the
+    global device list, so the record axis shards across hosts and the
+    top-K merge collective crosses DCN transparently.
+    """
+    import jax
+
+    from .sharded import corpus_mesh
+
+    return corpus_mesh(jax.devices())

@@ -267,3 +267,88 @@ def test_trace_batch_propagates_body_exceptions(tmp_path, monkeypatch):
     with pytest.raises(ValueError, match="real scoring error"):
         with profiling.trace_batch("failing"):
             raise ValueError("real scoring error")
+
+
+def test_one_to_one_listener_keeps_best_assignment():
+    from sesam_duke_microservice_tpu.core.records import (
+        ID_PROPERTY_NAME,
+        ORIGINAL_ENTITY_ID_PROPERTY_NAME,
+        Record,
+    )
+    from sesam_duke_microservice_tpu.engine.listeners import (
+        ServiceMatchListener,
+    )
+    from sesam_duke_microservice_tpu.links.memory import InMemoryLinkDatabase
+
+    def rec(rid):
+        r = Record()
+        r.add_value(ID_PROPERTY_NAME, rid)
+        r.add_value(ORIGINAL_ENTITY_ID_PROPERTY_NAME, rid)
+        return r
+
+    a1, a2, b1, b2 = rec("a1"), rec("a2"), rec("b1"), rec("b2")
+    linkdb = InMemoryLinkDatabase()
+    lis = ServiceMatchListener("t", linkdb, kind="recordlinkage",
+                               one_to_one=True)
+    lis.batch_ready(2)
+    # a1 matches both b1 (0.9) and b2 (0.95); a2 matches b2 (0.8)
+    lis.matches(a1, b1, 0.9)
+    lis.matches(a1, b2, 0.95)
+    lis.matches(a2, b2, 0.8)
+    lis.batch_done()
+    links = {(l.id1, l.id2) for l in linkdb.get_changes_since(0)}
+    # greedy by confidence: a1-b2 (0.95) wins; a2-b2 blocked (b2 taken);
+    # a1-b1 blocked (a1 taken) -> exactly one definite link
+    assert links == {("a1", "b2")}
+
+    # without the flag all three links assert (reference quirk Q5 behavior)
+    linkdb2 = InMemoryLinkDatabase()
+    lis2 = ServiceMatchListener("t", linkdb2, kind="recordlinkage")
+    lis2.batch_ready(2)
+    lis2.matches(a1, b1, 0.9)
+    lis2.matches(a1, b2, 0.95)
+    lis2.matches(a2, b2, 0.8)
+    lis2.batch_done()
+    assert len(linkdb2.get_changes_since(0)) == 3
+
+
+def test_one_to_one_cross_batch_retracts_weaker_link():
+    from sesam_duke_microservice_tpu.core.records import (
+        ID_PROPERTY_NAME,
+        ORIGINAL_ENTITY_ID_PROPERTY_NAME,
+        Record,
+    )
+    from sesam_duke_microservice_tpu.engine.listeners import (
+        ServiceMatchListener,
+    )
+    from sesam_duke_microservice_tpu.links.base import LinkStatus
+    from sesam_duke_microservice_tpu.links.memory import InMemoryLinkDatabase
+
+    def rec(rid):
+        r = Record()
+        r.add_value(ID_PROPERTY_NAME, rid)
+        r.add_value(ORIGINAL_ENTITY_ID_PROPERTY_NAME, rid)
+        return r
+
+    a1, a2, b1 = rec("a1"), rec("a2"), rec("b1")
+    linkdb = InMemoryLinkDatabase()
+    lis = ServiceMatchListener("t", linkdb, kind="recordlinkage",
+                               one_to_one=True)
+    # batch 1: a1-b1 at 0.9
+    lis.batch_ready(1)
+    lis.matches(a1, b1, 0.9)
+    lis.batch_done()
+    # batch 2: a2-b1 at 0.95 -> stronger, must retract a1-b1
+    lis.batch_ready(1)
+    lis.matches(a2, b1, 0.95)
+    lis.batch_done()
+    live = {(l.id1, l.id2) for l in linkdb.get_changes_since(0)
+            if l.status != LinkStatus.RETRACTED}
+    assert live == {("a2", "b1")}
+    # batch 3: a1-b1 again at 0.9 -> weaker than existing 0.95, suppressed
+    lis.batch_ready(1)
+    lis.matches(a1, b1, 0.9)
+    lis.batch_done()
+    live = {(l.id1, l.id2) for l in linkdb.get_changes_since(0)
+            if l.status != LinkStatus.RETRACTED}
+    assert live == {("a2", "b1")}

@@ -162,3 +162,19 @@ class TestShardedScorer:
         for qi in range(16):
             returned = s_index[qi][s_logit[qi] > S.NEG_INF / 2]
             assert qi not in returned
+
+
+class TestMultihost:
+    def test_initialize_noop_without_coordinator(self, monkeypatch):
+        from sesam_duke_microservice_tpu.parallel import multihost
+
+        monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+        assert multihost.initialize() is False
+
+    def test_global_corpus_mesh_spans_all_devices(self):
+        import jax
+
+        from sesam_duke_microservice_tpu.parallel import global_corpus_mesh
+
+        mesh = global_corpus_mesh()
+        assert mesh.size == jax.device_count()
