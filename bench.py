@@ -24,8 +24,10 @@ import time
 
 import numpy as np
 
-# bench sizes (env-overridable for quick runs)
-CORPUS = int(os.environ.get("BENCH_CORPUS", "8192"))
+# bench sizes (env-overridable for quick runs).  The default corpus matches
+# the reference stresstest's total size (2 x 10,000 seeded entities,
+# sesam_node_deduplication_stresstest_config.conf.json).
+CORPUS = int(os.environ.get("BENCH_CORPUS", "20000"))
 QUERIES = int(os.environ.get("BENCH_QUERIES", "1024"))
 CPU_SAMPLE_PAIRS = int(os.environ.get("BENCH_CPU_PAIRS", "20000"))
 
