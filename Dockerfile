@@ -1,0 +1,28 @@
+# Serving image — the reference's packaging shape (Dockerfile:1-8: shaded
+# jar on java:8-jre-alpine, EXPOSE 4567) re-expressed for the TPU build.
+# Base image must provide python>=3.10 with jax wheels matching the target
+# accelerator (e.g. a Cloud TPU VM base); pinned here to the generic python
+# image for CPU-only smoke runs.
+FROM python:3.11-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /srv/duke-tpu
+COPY pyproject.toml README.md ./
+COPY sesam_duke_microservice_tpu ./sesam_duke_microservice_tpu
+RUN pip install --no-cache-dir .
+
+# the reference creates this user but never switches to it (quirk Q8);
+# deliberately fixed: run unprivileged
+RUN useradd --system --create-home sesam
+USER sesam
+
+# durable state (lucene-index equivalent + link DB) lives under /data in
+# the default config, as in the reference (testdukeconfig.xml:7)
+VOLUME /data
+EXPOSE 4567
+
+# CONFIG_STRING / THREADS / PROFILE / MIN_RELEVANCE / FUZZY_SEARCH /
+# MAX_SEARCH_HITS / ONE_TO_ONE env vars are honored as in the reference
+ENTRYPOINT ["python", "-m", "sesam_duke_microservice_tpu.service"]

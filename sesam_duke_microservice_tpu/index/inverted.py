@@ -61,6 +61,37 @@ _NOT_ANALYZED = frozenset(
 
 SEARCH_EXPANSION_FACTOR = 1  # IncrementalLuceneDatabase.java:70
 
+# Lucene FuzzyQuery rewrites to at most 50 terms; same cap here.
+_MAX_FUZZY_EXPANSIONS = 50
+
+
+def _osa_distance(a: str, b: str, limit: int) -> int:
+    """Optimal-string-alignment edit distance, early-exiting past ``limit``.
+
+    Counts adjacent transpositions as one edit — the distance Lucene's
+    FuzzyQuery automaton uses (transpositions=true), which plain
+    Levenshtein would overcount ('ab' -> 'ba' is 1, not 2).
+    """
+    la, lb = len(a), len(b)
+    if abs(la - lb) > limit:
+        return limit + 1
+    prev2: List[int] = []
+    prev = list(range(lb + 1))
+    for i in range(1, la + 1):
+        cur = [i] + [0] * lb
+        ca = a[i - 1]
+        for j in range(1, lb + 1):
+            cost = 0 if ca == b[j - 1] else 1
+            d = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+            if (i > 1 and j > 1 and ca == b[j - 2]
+                    and a[i - 2] == b[j - 1]):
+                d = min(d, prev2[j - 2] + 1)
+            cur[j] = d
+        if min(cur) > limit:
+            return limit + 1
+        prev2, prev = prev, cur
+    return prev[lb]
+
 
 def analyze(value: str) -> List[str]:
     return [
@@ -126,6 +157,11 @@ class InvertedIndex(CandidateIndex):
         self._docs: Dict[int, _Doc] = {}                # committed, by slot
         self._id_to_slot: Dict[str, int] = {}
         self._postings: Dict[Tuple[str, str], Set[int]] = defaultdict(set)
+        # field -> term-length -> terms; mirrors _postings' key set (kept in
+        # sync at the two write sites below) so fuzzy expansion only scans
+        # the +/-2-length buckets
+        self._vocab: Dict[str, Dict[int, Set[str]]] = defaultdict(dict)
+        self._fuzzy_cache: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
         self._pending: List[Tuple[str, object]] = []    # ("add", Record) | ("del", id)
 
     # -- write path ---------------------------------------------------------
@@ -147,6 +183,8 @@ class InvertedIndex(CandidateIndex):
         self._indexing_disabled = disabled
 
     def commit(self) -> None:
+        if self._pending:
+            self._fuzzy_cache.clear()
         for op, payload in self._pending:
             if op == "del":
                 self._remove_committed(payload)
@@ -165,6 +203,7 @@ class InvertedIndex(CandidateIndex):
         for field, counts in doc.field_tokens.items():
             for token in counts:
                 self._postings[(field, token)].add(slot)
+                self._vocab[field].setdefault(len(token), set()).add(token)
 
     def _remove_committed(self, record_id: str) -> None:
         slot = self._id_to_slot.pop(record_id, None)
@@ -178,6 +217,11 @@ class InvertedIndex(CandidateIndex):
                     bucket.discard(slot)
                     if not bucket:
                         del self._postings[(field, token)]
+                        by_len = self._vocab.get(field)
+                        if by_len is not None:
+                            terms = by_len.get(len(token))
+                            if terms is not None:
+                                terms.discard(token)
 
     # -- read path ----------------------------------------------------------
 
@@ -187,14 +231,27 @@ class InvertedIndex(CandidateIndex):
 
     def find_candidate_matches(self, record: Record,
                                group_filtering: bool = False) -> List[Record]:
-        should: List[Tuple[str, str]] = []
-        must: List[Tuple[str, str]] = []
+        # fuzzy_search expands each token of a tokenized-comparator property
+        # into the indexed terms within 2 edits (transpositions counted, as
+        # in Lucene's FuzzyQuery automaton) — the reference's per-token
+        # FuzzyQuery (IncrementalLuceneDatabase.java:308-326; Lucene
+        # default maxEdits=2), rewritten as a term disjunction.  Each
+        # original token stays ONE scoring group whatever its expansion, so
+        # enabling fuzzy never dilutes exact-match scores via coord.
+        fuzzy = self.tunables.fuzzy_search
+        should: List[List[Tuple[str, str]]] = []  # groups of alternatives
+        must: List[List[Tuple[str, str]]] = []
         for prop in self.schema.lookup_properties():
             values = record.get_values(prop.name)
             required = prop.lookup == Lookup.REQUIRED
+            tokenized = bool(getattr(prop.comparator, "is_tokenized", False))
             for value in values:
                 for token in analyze(value):
-                    (must if required else should).append((prop.name, token))
+                    if fuzzy and tokenized:
+                        alts = self._fuzzy_terms(prop.name, token)
+                    else:
+                        alts = [(prop.name, token)]
+                    (must if required else should).append(alts)
 
         must_not_slots: Set[int] = set(
             self._postings.get((DELETED_PROPERTY_NAME, "true"), ())
@@ -209,46 +266,97 @@ class InvertedIndex(CandidateIndex):
 
         return self._do_query(should, must, must_not_slots)
 
+    def _fuzzy_terms(self, field: str, token: str) -> List[Tuple[str, str]]:
+        """The query token plus indexed terms within 2 edits (OSA distance,
+        so transpositions count one edit, as in Lucene's automaton).
+
+        Scans only the +/-2-length vocabulary buckets, caches per
+        (field, token) until the next commit, and caps the expansion at
+        Lucene's 50-term rewrite limit.
+        """
+        key = (field, token)
+        cached = self._fuzzy_cache.get(key)
+        if cached is not None:
+            return cached
+        out = [(field, token)]
+        by_len = self._vocab.get(field)
+        if by_len:
+            n = len(token)
+            for length in range(max(1, n - 2), n + 3):
+                terms = by_len.get(length)
+                if not terms:
+                    continue
+                for term in sorted(terms):  # deterministic under the cap
+                    if term != token and _osa_distance(term, token, 2) <= 2:
+                        out.append((field, term))
+                        if len(out) >= _MAX_FUZZY_EXPANSIONS:
+                            break
+                if len(out) >= _MAX_FUZZY_EXPANSIONS:
+                    break
+        self._fuzzy_cache[key] = out
+        return out
+
     def _do_query(self, should, must, must_not_slots) -> List[Record]:
-        clauses = should + must
-        if not clauses:
+        # dedup groups by their primary (exact) term, preserving order —
+        # repeated tokens score once, exactly as set(clauses) did pre-fuzzy
+        groups: List[List[Tuple[str, str]]] = []
+        seen: Set[Tuple[str, str]] = set()
+        for group in should + must:
+            if group[0] not in seen:
+                seen.add(group[0])
+                groups.append(group)
+        if not groups:
             return []
 
         n_docs = max(len(self._docs), 1)
+        flat = {alt for group in groups for alt in group}
         idf = {
             clause: 1.0 + math.log(n_docs / (len(self._postings.get(clause, ())) + 1))
-            for clause in set(clauses)
+            for clause in flat
         }
-        query_norm = 1.0 / math.sqrt(sum(idf[c] ** 2 for c in set(clauses)) or 1.0)
+        # norms over the primary terms: identical to the fuzzy-off query,
+        # so expansion never rescales scores of exact matches
+        query_norm = 1.0 / math.sqrt(
+            sum(idf[g[0]] ** 2 for g in groups) or 1.0
+        )
 
-        # candidate doc set
+        # candidate doc set; a MUST group (REQUIRED lookup) is satisfied by
+        # any of its fuzzy-expanded alternatives
         candidates: Set[int] = set()
-        for clause in clauses:
+        for clause in flat:
             candidates |= self._postings.get(clause, set())
-        for clause in must:
-            candidates &= self._postings.get(clause, set())
+        for group in must:
+            group_slots: Set[int] = set()
+            for alt in group:
+                group_slots |= self._postings.get(alt, set())
+            candidates &= group_slots
         candidates -= must_not_slots
         if not candidates:
             return []
 
         scored: List[Tuple[float, int]] = []
-        unique_clauses = set(clauses)
         for slot in candidates:
             doc = self._docs[slot]
             score = 0.0
             matched = 0
-            for field, token in unique_clauses:
-                counts = doc.field_tokens.get(field)
-                if not counts:
-                    continue
-                freq = counts.get(token, 0)
-                if freq == 0:
-                    continue
-                matched += 1
-                tf = math.sqrt(freq)
-                field_norm = 1.0 / math.sqrt(doc.field_lengths[field])
-                score += tf * (idf[(field, token)] ** 2) * field_norm
-            coord = matched / len(unique_clauses)
+            for group in groups:
+                best = 0.0
+                for field, token in group:
+                    counts = doc.field_tokens.get(field)
+                    if not counts:
+                        break  # same field for every alternative
+                    freq = counts.get(token, 0)
+                    if freq == 0:
+                        continue
+                    tf = math.sqrt(freq)
+                    field_norm = 1.0 / math.sqrt(doc.field_lengths[field])
+                    contrib = tf * (idf[(field, token)] ** 2) * field_norm
+                    if contrib > best:
+                        best = contrib
+                if best > 0.0:
+                    matched += 1
+                    score += best
+            coord = matched / len(groups)
             scored.append((score * coord * query_norm, slot))
         scored.sort(key=lambda s: (-s[0], s[1]))
 

@@ -352,3 +352,105 @@ def test_one_to_one_cross_batch_retracts_weaker_link():
     live = {(l.id1, l.id2) for l in linkdb.get_changes_since(0)
             if l.status != LinkStatus.RETRACTED}
     assert live == {("a2", "b1")}
+
+
+def test_fuzzy_search_expands_tokens():
+    from sesam_duke_microservice_tpu.core import comparators as C
+    from sesam_duke_microservice_tpu.core.config import (
+        DukeSchema,
+        MatchTunables,
+    )
+    from sesam_duke_microservice_tpu.core.records import (
+        ID_PROPERTY_NAME,
+        Property,
+        Record,
+    )
+
+    schema = DukeSchema(
+        threshold=0.8, maybe_threshold=None,
+        properties=[
+            Property(ID_PROPERTY_NAME, id_property=True),
+            Property("NAME", C.Levenshtein(), 0.1, 0.9),
+        ],
+        data_sources=[],
+    )
+
+    def rec(rid, name):
+        r = Record()
+        r.add_value(ID_PROPERTY_NAME, rid)
+        r.add_value("NAME", name)
+        return r
+
+    def build(fuzzy):
+        t = MatchTunables()
+        t.min_relevance = 0.0
+        t.fuzzy_search = fuzzy
+        idx = InvertedIndex(schema, t)
+        idx.index(rec("a", "kristiansen"))
+        idx.commit()
+        return idx
+
+    probe = rec("q", "kristianson")  # 2 edits from the indexed token
+    assert build(False).find_candidate_matches(probe) == []
+    fuzzy_hits = build(True).find_candidate_matches(probe)
+    assert [r.record_id for r in fuzzy_hits] == ["a"]
+    # beyond maxEdits=2 stays out even with fuzzy on
+    far = rec("q2", "kristol")
+    assert build(True).find_candidate_matches(far) == []
+
+
+def test_osa_distance_counts_transpositions():
+    from sesam_duke_microservice_tpu.index.inverted import _osa_distance
+
+    assert _osa_distance("ab", "ba", 2) == 1          # one transposition
+    assert _osa_distance("abcdef", "abcdef", 2) == 0
+    assert _osa_distance("kristiansen", "kristianson", 2) == 1
+    assert _osa_distance("kristiansen", "kristiansonx", 2) == 2
+    assert _osa_distance("abcdef", "ghijkl", 2) == 3  # clipped past limit
+
+
+def test_fuzzy_does_not_dilute_exact_match_scores():
+    from sesam_duke_microservice_tpu.core import comparators as C
+    from sesam_duke_microservice_tpu.core.config import (
+        DukeSchema,
+        MatchTunables,
+    )
+    from sesam_duke_microservice_tpu.core.records import (
+        ID_PROPERTY_NAME,
+        Property,
+        Record,
+    )
+
+    schema = DukeSchema(
+        threshold=0.8, maybe_threshold=None,
+        properties=[
+            Property(ID_PROPERTY_NAME, id_property=True),
+            Property("NAME", C.Levenshtein(), 0.1, 0.9),
+        ],
+        data_sources=[],
+    )
+
+    def rec(rid, name):
+        r = Record()
+        r.add_value(ID_PROPERTY_NAME, rid)
+        r.add_value("NAME", name)
+        return r
+
+    def hits(fuzzy, min_relevance):
+        t = MatchTunables()
+        t.min_relevance = min_relevance
+        t.fuzzy_search = fuzzy
+        idx = InvertedIndex(schema, t)
+        idx.index(rec("exact", "kristiansen"))
+        idx.index(rec("near", "kristianses"))
+        idx.commit()
+        return {r.record_id
+                for r in idx.find_candidate_matches(rec("q", "kristiansen"))}
+
+    # pick a cut that passes the exact match with fuzzy off
+    base = hits(False, 0.1)
+    assert "exact" in base
+    # fuzzy ON may only ADD candidates at the same cut, never remove
+    with_fuzzy = hits(True, 0.1)
+    assert base <= with_fuzzy
+    assert "near" in with_fuzzy
