@@ -13,9 +13,16 @@ COPY pyproject.toml README.md ./
 COPY sesam_duke_microservice_tpu ./sesam_duke_microservice_tpu
 RUN pip install --no-cache-dir .
 
+# build the native comparator library now, while site-packages is still
+# writable — at runtime the unprivileged user could not compile it and the
+# service would silently fall back to the pure-Python comparators
+RUN python -c "from sesam_duke_microservice_tpu import native; assert native.available()"
+
 # the reference creates this user but never switches to it (quirk Q8);
-# deliberately fixed: run unprivileged
-RUN useradd --system --create-home sesam
+# deliberately fixed: run unprivileged — with a writable /data, which the
+# default config's dataFolder points at (root-owned otherwise)
+RUN useradd --system --create-home sesam \
+    && mkdir -p /data && chown sesam:sesam /data
 USER sesam
 
 # durable state (lucene-index equivalent + link DB) lives under /data in

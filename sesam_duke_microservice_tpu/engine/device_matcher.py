@@ -233,6 +233,24 @@ def _tree_updater():
     return _TREE_UPDATER
 
 
+def _records_content_hash(records_by_id: Dict[str, Record]) -> str:
+    """Order-independent digest of record ids AND values (snapshot guard)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for rid in sorted(records_by_id):
+        h.update(rid.encode("utf-8", "surrogatepass"))
+        h.update(b"\x00")
+        record = records_by_id[rid]
+        for prop in sorted(record.properties()):
+            h.update(prop.encode("utf-8", "surrogatepass"))
+            h.update(b"\x01")
+            for value in record.get_values(prop):
+                h.update(value.encode("utf-8", "surrogatepass"))
+                h.update(b"\x02")
+    return h.hexdigest()
+
+
 def _grow_1d(arr: np.ndarray, cap: int, fill) -> np.ndarray:
     out = np.full((cap,), fill, dtype=arr.dtype)
     out[: arr.shape[0]] = arr
@@ -357,6 +375,106 @@ class DeviceIndex(CandidateIndex):
 
     def set_indexing_disabled(self, disabled: bool) -> None:
         self.indexing_disabled = disabled
+
+    # -- extraction snapshot (restart acceleration) --------------------------
+    #
+    # The durable record store is the source of truth (SURVEY.md section 7
+    # "State"); the corpus tensors are a rebuildable cache.  Rebuilding
+    # means re-running per-record feature extraction — the dominant restart
+    # cost at 10^5+ rows — so the host mirror can be snapshotted to one
+    # .npz and reloaded in one mmap'd read, the orbax-style device-state
+    # snapshot SURVEY.md section 5.4 calls an optimization, never truth:
+    # any mismatch (schema change, env-sized tensor shapes, store drift)
+    # silently falls back to full replay.
+
+    def _snapshot_fingerprint(self) -> str:
+        import hashlib
+
+        # plan semantics + every env knob that sizes the feature tensors
+        # (must be computable before any data is loaded)
+        spec = repr((
+            [(s.name, s.kind, s.low, s.high, s.v)
+             for s in self.plan.device_props],
+            os.environ.get("DEVICE_MAX_CHARS", ""),
+            os.environ.get("DEVICE_MAX_GRAMS", ""),
+            os.environ.get("DEVICE_MAX_TOKENS", ""),
+            getattr(self, "dim", None),   # ANN embedding width
+        ))
+        return hashlib.sha256(spec.encode()).hexdigest()
+
+    def snapshot_save(self, path: str) -> None:
+        corpus = self.corpus
+        if corpus.size == 0:
+            return
+        flat = {
+            f"feat\x1f{prop}\x1f{name}": arr[: corpus.size]
+            for prop, tensors in corpus.feats.items()
+            for name, arr in tensors.items()
+        }
+        np.savez_compressed(
+            path,
+            __fingerprint=np.array(self._snapshot_fingerprint()),
+            __content=np.array(_records_content_hash(self.records)),
+            __row_valid=corpus.row_valid[: corpus.size],
+            __row_deleted=corpus.row_deleted[: corpus.size],
+            __row_group=corpus.row_group[: corpus.size],
+            __row_ids=np.array(corpus.row_ids, dtype=object),
+            **flat,
+        )
+
+    def snapshot_load(self, path: str,
+                      records_by_id: Dict[str, Record]) -> bool:
+        """Restore the corpus tensors from a snapshot; False -> replay.
+
+        ``records_by_id`` is the durable store's live view; the snapshot is
+        rejected unless its live rows are exactly the store's record set.
+        """
+        if self.corpus.size != 0 or not os.path.exists(path):
+            return False
+        try:
+            with np.load(path, allow_pickle=True) as data:
+                if str(data["__fingerprint"]) != self._snapshot_fingerprint():
+                    return False
+                # record CONTENT hash, not just the id set: an id-set check
+                # would accept a snapshot predating an in-place record
+                # update that only the store persisted (crash before the
+                # next snapshot save) and score stale features
+                if (str(data["__content"])
+                        != _records_content_hash(records_by_id)):
+                    return False
+                row_ids = list(data["__row_ids"])
+                row_valid = data["__row_valid"]
+                row_deleted = data["__row_deleted"]
+                row_group = data["__row_group"]
+                live = {
+                    rid for rid, ok in zip(row_ids, row_valid) if ok
+                }
+                if live != set(records_by_id):
+                    return False
+                feats: Dict[str, Dict[str, np.ndarray]] = {}
+                for key in data.files:
+                    if not key.startswith("feat\x1f"):
+                        continue
+                    _, prop, name = key.split("\x1f", 2)
+                    feats.setdefault(prop, {})[name] = data[key]
+        except Exception:
+            logger.exception("snapshot load failed; replaying from store")
+            return False
+
+        corpus = self.corpus
+        n = len(row_ids)
+        rows = corpus.append(
+            feats, np.asarray(row_deleted), np.asarray(row_group),
+            [str(r) for r in row_ids],
+        )
+        corpus.row_valid[: n] = row_valid
+        corpus._dirty_masks = True
+        for rid, row, ok in zip(row_ids, rows, row_valid):
+            if ok:
+                self.id_to_row[str(rid)] = int(row)
+                self.records[str(rid)] = records_by_id[str(rid)]
+        logger.info("corpus snapshot restored: %d rows from %s", n, path)
+        return True
 
     def close(self) -> None:
         pass

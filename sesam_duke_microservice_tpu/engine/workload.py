@@ -23,6 +23,7 @@ Flow parity notes:
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Dict, List, Optional, Sequence
 
@@ -40,6 +41,12 @@ from ..service.datasource import IncrementalDataSource
 from ..store.records import RecordStore
 from .listeners import ServiceMatchListener
 from .processor import Processor
+
+
+def _snapshot_path(data_folder: str) -> str:
+    import os
+
+    return os.path.join(data_folder, "corpus_snapshot.npz")
 
 
 class Workload:
@@ -137,8 +144,22 @@ class Workload:
 
     def close(self) -> None:
         """Release index/link-db resources (the reference leaks these on hot
-        reload — SURVEY.md quirk Q7; fixed by calling this on config swap)."""
+        reload — SURVEY.md quirk Q7; fixed by calling this on config swap).
+
+        Device backends additionally persist a corpus snapshot so the next
+        start can skip feature re-extraction (best-effort: a failed save
+        only logs; the record store remains the source of truth)."""
         self.closed = True
+        if (self.record_store is not None
+                and hasattr(self.index, "snapshot_save")):
+            try:
+                self.index.snapshot_save(
+                    _snapshot_path(self.config.data_folder)
+                )
+            except Exception:
+                logging.getLogger("workload").exception(
+                    "corpus snapshot save failed (replay will rebuild)"
+                )
         self.index.close()
         self.link_database.close()
         if self.record_store is not None:
@@ -205,12 +226,21 @@ def build_workload(wc: WorkloadConfig, sc: ServiceConfig, *,
             )
             # resume: rebuild the blocking index from the durable store (the
             # reference resumes by reopening its Lucene dir in APPEND mode —
-            # IncrementalLuceneDatabase.java:233-244)
-            replayed = 0
-            for record in record_store.all_records():
-                index.index(record)
-                replayed += 1
-            if replayed:
+            # IncrementalLuceneDatabase.java:233-244).  Device backends may
+            # shortcut the per-record feature re-extraction through a
+            # corpus snapshot; the store stays the source of truth and any
+            # snapshot mismatch falls back to full replay.
+            records_by_id = {
+                r.record_id: r for r in record_store.all_records()
+            }
+            loaded = False
+            if hasattr(index, "snapshot_load"):
+                loaded = index.snapshot_load(
+                    _snapshot_path(wc.data_folder), records_by_id
+                )
+            if not loaded and records_by_id:
+                for record in records_by_id.values():
+                    index.index(record)
                 index.commit()
     except BaseException:
         # a half-built workload never reaches the caller; release whatever

@@ -18,6 +18,11 @@ Kernel inventory:
     query x corpus pairs via Myers/Hyyro bit-parallel DP (pattern <= 32
     codepoints, one uint32 word per pair).  Differentially tested against
     ``ops.pairwise.levenshtein_distance_myers`` and the scalar oracle.
+  * ``set_intersection_tiles`` — |A ∩ B| for all query x corpus pairs of
+    hashed id sets (q-grams / tokens): dense equality compare in VMEM,
+    O(T*G) HBM traffic per tile instead of the XLA path's expanded
+    (Q*C, G) pair operands.  Backs ``qgram_sim_tiles`` /
+    ``token_set_sim_tiles``.
 
 Enabling: ``pallas_enabled()`` — env ``DUKE_TPU_PALLAS`` ("1" force on,
 "0" force off); default on only when the active JAX backend is TPU.  On
@@ -181,6 +186,128 @@ def myers_distance_tiles(qchars, qlen, cchars, clen, *, interpret=None):
         qc, ql2, cct, cl2, tile_q=tile_q, tile_c=tile_c, interpret=interpret
     )
     return out[:q, :c]
+
+
+# -- set intersection (q-grams / token sets), tiled --------------------------
+
+
+def _intersect_tile_kernel(qg_ref, qn_ref, cgt_ref, cn_ref, out_ref, *, G: int):
+    """One (TQ, TC) intersection-count tile.
+
+    qg_ref:  (TQ, G)  query gram/token hashes (SET_PAD-padded)
+    qn_ref:  (TQ, 1)  query set sizes
+    cgt_ref: (G, TC)  corpus hashes, transposed
+    cn_ref:  (1, TC)  corpus set sizes
+    out_ref: (TQ, TC) int32 |A ∩ B|
+    """
+    tq = qg_ref.shape[0]
+    tc = cgt_ref.shape[1]
+    qn = qn_ref[...][:, :1]                          # (TQ, 1)
+    cn = cn_ref[...][:1, :]                          # (1, TC)
+    qg = qg_ref[...]                                 # (TQ, G)
+    count = jnp.zeros((tq, tc), jnp.int32)
+
+    # fully static G x G unroll (G <= ~32): Mosaic cannot dynamic-slice the
+    # lane axis, and every step is one (TQ, TC) vector compare on the VPU
+    for i in range(G):
+        qv = qg[:, i : i + 1]                        # (TQ, 1)
+        ivalid = i < qn                              # (TQ, 1)
+        hit = jnp.zeros((tq, tc), jnp.bool_)
+        for j in range(G):
+            jvalid = j < cn                          # (1, TC)
+            hit = hit | ((qv == cgt_ref[j : j + 1, :]) & jvalid)
+        # sets are distinct: each query element matches at most one corpus
+        # element, so OR-then-add counts the intersection exactly
+        count = count + jnp.where(hit & ivalid, 1, 0)
+    out_ref[...] = count
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tile_q", "tile_c", "interpret")
+)
+def _intersect_tiles_padded(qg, qn2, cgt, cn2, *, tile_q, tile_c, interpret):
+    qp, g = qg.shape
+    cp = cgt.shape[1]
+    grid = (qp // tile_q, cp // tile_c)
+    kernel = functools.partial(_intersect_tile_kernel, G=g)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((qp, cp), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_q, g), lambda i, j: (i, 0), memory_space=_VMEM),
+            pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0), memory_space=_VMEM),
+            pl.BlockSpec((g, tile_c), lambda i, j: (0, j), memory_space=_VMEM),
+            pl.BlockSpec((1, tile_c), lambda i, j: (0, j), memory_space=_VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (tile_q, tile_c), lambda i, j: (i, j), memory_space=_VMEM
+        ),
+        interpret=interpret,
+    )(qg, qn2, cgt, cn2)
+
+
+def set_intersection_tiles(qgrams, qn, cgrams, cn, *, interpret=None):
+    """All-pairs |set_i ∩ set_j| -> (Q, C) int32.
+
+    qgrams: (Q, G) int32 hashed ids (SET_PAD-padded); qn: (Q,) set sizes
+    cgrams: (C, G) int32; cn: (C,) — same layout as ops.features GRAM_SET /
+    TOKEN_SET tensors.  Padded rows compute garbage counts that callers
+    mask via validity bits.
+    """
+    q, g = qgrams.shape
+    c = cgrams.shape[0]
+    if interpret is None:
+        interpret = _interpret()
+
+    tile_q = min(128, _round_up(max(q, 1), 8))
+    tile_c = min(512, _round_up(max(c, 1), 128))
+    qp = _round_up(max(q, 1), tile_q)
+    cp = _round_up(max(c, 1), tile_c)
+
+    qg = jnp.zeros((qp, g), jnp.int32).at[:q].set(qgrams)
+    qn2 = jnp.zeros((qp, 1), jnp.int32).at[:q, 0].set(qn)
+    cgt = jnp.zeros((g, cp), jnp.int32).at[:, :c].set(cgrams.T)
+    cn2 = jnp.zeros((1, cp), jnp.int32).at[0, :c].set(cn)
+
+    out = _intersect_tiles_padded(
+        qg, qn2, cgt, cn2, tile_q=tile_q, tile_c=tile_c, interpret=interpret
+    )
+    return out[:q, :c]
+
+
+def qgram_sim_tiles(qgrams, qn, cgrams, cn, equal, *, formula="overlap",
+                    interpret=None):
+    """core.comparators.QGram over all query x corpus pairs: (Q, C) f32."""
+    common = set_intersection_tiles(
+        qgrams, qn, cgrams, cn, interpret=interpret
+    ).astype(jnp.float32)
+    f1 = qn.astype(jnp.float32)[:, None]
+    f2 = cn.astype(jnp.float32)[None, :]
+    if formula == "jaccard":
+        sim = common / jnp.maximum(f1 + f2 - common, 1.0)
+    elif formula == "dice":
+        sim = 2.0 * common / jnp.maximum(f1 + f2, 1.0)
+    else:
+        sim = common / jnp.maximum(jnp.minimum(f1, f2), 1.0)
+    sim = jnp.where((f1 == 0) | (f2 == 0), 0.0, sim)
+    return jnp.where(equal, 1.0, sim)
+
+
+def token_set_sim_tiles(qtokens, qn, ctokens, cn, equal, *, dice=False,
+                        interpret=None):
+    """JaccardIndex / DiceCoefficient over all pairs: (Q, C) f32."""
+    inter = set_intersection_tiles(
+        qtokens, qn, ctokens, cn, interpret=interpret
+    ).astype(jnp.float32)
+    f1 = qn.astype(jnp.float32)[:, None]
+    f2 = cn.astype(jnp.float32)[None, :]
+    if dice:
+        sim = 2.0 * inter / jnp.maximum(f1 + f2, 1.0)
+    else:
+        sim = inter / jnp.maximum(f1 + f2 - inter, 1.0)
+    sim = jnp.where((f1 == 0) | (f2 == 0), 0.0, sim)
+    return jnp.where(equal, 1.0, sim)
 
 
 def levenshtein_sim_tiles(qchars, qlen, cchars, clen, equal, *, interpret=None):

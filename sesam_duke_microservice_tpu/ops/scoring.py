@@ -124,6 +124,39 @@ def _property_sim(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict,
             rows.append(jnp.stack(cols, axis=-1))        # (Q, C, Vc)
         sim = jnp.stack(rows, axis=-2).reshape(-1)       # (Q, C, Vq, Vc)
         return sim, combo_valid
+    if (
+        pallas_ok
+        and kind in (F.GRAM_SET, F.TOKEN_SET)
+        and pk.pallas_enabled()
+    ):
+        # Pallas tiled path: (TQ, TC) intersection tiles in VMEM from
+        # O(T*G) operands — no expanded (Q*C, G) pair arrays in HBM.
+        q = qf["valid"].shape[0]
+        c = cf["valid"].shape[0]
+        vq = qf["valid"].shape[1]
+        vc = cf["valid"].shape[1]
+        eq4 = equal.reshape(q, c, vq, vc)
+        if kind == F.GRAM_SET:
+            gk, nk = "grams", "gram_count"
+            tile_sim = partial(pk.qgram_sim_tiles, formula=cmp.formula)
+        else:
+            gk, nk = "tokens", "token_count"
+            tile_sim = partial(
+                pk.token_set_sim_tiles, dice=isinstance(cmp, C.DiceCoefficient)
+            )
+        rows = []
+        for a in range(vq):
+            cols = [
+                tile_sim(
+                    qf[gk][:, a], qf[nk][:, a],
+                    cf[gk][:, b], cf[nk][:, b],
+                    eq4[:, :, a, b],
+                )
+                for b in range(vc)
+            ]
+            rows.append(jnp.stack(cols, axis=-1))        # (Q, C, Vc)
+        sim = jnp.stack(rows, axis=-2).reshape(-1)       # (Q, C, Vq, Vc)
+        return sim, combo_valid
     if kind == F.CHARS:
         c1, c2 = expand(qf["chars"], cf["chars"])
         l1, l2 = expand(qf["length"], cf["length"])

@@ -33,13 +33,28 @@ def main() -> None:
         enable_persistent_cache()
     app = create_app(backend=args.backend, persistent=not args.ephemeral)
     server = serve(app, port=args.port, host=args.host)
-    logging.getLogger("duke-tpu-service").info(
+    log = logging.getLogger("duke-tpu-service")
+    log.info(
         "Serving on %s:%d (backend=%s)", args.host, args.port, args.backend
     )
+
+    # graceful shutdown on SIGTERM (docker stop) / SIGINT: stop accepting,
+    # then close workloads — flushes link DBs and saves corpus snapshots
+    import signal
+    import threading
+
+    def _shutdown(signum, frame):
+        log.info("signal %d: shutting down", signum)
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGINT, _shutdown)
+    # (SIGINT is rebound above, so no KeyboardInterrupt path exists)
     try:
         server.serve_forever()
-    except KeyboardInterrupt:
-        server.shutdown()
+    finally:
+        app.close()
+        log.info("shutdown complete")
 
 
 if __name__ == "__main__":

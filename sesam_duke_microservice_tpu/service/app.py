@@ -127,6 +127,23 @@ class DukeApp:
     def reload_from_string(self, config_string: str) -> None:
         self.apply_config(parse_config(config_string))
 
+    def close(self) -> None:
+        """Graceful shutdown: close every workload (flushes link DBs and
+        saves device-corpus snapshots).  Called by the CLI's signal
+        handlers — the reference has no shutdown hook at all (state safety
+        there rests on Lucene/H2 syncing every commit)."""
+        with self._swap_lock:
+            workloads = (list(self.deduplications.values())
+                         + list(self.record_linkages.values()))
+            self.deduplications = {}
+            self.record_linkages = {}
+        for wl in workloads:
+            with wl.lock:
+                try:
+                    wl.close()
+                except Exception:
+                    logger.exception("Error closing workload on shutdown")
+
 
 class _HttpError(Exception):
     def __init__(self, status: int, message: str, content_type: str = "text/plain"):

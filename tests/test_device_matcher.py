@@ -337,3 +337,75 @@ class TestDeviceCorpus:
         assert index.corpus.capacity >= 600
         assert index.corpus.capacity % 512 == 0
         assert index.corpus.row_valid[:600].all()
+
+
+class TestSnapshot:
+    def test_snapshot_roundtrip(self, tmp_path):
+        schema = dedup_schema()
+        records = random_records(30, seed=21)
+        log1, index, proc = run_device(schema, [records])
+        path = str(tmp_path / "snap.npz")
+        index.snapshot_save(path)
+
+        by_id = dict(index.records)
+        index2 = DeviceIndex(schema, tunables=MatchTunables())
+        assert index2.snapshot_load(path, by_id) is True
+        assert index2.corpus.size == index.corpus.size
+        assert index2.id_to_row == index.id_to_row
+        # matching over the restored corpus equals matching over the original
+        proc2 = DeviceProcessor(schema, index2)
+        log2 = EventLog()
+        proc2.add_match_listener(log2)
+        probe = random_records(10, seed=77)
+        for i, r in enumerate(probe):
+            r._values["ID"] = [f"p{i}"]
+        proc2.deduplicate(probe)
+
+        log3 = EventLog()
+        proc.listeners[:] = [log3]
+        probe2 = random_records(10, seed=77)
+        for i, r in enumerate(probe2):
+            r._values["ID"] = [f"p{i}"]
+        proc.deduplicate(probe2)
+        assert log2.match_set() == log3.match_set()
+
+    def test_snapshot_rejected_on_store_drift(self, tmp_path):
+        schema = dedup_schema()
+        records = random_records(10, seed=5)
+        _, index, _ = run_device(schema, [records])
+        path = str(tmp_path / "snap.npz")
+        index.snapshot_save(path)
+
+        by_id = dict(index.records)
+        by_id.pop(next(iter(by_id)))  # store lost a record -> stale snapshot
+        index2 = DeviceIndex(schema, tunables=MatchTunables())
+        assert index2.snapshot_load(path, by_id) is False
+        assert index2.corpus.size == 0
+
+    def test_snapshot_rejected_on_schema_change(self, tmp_path):
+        schema = dedup_schema()
+        records = random_records(10, seed=5)
+        _, index, _ = run_device(schema, [records])
+        path = str(tmp_path / "snap.npz")
+        index.snapshot_save(path)
+
+        other = dedup_schema(threshold=0.9)
+        other.properties[1].high = 0.5  # changed probability map
+        index2 = DeviceIndex(other, tunables=MatchTunables())
+        assert index2.snapshot_load(path, dict(index.records)) is False
+
+    def test_snapshot_rejected_on_record_content_change(self, tmp_path):
+        schema = dedup_schema()
+        records = random_records(10, seed=5)
+        _, index, _ = run_device(schema, [records])
+        path = str(tmp_path / "snap.npz")
+        index.snapshot_save(path)
+
+        # same ids, but one record's VALUE changed in the store after the
+        # snapshot was written (update persisted, then crash before re-save)
+        by_id = dict(index.records)
+        changed = make_record(records[0].record_id, name="totally different",
+                              city="oslo", amount="1")
+        by_id[records[0].record_id] = changed
+        index2 = DeviceIndex(schema, tunables=MatchTunables())
+        assert index2.snapshot_load(path, by_id) is False

@@ -152,3 +152,141 @@ def test_scoring_program_with_pallas_enabled(monkeypatch):
     np.testing.assert_allclose(pal[0], base[0], rtol=1e-5, atol=1e-5)
     np.testing.assert_array_equal(pal[1], base[1])
     np.testing.assert_array_equal(pal[2], base[2])
+
+
+def _encode_sets(value_lists, slots=12):
+    from sesam_duke_microservice_tpu.ops.features import SET_PAD
+
+    n = len(value_lists)
+    grams = np.full((n, slots), SET_PAD, np.int32)
+    counts = np.zeros((n,), np.int32)
+    rng = np.random.default_rng(42)
+    pool = rng.integers(-2**31, 2**31 - 1, size=1000).astype(np.int32)
+    for i, ids in enumerate(value_lists):
+        distinct = sorted({int(pool[k % 1000]) for k in ids})[:slots]
+        grams[i, : len(distinct)] = distinct
+        counts[i] = len(distinct)
+    return jnp.asarray(grams), jnp.asarray(counts)
+
+
+SETS_Q = [[1, 2, 3], [4, 5], [], [1, 2, 3, 4, 5, 6, 7], [9], [1, 9, 17]]
+SETS_C = [[1, 2], [5], [3, 4, 5], [], [1, 2, 3, 4, 5, 6, 7], [8, 9, 10]]
+
+
+def test_set_intersection_tiles_vs_flat():
+    qg, qn = _encode_sets(SETS_Q)
+    cg, cn = _encode_sets(SETS_C)
+    got = np.asarray(
+        pk.set_intersection_tiles(qg, qn, cg, cn, interpret=True)
+    )
+    nq, nc = len(SETS_Q), len(SETS_C)
+    g1 = jnp.repeat(qg, nc, axis=0)
+    n1 = jnp.repeat(qn, nc)
+    g2 = jnp.tile(cg, (nq, 1))
+    n2 = jnp.tile(cn, (nq,))
+    want = np.asarray(pw.set_intersection_count(g1, n1, g2, n2)).reshape(
+        nq, nc
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_qgram_sim_tiles_vs_flat():
+    qg, qn = _encode_sets(SETS_Q)
+    cg, cn = _encode_sets(SETS_C)
+    nq, nc = len(SETS_Q), len(SETS_C)
+    equal = jnp.zeros((nq, nc), bool)
+    for formula in ("overlap", "jaccard", "dice"):
+        got = np.asarray(pk.qgram_sim_tiles(
+            qg, qn, cg, cn, equal, formula=formula, interpret=True
+        ))
+        g1 = jnp.repeat(qg, nc, axis=0)
+        n1 = jnp.repeat(qn, nc)
+        g2 = jnp.tile(cg, (nq, 1))
+        n2 = jnp.tile(cn, (nq,))
+        want = np.asarray(pw.qgram_sim(
+            g1, n1, g2, n2, equal.reshape(-1), formula=formula
+        )).reshape(nq, nc)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_token_set_sim_tiles_vs_flat():
+    qg, qn = _encode_sets(SETS_Q)
+    cg, cn = _encode_sets(SETS_C)
+    nq, nc = len(SETS_Q), len(SETS_C)
+    equal = jnp.zeros((nq, nc), bool)
+    for dice in (False, True):
+        got = np.asarray(pk.token_set_sim_tiles(
+            qg, qn, cg, cn, equal, dice=dice, interpret=True
+        ))
+        g1 = jnp.repeat(qg, nc, axis=0)
+        n1 = jnp.repeat(qn, nc)
+        g2 = jnp.tile(cg, (nq, 1))
+        n2 = jnp.tile(cn, (nq,))
+        want = np.asarray(pw.token_set_sim(
+            g1, n1, g2, n2, equal.reshape(-1), dice=dice
+        )).reshape(nq, nc)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_scoring_program_set_kernels_pallas_wiring(monkeypatch):
+    """The GRAM_SET/TOKEN_SET pallas branch agrees with the XLA path."""
+    monkeypatch.setenv("DUKE_TPU_PALLAS", "0")
+    import jax
+
+    from sesam_duke_microservice_tpu.core.config import DukeSchema
+    from sesam_duke_microservice_tpu.core.records import (
+        ID_PROPERTY_NAME,
+        Property,
+        Record,
+    )
+    from sesam_duke_microservice_tpu.ops import features as F
+    from sesam_duke_microservice_tpu.ops import scoring as S
+
+    schema = DukeSchema(
+        threshold=0.8,
+        maybe_threshold=None,
+        properties=[
+            Property(ID_PROPERTY_NAME, id_property=True),
+            Property("SSN", C.QGram(), 0.2, 0.9),
+            Property("TAGS", C.JaccardIndex(), 0.3, 0.8),
+        ],
+        data_sources=[],
+    )
+    plan = F.SchemaFeatures.plan(schema)
+    rows = [("12345678", "red green"), ("12345679", "red green"),
+            ("87654321", "blue"), ("12340078", "green yellow"),
+            ("11112222", "red"), ("12345678", "purple orange")]
+    records = []
+    for i, (ssn, tags) in enumerate(rows):
+        r = Record()
+        r.add_value(ID_PROPERTY_NAME, f"d__{i}")
+        r.add_value("SSN", ssn)
+        r.add_value("TAGS", tags)
+        records.append(r)
+    feats = F.extract_batch(plan, records)
+    to_dev = lambda t: {p: {k: jnp.asarray(a) for k, a in d.items()}
+                        for p, d in t.items()}
+    dev = to_dev(feats)
+    n = len(records)
+    valid = jnp.ones((n,), bool)
+    deleted = jnp.zeros((n,), bool)
+    group = jnp.full((n,), -1, jnp.int32)
+    qrow = jnp.arange(n, dtype=jnp.int32)
+    qgroup = jnp.full((n,), -2, jnp.int32)
+
+    def run():
+        pair_logits = S.build_pair_logits(plan)
+        return jax.tree_util.tree_map(
+            np.asarray,
+            S.scan_topk(
+                pair_logits, dev, dev, valid, deleted, group, qgroup, qrow,
+                jnp.float32(0.0), chunk=2, top_k=4, group_filtering=False,
+            ),
+        )
+
+    base = run()
+    monkeypatch.setenv("DUKE_TPU_PALLAS", "1")
+    pal = run()
+    np.testing.assert_allclose(pal[0], base[0], rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(pal[1], base[1])
+    np.testing.assert_array_equal(pal[2], base[2])

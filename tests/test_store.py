@@ -160,3 +160,50 @@ def test_restart_preserves_deletion_tombstones(tmp_path):
     assert frozenset(("1", "4")) in live_pairs
     assert frozenset(("2", "4")) not in live_pairs
     wl2.close()
+
+
+def test_workload_restart_uses_corpus_snapshot(tmp_path, monkeypatch):
+    """Device-backend restart restores tensors from the snapshot without
+    re-running feature extraction; a missing snapshot replays instead."""
+    from sesam_duke_microservice_tpu.core.config import parse_config
+    from sesam_duke_microservice_tpu.engine.device_matcher import DeviceIndex
+    from sesam_duke_microservice_tpu.engine.workload import build_workload
+
+    xml = f"""
+    <DukeMicroService dataFolder="{tmp_path}">
+      <Deduplication name="w" link-database-type="in-memory">
+        <duke>
+          <schema>
+            <threshold>0.8</threshold>
+            <property><name>NAME</name>
+              <comparator>levenshtein</comparator><low>0.1</low><high>0.9</high>
+            </property>
+          </schema>
+          <data-source class="io.sesam.dukemicroservice.IncrementalDeduplicationDataSource">
+            <param name="dataset-id" value="d"/>
+            <column name="name" property="NAME"/>
+          </data-source>
+        </duke>
+      </Deduplication>
+    </DukeMicroService>
+    """
+    sc = parse_config(xml)
+    wc = sc.deduplications["w"]
+
+    wl = build_workload(wc, sc, backend="device", persistent=True)
+    with wl.lock:
+        wl.process_batch("d", [{"_id": f"r{i}", "name": f"acme {i}"}
+                               for i in range(12)])
+    assert wl.index.corpus.size == 12
+    wl.close()  # saves the snapshot
+
+    # restart: extraction must NOT run (snapshot covers the whole store)
+    def boom(self, records):
+        raise AssertionError("extraction ran despite snapshot")
+
+    monkeypatch.setattr(DeviceIndex, "_extract", boom)
+    wl2 = build_workload(wc, sc, backend="device", persistent=True)
+    assert wl2.index.corpus.size == 12
+    assert len(wl2.index.records) == 12
+    monkeypatch.undo()
+    wl2.close()
