@@ -411,16 +411,35 @@ class DeviceIndex(CandidateIndex):
             for prop, tensors in corpus.feats.items()
             for name, arr in tensors.items()
         }
-        np.savez_compressed(
-            path,
-            __fingerprint=np.array(self._snapshot_fingerprint()),
-            __content=np.array(_records_content_hash(self.records)),
-            __row_valid=corpus.row_valid[: corpus.size],
-            __row_deleted=corpus.row_deleted[: corpus.size],
-            __row_group=corpus.row_group[: corpus.size],
-            __row_ids=np.array(corpus.row_ids, dtype=object),
-            **flat,
-        )
+        # write-then-rename: a SIGKILL mid-save must never leave a truncated
+        # snapshot (np.load would fail and silently force a full replay)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            np.savez_compressed(
+                tmp,
+                __fingerprint=np.array(self._snapshot_fingerprint()),
+                __content=np.array(_records_content_hash(self.records)),
+                __row_valid=corpus.row_valid[: corpus.size],
+                __row_deleted=corpus.row_deleted[: corpus.size],
+                __row_group=corpus.row_group[: corpus.size],
+                # fixed-width unicode, NOT object dtype: object arrays
+                # pickle, and a pickle-bearing snapshot would force
+                # allow_pickle=True at load — an arbitrary-code-execution
+                # vector for anyone who can write the data volume
+                __row_ids=np.array(
+                    [rid or "" for rid in corpus.row_ids], dtype=str
+                ),
+                **flat,
+            )
+            # np.savez appends .npz to names without it
+            os.replace(tmp if tmp.endswith(".npz") else f"{tmp}.npz", path)
+        except BaseException:
+            for cand in (tmp, f"{tmp}.npz"):
+                try:
+                    os.unlink(cand)
+                except OSError:
+                    pass
+            raise
 
     def snapshot_load(self, path: str,
                       records_by_id: Dict[str, Record]) -> bool:
@@ -432,7 +451,7 @@ class DeviceIndex(CandidateIndex):
         if self.corpus.size != 0 or not os.path.exists(path):
             return False
         try:
-            with np.load(path, allow_pickle=True) as data:
+            with np.load(path) as data:  # no pickle: plain arrays only
                 if str(data["__fingerprint"]) != self._snapshot_fingerprint():
                     return False
                 # record CONTENT hash, not just the id set: an id-set check

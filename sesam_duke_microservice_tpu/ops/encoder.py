@@ -21,6 +21,7 @@ the encoder is deterministic from the schema alone.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -169,7 +170,18 @@ def retrieval_scan(q_emb, corpus_emb, corpus_valid, corpus_deleted,
     score is a single (Q, D) x (D, chunk) matmul in bf16 with f32
     accumulation — the MXU path.  Returns (top_sim, top_index) with global
     row indices (``row_offset`` as in scan_topk for sharded use).
+
+    The scan chunk is widened to ``DEVICE_ANN_RETRIEVAL_CHUNK`` (default
+    16384, measured optimum at 1M rows on v5e: 3.45 s -> 2.19 s per
+    1024-query batch) when the corpus allows: the matmul is so cheap per
+    row that per-step overhead (top_k merge, scan bookkeeping) dominates
+    with small chunks.  Capacities are power-of-2 multiples of the base
+    chunk, so any power-of-2 widening divides evenly.
     """
+    wide = int(os.environ.get("DEVICE_ANN_RETRIEVAL_CHUNK", "16384"))
+    cap_total = corpus_valid.shape[0]
+    while chunk < wide and chunk * 2 <= cap_total and cap_total % (chunk * 2) == 0:
+        chunk *= 2
     import jax
     import jax.numpy as jnp
     from jax import lax

@@ -292,32 +292,40 @@ def set_intersection_count(a, na, b, nb):
     return hits.sum(axis=(1, 2)).astype(jnp.int32)
 
 
-def qgram_sim(g1, n1, g2, n2, equal, *, formula="overlap"):
-    """core.comparators.QGram.compare over precomputed distinct-gram sets."""
-    common = set_intersection_count(g1, n1, g2, n2).astype(jnp.float32)
-    f1 = n1.astype(jnp.float32)
-    f2 = n2.astype(jnp.float32)
+def sim_from_set_intersection(common, f1, f2, equal, *, formula):
+    """Shared |A ∩ B| -> similarity map for every set comparator.
+
+    One copy of the overlap/jaccard/dice math (plus the empty-set zero and
+    exact-equality override) used by both the flat kernels here and the
+    Pallas tile kernels — operands broadcast, so (P,) and (Q,1)x(1,C)
+    shapes both work.  QGram uses all three formulas; JaccardIndex ≡
+    'jaccard'; DiceCoefficient ≡ 'dice' (core.comparators semantics).
+    """
+    common = common.astype(jnp.float32)
+    f1 = f1.astype(jnp.float32)
+    f2 = f2.astype(jnp.float32)
     if formula == "jaccard":
         sim = common / jnp.maximum(f1 + f2 - common, 1.0)
     elif formula == "dice":
         sim = 2.0 * common / jnp.maximum(f1 + f2, 1.0)
     else:
         sim = common / jnp.maximum(jnp.minimum(f1, f2), 1.0)
-    sim = jnp.where((n1 == 0) | (n2 == 0), 0.0, sim)
+    sim = jnp.where((f1 == 0) | (f2 == 0), 0.0, sim)
     return jnp.where(equal, 1.0, sim)
+
+
+def qgram_sim(g1, n1, g2, n2, equal, *, formula="overlap"):
+    """core.comparators.QGram.compare over precomputed distinct-gram sets."""
+    common = set_intersection_count(g1, n1, g2, n2)
+    return sim_from_set_intersection(common, n1, n2, equal, formula=formula)
 
 
 def token_set_sim(t1, n1, t2, n2, equal, *, dice=False):
     """JaccardIndex (dice=False) / DiceCoefficient (dice=True) over token sets."""
-    inter = set_intersection_count(t1, n1, t2, n2).astype(jnp.float32)
-    f1 = n1.astype(jnp.float32)
-    f2 = n2.astype(jnp.float32)
-    if dice:
-        sim = 2.0 * inter / jnp.maximum(f1 + f2, 1.0)
-    else:
-        sim = inter / jnp.maximum(f1 + f2 - inter, 1.0)
-    sim = jnp.where((n1 == 0) | (n2 == 0), 0.0, sim)
-    return jnp.where(equal, 1.0, sim)
+    inter = set_intersection_count(t1, n1, t2, n2)
+    return sim_from_set_intersection(
+        inter, n1, n2, equal, formula="dice" if dice else "jaccard"
+    )
 
 
 # -- scalar comparators ------------------------------------------------------

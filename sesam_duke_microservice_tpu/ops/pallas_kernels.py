@@ -21,8 +21,8 @@ Kernel inventory:
   * ``set_intersection_tiles`` — |A ∩ B| for all query x corpus pairs of
     hashed id sets (q-grams / tokens): dense equality compare in VMEM,
     O(T*G) HBM traffic per tile instead of the XLA path's expanded
-    (Q*C, G) pair operands.  Backs ``qgram_sim_tiles`` /
-    ``token_set_sim_tiles``.
+    (Q*C, G) pair operands.  Backs ``set_sim_tiles`` (QGram / Jaccard /
+    Dice).
 
 Enabling: ``pallas_enabled()`` — env ``DUKE_TPU_PALLAS`` ("1" force on,
 "0" force off); default on only when the active JAX backend is TPU.  On
@@ -276,38 +276,22 @@ def set_intersection_tiles(qgrams, qn, cgrams, cn, *, interpret=None):
     return out[:q, :c]
 
 
-def qgram_sim_tiles(qgrams, qn, cgrams, cn, equal, *, formula="overlap",
-                    interpret=None):
-    """core.comparators.QGram over all query x corpus pairs: (Q, C) f32."""
-    common = set_intersection_tiles(
-        qgrams, qn, cgrams, cn, interpret=interpret
-    ).astype(jnp.float32)
-    f1 = qn.astype(jnp.float32)[:, None]
-    f2 = cn.astype(jnp.float32)[None, :]
-    if formula == "jaccard":
-        sim = common / jnp.maximum(f1 + f2 - common, 1.0)
-    elif formula == "dice":
-        sim = 2.0 * common / jnp.maximum(f1 + f2, 1.0)
-    else:
-        sim = common / jnp.maximum(jnp.minimum(f1, f2), 1.0)
-    sim = jnp.where((f1 == 0) | (f2 == 0), 0.0, sim)
-    return jnp.where(equal, 1.0, sim)
+def set_sim_tiles(qids, qn, cids, cn, equal, *, formula,
+                  interpret=None):
+    """Set-comparator similarity over all query x corpus pairs: (Q, C) f32.
 
+    One tile entry point for QGram (``formula`` = its configured formula),
+    JaccardIndex ('jaccard'), and DiceCoefficient ('dice'); the
+    intersection -> similarity math is the shared
+    ``ops.pairwise.sim_from_set_intersection``, so the tile and flat paths
+    cannot drift.
+    """
+    from .pairwise import sim_from_set_intersection
 
-def token_set_sim_tiles(qtokens, qn, ctokens, cn, equal, *, dice=False,
-                        interpret=None):
-    """JaccardIndex / DiceCoefficient over all pairs: (Q, C) f32."""
-    inter = set_intersection_tiles(
-        qtokens, qn, ctokens, cn, interpret=interpret
-    ).astype(jnp.float32)
-    f1 = qn.astype(jnp.float32)[:, None]
-    f2 = cn.astype(jnp.float32)[None, :]
-    if dice:
-        sim = 2.0 * inter / jnp.maximum(f1 + f2, 1.0)
-    else:
-        sim = inter / jnp.maximum(f1 + f2 - inter, 1.0)
-    sim = jnp.where((f1 == 0) | (f2 == 0), 0.0, sim)
-    return jnp.where(equal, 1.0, sim)
+    common = set_intersection_tiles(qids, qn, cids, cn, interpret=interpret)
+    return sim_from_set_intersection(
+        common, qn[:, None], cn[None, :], equal, formula=formula
+    )
 
 
 def levenshtein_sim_tiles(qchars, qlen, cchars, clen, equal, *, interpret=None):

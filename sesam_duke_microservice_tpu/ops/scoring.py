@@ -83,6 +83,19 @@ def _pair_expand_gathered(qa: jnp.ndarray, ca: jnp.ndarray) -> tuple:
     return a.reshape((q * c * v * v,) + rq), b.reshape((q * c * v * v,) + rc)
 
 
+def _tiled_combo_sim(tile_fn, q: int, c: int, vq: int, vc: int,
+                     equal) -> jnp.ndarray:
+    """Shared value-combo scaffold for the Pallas tile branches: run a
+    (Q, C) tile kernel per (query-value, corpus-value) slot pair and stack
+    into the flat (Q*C*Vq*Vc,) layout ``_pair_expand`` produces."""
+    eq4 = equal.reshape(q, c, vq, vc)
+    rows = []
+    for a in range(vq):
+        cols = [tile_fn(a, b, eq4[:, :, a, b]) for b in range(vc)]
+        rows.append(jnp.stack(cols, axis=-1))         # (Q, C, Vc)
+    return jnp.stack(rows, axis=-2).reshape(-1)       # (Q, C, Vq, Vc)
+
+
 def _property_sim(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict,
                   expand=_pair_expand, pallas_ok: bool = True) -> tuple:
     """Pair similarity for one property.
@@ -106,23 +119,14 @@ def _property_sim(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict,
     ):
         # Pallas tiled path: (TQ, TC) distance tiles computed in VMEM from
         # O(T*L) operands — no expanded (Q*C, L) pair arrays in HBM.
-        q = qf["valid"].shape[0]
-        c = cf["valid"].shape[0]
-        vq = qf["chars"].shape[1]
-        vc = cf["chars"].shape[1]
-        eq4 = equal.reshape(q, c, vq, vc)
-        rows = []
-        for a in range(vq):
-            cols = [
-                pk.levenshtein_sim_tiles(
-                    qf["chars"][:, a], qf["length"][:, a],
-                    cf["chars"][:, b], cf["length"][:, b],
-                    eq4[:, :, a, b],
-                )
-                for b in range(vc)
-            ]
-            rows.append(jnp.stack(cols, axis=-1))        # (Q, C, Vc)
-        sim = jnp.stack(rows, axis=-2).reshape(-1)       # (Q, C, Vq, Vc)
+        sim = _tiled_combo_sim(
+            lambda a, b, eq: pk.levenshtein_sim_tiles(
+                qf["chars"][:, a], qf["length"][:, a],
+                cf["chars"][:, b], cf["length"][:, b], eq,
+            ),
+            qf["valid"].shape[0], cf["valid"].shape[0],
+            qf["chars"].shape[1], cf["chars"].shape[1], equal,
+        )
         return sim, combo_valid
     if (
         pallas_ok
@@ -131,31 +135,19 @@ def _property_sim(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict,
     ):
         # Pallas tiled path: (TQ, TC) intersection tiles in VMEM from
         # O(T*G) operands — no expanded (Q*C, G) pair arrays in HBM.
-        q = qf["valid"].shape[0]
-        c = cf["valid"].shape[0]
-        vq = qf["valid"].shape[1]
-        vc = cf["valid"].shape[1]
-        eq4 = equal.reshape(q, c, vq, vc)
         if kind == F.GRAM_SET:
-            gk, nk = "grams", "gram_count"
-            tile_sim = partial(pk.qgram_sim_tiles, formula=cmp.formula)
+            gk, nk, formula = "grams", "gram_count", cmp.formula
         else:
             gk, nk = "tokens", "token_count"
-            tile_sim = partial(
-                pk.token_set_sim_tiles, dice=isinstance(cmp, C.DiceCoefficient)
-            )
-        rows = []
-        for a in range(vq):
-            cols = [
-                tile_sim(
-                    qf[gk][:, a], qf[nk][:, a],
-                    cf[gk][:, b], cf[nk][:, b],
-                    eq4[:, :, a, b],
-                )
-                for b in range(vc)
-            ]
-            rows.append(jnp.stack(cols, axis=-1))        # (Q, C, Vc)
-        sim = jnp.stack(rows, axis=-2).reshape(-1)       # (Q, C, Vq, Vc)
+            formula = "dice" if isinstance(cmp, C.DiceCoefficient) else "jaccard"
+        sim = _tiled_combo_sim(
+            lambda a, b, eq: pk.set_sim_tiles(
+                qf[gk][:, a], qf[nk][:, a],
+                cf[gk][:, b], cf[nk][:, b], eq, formula=formula,
+            ),
+            qf["valid"].shape[0], cf["valid"].shape[0],
+            qf["valid"].shape[1], cf["valid"].shape[1], equal,
+        )
         return sim, combo_valid
     if kind == F.CHARS:
         c1, c2 = expand(qf["chars"], cf["chars"])

@@ -196,7 +196,7 @@ def test_qgram_sim_tiles_vs_flat():
     nq, nc = len(SETS_Q), len(SETS_C)
     equal = jnp.zeros((nq, nc), bool)
     for formula in ("overlap", "jaccard", "dice"):
-        got = np.asarray(pk.qgram_sim_tiles(
+        got = np.asarray(pk.set_sim_tiles(
             qg, qn, cg, cn, equal, formula=formula, interpret=True
         ))
         g1 = jnp.repeat(qg, nc, axis=0)
@@ -215,8 +215,9 @@ def test_token_set_sim_tiles_vs_flat():
     nq, nc = len(SETS_Q), len(SETS_C)
     equal = jnp.zeros((nq, nc), bool)
     for dice in (False, True):
-        got = np.asarray(pk.token_set_sim_tiles(
-            qg, qn, cg, cn, equal, dice=dice, interpret=True
+        got = np.asarray(pk.set_sim_tiles(
+            qg, qn, cg, cn, equal,
+            formula="dice" if dice else "jaccard", interpret=True
         ))
         g1 = jnp.repeat(qg, nc, axis=0)
         n1 = jnp.repeat(qn, nc)
