@@ -1,22 +1,29 @@
-"""Dedup F1 stresstest harness: seeded corpus with known ground truth.
+"""Matching-quality (F1) stresstest harness: seeded corpus, known truth.
 
 The reference validates matching quality only through the external Sesam
-stresstest pipes (sesam_node_deduplication_stresstest_config.conf.json:
-86-106 — 10,000 fake entities per source, seed 1234, value pools sized so
-duplicates occur at a known rate, SURVEY.md section 4).  This harness is
-the in-process equivalent with a *measurable* ground truth: every record
-derives from a true underlying identity, field values are perturbed with
-seeded noise (typos, digit swaps, missing fields), and two records are true
-duplicates iff they share the identity.  That turns the BASELINE.json
-metric ("dedup F1 @ fixed wall-clock") into a number.
+stresstest pipes (sesam_node_deduplication_stresstest_config.conf.json and
+its recordlinkage twin — 10,000 fake entities per source, seed 1234, value
+pools sized so duplicates occur at a known rate, SURVEY.md section 4).
+This harness is the in-process equivalent with a *measurable* ground
+truth: every record derives from a true underlying identity, field values
+are perturbed with seeded noise (typos, digit swaps), and two records are
+true duplicates/links iff they share the identity.  That turns the
+BASELINE.json metric ("dedup F1 @ fixed wall-clock") into a number.
+
+Workloads: ``--workload dedup`` (one group, duplicates within) or
+``--workload linkage`` (two groups over a shared identity pool, group
+filtering on; ``--one-to-one`` additionally attaches the real ONE_TO_ONE
+service listener and scores its surviving links).
 
 Usage::
 
     python benchmarks/f1_stresstest.py [--backend host|device|ann]
-        [--entities 2000] [--dup-rate 0.3] [--batch 500]
+        [--workload dedup|linkage] [--one-to-one]
+        [--entities 2000] [--dup-rate 0.3] [--batch 500] [--seed 1234]
 
-Prints one JSON line: {"backend", "f1", "precision", "recall",
-"wall_s", "records_per_sec", "true_pairs", "emitted_pairs"}.
+Prints one JSON line: {"backend", "workload", "f1", "precision",
+"recall", "wall_s", "records_per_sec", "true_pairs", "emitted_pairs",
+(+ ProfileStats fields when the backend exposes them)}.
 """
 
 from __future__ import annotations
@@ -160,7 +167,7 @@ class PairCollector:
         pass
 
 
-def build_processor(schema, backend: str):
+def build_processor(schema, backend: str, group_filtering: bool = False):
     from sesam_duke_microservice_tpu.core.config import MatchTunables
 
     if backend in ("device", "ann"):
@@ -176,7 +183,8 @@ def build_processor(schema, backend: str):
         )
 
         index = DeviceIndex(schema, tunables=MatchTunables())
-        return DeviceProcessor(schema, index)
+        return DeviceProcessor(schema, index,
+                               group_filtering=group_filtering)
     if backend == "ann":
         from sesam_duke_microservice_tpu.engine.ann_matcher import (
             AnnIndex,
@@ -184,12 +192,12 @@ def build_processor(schema, backend: str):
         )
 
         index = AnnIndex(schema, tunables=MatchTunables())
-        return AnnProcessor(schema, index)
+        return AnnProcessor(schema, index, group_filtering=group_filtering)
     from sesam_duke_microservice_tpu.engine.processor import Processor
     from sesam_duke_microservice_tpu.index.inverted import InvertedIndex
 
     index = InvertedIndex(schema, MatchTunables())
-    return Processor(schema, index)
+    return Processor(schema, index, group_filtering=group_filtering)
 
 
 def to_records(rows):
@@ -212,27 +220,103 @@ def to_records(rows):
     return records
 
 
+def generate_linkage(n_per_group: int, overlap: float, seed: int = 1234):
+    """Two-group corpus (reference recordlinkage stresstest shape): both
+    groups drawn from a shared identity pool; a cross-group pair is a true
+    link iff the identities match."""
+    rows, truth = generate(int(n_per_group * 2 * (1 + overlap)), overlap,
+                           seed)
+    g1, g2 = [], []
+    for i, row in enumerate(rows):
+        (g1 if i % 2 == 0 else g2).append(row)
+    g1, g2 = g1[:n_per_group], g2[:n_per_group]
+    # truth maps must cover exactly the ingested rows (truncated rows would
+    # count as unreachable expected links and depress recall artificially)
+    t1 = {row["_id"]: truth[row["_id"]] for row in g1}
+    t2 = {row["_id"]: truth[row["_id"]] for row in g2}
+    return g1, g2, t1, t2
+
+
+def truth_links(t1, t2):
+    by_ident = defaultdict(list)
+    for rid, ident in t2.items():
+        by_ident[ident].append(rid)
+    links = set()
+    for rid, ident in t1.items():
+        for other in by_ident.get(ident, ()):
+            links.add(tuple(sorted((rid, other))))
+    return links
+
+
 def run(backend: str, n_entities: int, dup_rate: float, batch: int,
-        seed: int = 1234):
-    rows, truth = generate(n_entities, dup_rate, seed)
-    records = to_records(rows)
+        seed: int = 1234, workload: str = "dedup",
+        one_to_one: bool = False):
+    from sesam_duke_microservice_tpu.core.records import (
+        GROUP_NO_PROPERTY_NAME,
+    )
+
+    if workload == "linkage":
+        g1, g2, t1, t2 = generate_linkage(n_entities // 2, dup_rate, seed)
+        r1, r2 = to_records(g1), to_records(g2)
+        for r in r1:
+            r.add_value(GROUP_NO_PROPERTY_NAME, "1")
+        for r in r2:
+            r.add_value(GROUP_NO_PROPERTY_NAME, "2")
+        records = r1 + r2
+        expected_links = truth_links(t1, t2)
+    else:
+        rows, truth = generate(n_entities, dup_rate, seed)
+        records = to_records(rows)
+        expected_links = None
+
     schema = stresstest_schema()
-    proc = build_processor(schema, backend)
-    collector = PairCollector()
-    proc.add_match_listener(collector)
+    proc = build_processor(schema, backend,
+                           group_filtering=(workload == "linkage"))
+    if one_to_one:
+        # the REAL service policy (per-batch greedy resolution with
+        # cross-batch retraction), not a post-hoc approximation: attach the
+        # actual listener over an in-memory link DB and read its live links
+        from sesam_duke_microservice_tpu.engine.listeners import (
+            ServiceMatchListener,
+        )
+        from sesam_duke_microservice_tpu.links.base import LinkStatus
+        from sesam_duke_microservice_tpu.links.memory import (
+            InMemoryLinkDatabase,
+        )
+
+        linkdb = InMemoryLinkDatabase()
+        listener = ServiceMatchListener(
+            "bench", linkdb,
+            kind="recordlinkage" if workload == "linkage" else "deduplication",
+            one_to_one=True,
+        )
+        proc.add_match_listener(listener)
+    else:
+        collector = PairCollector()
+        proc.add_match_listener(collector)
 
     t0 = time.perf_counter()
     for start in range(0, len(records), batch):
         proc.deduplicate(records[start:start + batch])
     wall = time.perf_counter() - t0
 
+    if one_to_one:
+        pair_items = {
+            (link.id1, link.id2): link.confidence
+            for link in linkdb.get_changes_since(0)
+            if link.status != LinkStatus.RETRACTED
+        }
+    else:
+        pair_items = collector.pairs
+
     stats = getattr(proc, "stats", None)
 
     emitted = {
-        (a.split("__", 1)[1], b.split("__", 1)[1])
-        for a, b in collector.pairs
+        tuple(sorted((a.split("__", 1)[1], b.split("__", 1)[1])))
+        for a, b in pair_items
     }
-    expected = truth_pairs(truth)
+    expected = (expected_links if expected_links is not None
+                else truth_pairs(truth))
     tp = len(emitted & expected)
     precision = tp / len(emitted) if emitted else 0.0
     recall = tp / len(expected) if expected else 1.0
@@ -240,6 +324,7 @@ def run(backend: str, n_entities: int, dup_rate: float, batch: int,
           if precision + recall else 0.0)
     out = {
         "backend": backend,
+        "workload": workload,
         "f1": round(f1, 4),
         "precision": round(precision, 4),
         "recall": round(recall, 4),
@@ -263,10 +348,14 @@ def main():
     ap.add_argument("--dup-rate", type=float, default=0.3)
     ap.add_argument("--batch", type=int, default=500)
     ap.add_argument("--seed", type=int, default=1234)
+    ap.add_argument("--workload", default="dedup",
+                    choices=["dedup", "linkage"])
+    ap.add_argument("--one-to-one", action="store_true",
+                    help="greedy best-match assignment (ONE_TO_ONE policy)")
     args = ap.parse_args()
     print(json.dumps(
         run(args.backend, args.entities, args.dup_rate, args.batch,
-            args.seed)
+            args.seed, workload=args.workload, one_to_one=args.one_to_one)
     ))
 
 
