@@ -223,15 +223,16 @@ def to_records(rows):
 def generate_linkage(n_per_group: int, overlap: float, seed: int = 1234):
     """Two-group corpus (reference recordlinkage stresstest shape): both
     groups drawn from a shared identity pool; a cross-group pair is a true
-    link iff the identities match."""
-    rows, truth = generate(int(n_per_group * 2 * (1 + overlap)), overlap,
-                           seed)
+    link iff the identities match.
+
+    Exactly 2*n_per_group rows are generated and round-robin split — no
+    over-generation/truncation, so the duplicate rate stays ``overlap``
+    (truncating would keep only overlap^2 of the duplicate rows, since
+    generate() emits all duplicates after the canonical block)."""
+    rows, truth = generate(n_per_group * 2, overlap, seed)
     g1, g2 = [], []
     for i, row in enumerate(rows):
         (g1 if i % 2 == 0 else g2).append(row)
-    g1, g2 = g1[:n_per_group], g2[:n_per_group]
-    # truth maps must cover exactly the ingested rows (truncated rows would
-    # count as unreachable expected links and depress recall artificially)
     t1 = {row["_id"]: truth[row["_id"]] for row in g1}
     t2 = {row["_id"]: truth[row["_id"]] for row in g2}
     return g1, g2, t1, t2

@@ -143,3 +143,30 @@ def test_registry_java_names():
 def test_set_param_unknown_raises():
     with pytest.raises(KeyError):
         C.Numeric().set_param("no-such-param", "1")
+
+
+def test_comparators_long_unicode_values():
+    """Probe: 200-char unicode values through every registered comparator
+    class must return a finite [0, 1] similarity without raising."""
+    import math
+
+    from sesam_duke_microservice_tpu.core.comparators import (
+        _REGISTRY,
+        Comparator,
+    )
+
+    v1 = ("åßñ漢字œø" * 40)[:200]
+    v2 = ("åßñ漢字œzx" * 40)[:200] + "!"
+    seen = set()
+    for cls in _REGISTRY.values():
+        if cls in seen or not issubclass(cls, Comparator):
+            continue
+        seen.add(cls)
+        cmp = cls()
+        sim = cmp.compare(v1, v2)
+        assert isinstance(sim, float) and math.isfinite(sim), cls.__name__
+        assert -1e-9 <= sim <= 1.0 + 1e-9, (cls.__name__, sim)
+        if not cls.__name__.startswith("Different"):
+            # string comparators: identity -> 1.0; numeric/geo on
+            # unparseable text -> neutral 0.5 (Duke semantics)
+            assert cmp.compare(v1, v1) >= 0.5 - 1e-9, cls.__name__

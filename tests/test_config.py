@@ -228,3 +228,31 @@ def test_sqlite_alias_and_bad_linkdb():
         MINIMAL_DEDUP.replace('name="d"', 'name="d" link-database-type="bogus"'),
         "unknown 'link-database-type'",
     )
+
+
+def test_malformed_xml_raises_config_error():
+    from sesam_duke_microservice_tpu.core.config import ConfigError, parse_config
+
+    with pytest.raises(ConfigError):
+        parse_config("<DukeMicroService><Dedup")  # truncated document
+
+
+def test_unknown_comparator_name_rejected():
+    from sesam_duke_microservice_tpu.core.config import parse_config
+
+    bad = MINIMAL_DEDUP.replace(
+        "<comparator>levenshtein</comparator>",
+        "<comparator>no.such.ComparatorAtAll</comparator>",
+    )
+    with pytest.raises(Exception) as err:
+        parse_config(bad)
+    assert "omparator" in str(err.value)
+
+
+def test_empty_dataset_id_rejected():
+    from sesam_duke_microservice_tpu.core.config import parse_config
+
+    bad = MINIMAL_DEDUP.replace('value="ds1"', 'value=""')
+    with pytest.raises(Exception) as err:
+        parse_config(bad)
+    assert "dataset" in str(err.value).lower()
