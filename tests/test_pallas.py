@@ -291,3 +291,108 @@ def test_scoring_program_set_kernels_pallas_wiring(monkeypatch):
     np.testing.assert_allclose(pal[0], base[0], rtol=1e-5, atol=1e-5)
     np.testing.assert_array_equal(pal[1], base[1])
     np.testing.assert_array_equal(pal[2], base[2])
+
+
+JW_QUERIES = ["martha", "dixon", "jellyfish", "", "dwayne", "arnab",
+              "aabbcc", "identical", "ab"]
+JW_CORPUS = ["marhta", "dicksonx", "smellyfish", "word", "duane", "raabn",
+             "ccbbaa", "identical", "", "ba"]
+
+
+def test_jaro_winkler_tiles_vs_scalar_oracle():
+    qc, ql = _encode(JW_QUERIES)
+    cc, cl = _encode(JW_CORPUS)
+    equal = jnp.zeros((len(JW_QUERIES), len(JW_CORPUS)), bool)
+    got = np.asarray(pk.jaro_winkler_sim_tiles(
+        qc, ql, cc, cl, equal, interpret=True
+    ))
+    jw = C.JaroWinkler()
+    for i, s1 in enumerate(JW_QUERIES):
+        for j, s2 in enumerate(JW_CORPUS):
+            if not s1 or not s2:
+                want = 0.0
+            elif s1 == s2:
+                want = 1.0  # kernel computes raw jaro = 1 for identical
+            else:
+                want = jw.compare(s1, s2)
+            assert got[i, j] == pytest.approx(want, abs=1e-5), (s1, s2)
+
+
+def test_jaro_winkler_tiles_vs_flat():
+    qc, ql = _encode(JW_QUERIES)
+    cc, cl = _encode(JW_CORPUS)
+    nq, nc = len(JW_QUERIES), len(JW_CORPUS)
+    equal = jnp.zeros((nq, nc), bool)
+    got = np.asarray(pk.jaro_winkler_sim_tiles(
+        qc, ql, cc, cl, equal, interpret=True
+    ))
+    c1 = jnp.repeat(qc, nc, axis=0)
+    l1 = jnp.repeat(ql, nc)
+    c2 = jnp.tile(cc, (nq, 1))
+    l2 = jnp.tile(cl, (nq,))
+    want = np.asarray(pw.jaro_winkler_sim(
+        c1, l1, c2, l2, equal.reshape(-1),
+        prefix_scale=0.1, boost_threshold=0.7, max_prefix=4,
+    )).reshape(nq, nc)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_scoring_program_jw_pallas_wiring(monkeypatch):
+    """The JaroWinkler CHARS pallas branch agrees with the XLA path."""
+    monkeypatch.setenv("DUKE_TPU_PALLAS", "0")
+    import jax
+
+    from sesam_duke_microservice_tpu.core.config import DukeSchema
+    from sesam_duke_microservice_tpu.core.records import (
+        ID_PROPERTY_NAME,
+        Property,
+        Record,
+    )
+    from sesam_duke_microservice_tpu.ops import features as F
+    from sesam_duke_microservice_tpu.ops import scoring as S
+
+    schema = DukeSchema(
+        threshold=0.8,
+        maybe_threshold=None,
+        properties=[
+            Property(ID_PROPERTY_NAME, id_property=True),
+            Property("CAPITAL", C.JaroWinkler(), 0.3, 0.85),
+        ],
+        data_sources=[],
+    )
+    plan = F.SchemaFeatures.plan(schema)
+    names = ["oslo", "olso", "stockholm", "stokholm", "helsinki",
+             "reykjavik", "copenhagen", "kobenhavn"]
+    records = []
+    for i, nm in enumerate(names):
+        r = Record()
+        r.add_value(ID_PROPERTY_NAME, f"d__{i}")
+        r.add_value("CAPITAL", nm)
+        records.append(r)
+    feats = F.extract_batch(plan, records)
+    to_dev = lambda t: {p: {k: jnp.asarray(a) for k, a in d.items()}
+                        for p, d in t.items()}
+    dev = to_dev(feats)
+    n = len(records)
+    valid = jnp.ones((n,), bool)
+    deleted = jnp.zeros((n,), bool)
+    group = jnp.full((n,), -1, jnp.int32)
+    qrow = jnp.arange(n, dtype=jnp.int32)
+    qgroup = jnp.full((n,), -2, jnp.int32)
+
+    def run():
+        pair_logits = S.build_pair_logits(plan)
+        return jax.tree_util.tree_map(
+            np.asarray,
+            S.scan_topk(
+                pair_logits, dev, dev, valid, deleted, group, qgroup, qrow,
+                jnp.float32(0.0), chunk=4, top_k=4, group_filtering=False,
+            ),
+        )
+
+    base = run()
+    monkeypatch.setenv("DUKE_TPU_PALLAS", "1")
+    pal = run()
+    np.testing.assert_allclose(pal[0], base[0], rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(pal[1], base[1])
+    np.testing.assert_array_equal(pal[2], base[2])
