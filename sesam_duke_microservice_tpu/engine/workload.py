@@ -142,24 +142,32 @@ class Workload:
             )
         return rows
 
-    def close(self) -> None:
+    def save_corpus_snapshot(self) -> None:
+        """Persist the device-corpus snapshot (no-op for host backends).
+
+        Best-effort: a failed save only logs; the record store remains the
+        source of truth and the next start falls back to full replay."""
+        if (self.record_store is None
+                or not hasattr(self.index, "snapshot_save")):
+            return
+        try:
+            self.index.snapshot_save(_snapshot_path(self.config.data_folder))
+        except Exception:
+            logging.getLogger("workload").exception(
+                "corpus snapshot save failed (replay will rebuild)"
+            )
+
+    def close(self, save_snapshot: bool = True) -> None:
         """Release index/link-db resources (the reference leaks these on hot
         reload — SURVEY.md quirk Q7; fixed by calling this on config swap).
 
         Device backends additionally persist a corpus snapshot so the next
-        start can skip feature re-extraction (best-effort: a failed save
-        only logs; the record store remains the source of truth)."""
+        start can skip feature re-extraction; hot reload passes
+        ``save_snapshot=False`` because it already saved under the quiesce
+        locks (the corpus cannot have changed since)."""
         self.closed = True
-        if (self.record_store is not None
-                and hasattr(self.index, "snapshot_save")):
-            try:
-                self.index.snapshot_save(
-                    _snapshot_path(self.config.data_folder)
-                )
-            except Exception:
-                logging.getLogger("workload").exception(
-                    "corpus snapshot save failed (replay will rebuild)"
-                )
+        if save_snapshot:
+            self.save_corpus_snapshot()
         self.index.close()
         self.link_database.close()
         if self.record_store is not None:

@@ -89,6 +89,12 @@ class DukeApp:
             for wl in old:
                 wl.lock.acquire()
             try:
+                # snapshot the quiesced corpora FIRST: the replacements are
+                # built before the old workloads close, so without this a
+                # device-backend reload would replay the store through full
+                # feature re-extraction instead of the snapshot fast path
+                for wl in old:
+                    wl.save_corpus_snapshot()
                 built = []
                 try:
                     new_dedups = {}
@@ -117,7 +123,9 @@ class DukeApp:
                 self.record_linkages = new_linkages
                 for wl in old:
                     try:
-                        wl.close()
+                        # snapshot already written above and the corpus is
+                        # unchanged (locks held) — skip the duplicate save
+                        wl.close(save_snapshot=False)
                     except Exception:
                         logger.exception("Error closing replaced workload")
             finally:

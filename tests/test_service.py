@@ -318,3 +318,33 @@ def test_stats_endpoint(server_url):
     assert people["records_indexed"] >= 1
     assert people["batches"] >= 1
     assert people["records_processed"] >= 1
+
+
+def test_device_reload_uses_corpus_snapshot(tmp_path, monkeypatch):
+    """Hot reload must restore the new workloads' corpora from the
+    snapshot saved under the quiesce locks, not re-extract features."""
+    from sesam_duke_microservice_tpu.engine.device_matcher import DeviceIndex
+    from sesam_duke_microservice_tpu.service.app import DukeApp
+
+    xml = CONFIG_XML.replace(
+        "<DukeMicroService>", f'<DukeMicroService dataFolder="{tmp_path}">'
+    )
+    monkeypatch.setenv("MIN_RELEVANCE", "0.05")
+    app = DukeApp(parse_config(xml), backend="device", persistent=True)
+    wl = app.deduplications["people"]
+    with wl.lock:
+        wl.process_batch("crm", [
+            {"_id": f"r{i}", "name": f"acme {i}", "email": f"a{i}@x.no"}
+            for i in range(10)
+        ])
+    assert wl.index.corpus.size == 10
+
+    def boom(self, records):
+        raise AssertionError("extraction ran during reload despite snapshot")
+
+    monkeypatch.setattr(DeviceIndex, "_extract", boom)
+    app.reload_from_string(xml)   # hot reload, same config
+    wl2 = app.deduplications["people"]
+    assert wl2 is not wl
+    assert wl2.index.corpus.size == 10
+    app.close()
