@@ -70,8 +70,8 @@ class AnnIndex(DeviceIndex):
         self.initial_top_c = initial_top_c
         self.encoder = E.RecordEncoder(schema, dim)
 
-    def _extract(self, records: Sequence[Record]):
-        feats = super()._extract(records)
+    def _extract(self, records: Sequence[Record], plan=None):
+        feats = super()._extract(records, plan)
         feats[E.ANN_PROP] = {
             E.ANN_TENSOR: self.encoder.encode_batch(records)
         }

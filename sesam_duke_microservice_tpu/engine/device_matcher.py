@@ -19,6 +19,10 @@ Semantics contract (held to the host engine by differential tests in
     maybe_threshold)``: device logits are exact for device-kernel
     properties, and host-only comparators are re-scored exactly for the
     surviving pairs (optimistic-bound pruning, ``ops.scoring``);
+  * multi-valued properties score all value pairs on device: the value
+    axis auto-sizes to the data (``_maybe_grow_value_slots``, capped by
+    ``DEVICE_VALUE_SLOTS_MAX``), so a record whose second value is the
+    matching one is pruned identically to the host engine;
   * K-escalation keeps this exact: if any query had more potential
     candidates than K, the scorer re-runs with doubled K until all fit.
 
@@ -59,6 +63,11 @@ _QUERY_BUCKETS = tuple(
 )
 _CHUNK = int(os.environ.get("DEVICE_CHUNK", "512"))
 _INITIAL_TOP_K = int(os.environ.get("DEVICE_TOP_K", "64"))
+# Value-slot auto-growth cap: pair scoring is O(V^2) combos per property, so
+# the per-property value axis stops doubling here; records with more values
+# score their first MAX slots on device (host finalization still sees every
+# value, so only *pruning* can be affected beyond the cap).
+_VALUE_SLOTS_MAX = int(os.environ.get("DEVICE_VALUE_SLOTS_MAX", "8"))
 
 
 def _bucket_for(n: int) -> int:
@@ -283,7 +292,13 @@ class DeviceIndex(CandidateIndex):
 
         self.schema = schema
         self.tunables = tunables or MatchTunables()
-        v = values_per_record or int(os.environ.get("DEVICE_VALUE_SLOTS", "1"))
+        # Value slots auto-size from the data (Duke records are multi-valued;
+        # a record whose *second* value is the matching one must still be
+        # visible to device pruning).  An explicit ctor arg or
+        # DEVICE_VALUE_SLOTS env pins the width instead.
+        env_v = os.environ.get("DEVICE_VALUE_SLOTS")
+        self._auto_value_slots = values_per_record is None and env_v is None
+        v = values_per_record or int(env_v or "1")
         self.plan = F.SchemaFeatures.plan(schema, values_per_record=v)
         if not self.plan.device_props:
             raise SchemaError(
@@ -298,6 +313,7 @@ class DeviceIndex(CandidateIndex):
         self._pending: List[Record] = []
         self._lock = threading.Lock()
         self._scorer_cache: Optional["_ScorerCache"] = None
+        self._cap_warned: set = set()
 
     @property
     def scorer_cache(self) -> "_ScorerCache":
@@ -313,12 +329,53 @@ class DeviceIndex(CandidateIndex):
         with self._lock:
             self._pending.append(record)
 
-    def _extract(self, records: Sequence[Record]):
+    def _extract(self, records: Sequence[Record], plan=None):
         """Feature extraction for a record batch; subclasses may add pseudo-
-        properties (the ANN backend rides its embedding matrix in here)."""
+        properties (the ANN backend rides its embedding matrix in here).
+        ``plan`` overrides the corpus plan for query-side extraction."""
         from ..ops import features as F
 
-        return F.extract_batch(self.plan, records)
+        return F.extract_batch(plan or self.plan, records)
+
+    def _sized_slots(self, spec, records: Sequence[Record]) -> int:
+        """Power-of-two value width fitting ``records`` for one property,
+        clamped to DEVICE_VALUE_SLOTS_MAX (warns once per property when the
+        clamp makes 9th+ values invisible to device pruning)."""
+        need = max(
+            (sum(1 for val in r.get_values(spec.name) if val)
+             for r in records),
+            default=0,
+        )
+        if need > _VALUE_SLOTS_MAX and spec.name not in self._cap_warned:
+            self._cap_warned.add(spec.name)
+            logger.warning(
+                "property %r has records with %d values; device pruning "
+                "sees the first %d (DEVICE_VALUE_SLOTS_MAX)",
+                spec.name, need, _VALUE_SLOTS_MAX,
+            )
+        v = 1
+        while v < need:
+            v *= 2
+        return max(1, min(v, _VALUE_SLOTS_MAX))
+
+    def _query_plan(self, records: Sequence[Record]):
+        """Plan for non-indexed query records (http-transform): the value
+        axis is sized to the probe batch (power of two, capped) so a query's
+        2nd+ values stay visible to pruning WITHOUT widening the corpus —
+        scoring handles asymmetric Vq x Vc value combos."""
+        from dataclasses import replace
+
+        from ..ops import features as F
+
+        specs = []
+        for spec in self.plan.device_props:
+            v = self._sized_slots(spec, records)
+            specs.append(
+                replace(spec, values_per_record=v) if v != spec.v else spec
+            )
+        return F.SchemaFeatures(
+            device_props=specs, host_props=self.plan.host_props
+        )
 
     def commit(self) -> None:
         with self._lock:
@@ -330,10 +387,14 @@ class DeviceIndex(CandidateIndex):
         for r in pending:
             by_id[r.record_id] = r
         records = list(by_id.values())
+        self._maybe_grow_value_slots(records)
         for r in records:
             old = self.id_to_row.get(r.record_id)
             if old is not None:
                 self.corpus.tombstone(old)
+        self._append_records(records)
+
+    def _append_records(self, records: Sequence[Record]) -> None:
         feats = self._extract(records)
         deleted = np.array([r.is_deleted() for r in records], dtype=bool)
         group = np.array(
@@ -345,6 +406,52 @@ class DeviceIndex(CandidateIndex):
         for r, row in zip(records, rows):
             self.id_to_row[r.record_id] = int(row)
             self.records[r.record_id] = r
+
+    # -- value-slot auto-sizing ----------------------------------------------
+
+    def _maybe_grow_value_slots(self, records: Sequence[Record]) -> None:
+        """Grow per-property value slots to fit the incoming batch.
+
+        Duke scores the max over *all* value pairs per property
+        (IncrementalDataSource.java:69-73 feeds multi-values); the device
+        tensors bound the value axis for static shapes, so when a batch
+        arrives with more values than the current width the plan widens
+        (power-of-two, capped at DEVICE_VALUE_SLOTS_MAX) and the corpus
+        tensors are rebuilt from the host-resident records.  Growth happens
+        at most O(log max) times per property over a corpus's lifetime.
+        """
+        if not self._auto_value_slots:
+            return
+        grew = False
+        for spec in self.plan.device_props:
+            v = self._sized_slots(spec, records)
+            if v > spec.values_per_record:
+                spec.values_per_record = v
+                grew = True
+        if grew:
+            self._rebuild_corpus()
+
+    def _rebuild_corpus(self) -> None:
+        """Re-extract every stored record under the current feature plan.
+
+        Holds the index lock for the whole swap so a concurrent ``delete``
+        cannot land between the old-state capture and the replacement (its
+        tombstone would otherwise be resurrected by the re-append).
+        """
+        with self._lock:
+            old_records = self.records
+            self.corpus = DeviceCorpus(
+                self.plan, max((s.v for s in self.plan.device_props), default=1)
+            )
+            self.id_to_row = {}
+            self.records = {}
+            if old_records:
+                logger.info(
+                    "value-slot growth: rebuilding corpus tensors for %d "
+                    "records (slots now %s)", len(old_records),
+                    {s.name: s.v for s in self.plan.device_props},
+                )
+                self._append_records(list(old_records.values()))
 
     def find_record_by_id(self, record_id: str) -> Optional[Record]:
         return self.records.get(record_id)
@@ -391,9 +498,11 @@ class DeviceIndex(CandidateIndex):
         import hashlib
 
         # plan semantics + every env knob that sizes the feature tensors
-        # (must be computable before any data is loaded)
+        # (must be computable before any data is loaded; value-slot widths
+        # are data-derived, so they ride in the snapshot payload instead —
+        # __value_slots — and are applied at load)
         spec = repr((
-            [(s.name, s.kind, s.low, s.high, s.v)
+            [(s.name, s.kind, s.low, s.high)
              for s in self.plan.device_props],
             os.environ.get("DEVICE_MAX_CHARS", ""),
             os.environ.get("DEVICE_MAX_GRAMS", ""),
@@ -419,6 +528,9 @@ class DeviceIndex(CandidateIndex):
                 tmp,
                 __fingerprint=np.array(self._snapshot_fingerprint()),
                 __content=np.array(_records_content_hash(self.records)),
+                __value_slots=np.array(
+                    [s.v for s in self.plan.device_props], dtype=np.int64
+                ),
                 __row_valid=corpus.row_valid[: corpus.size],
                 __row_deleted=corpus.row_deleted[: corpus.size],
                 __row_group=corpus.row_group[: corpus.size],
@@ -454,6 +566,18 @@ class DeviceIndex(CandidateIndex):
             with np.load(path) as data:  # no pickle: plain arrays only
                 if str(data["__fingerprint"]) != self._snapshot_fingerprint():
                     return False
+                if "__value_slots" not in data.files:
+                    return False
+                slots = [int(x) for x in data["__value_slots"]]
+                if len(slots) != len(self.plan.device_props):
+                    return False
+                if self._auto_value_slots:
+                    # snapshot written under a larger cap: replaying re-grows
+                    # under the current one instead of adopting oversize axes
+                    if any(v > _VALUE_SLOTS_MAX for v in slots):
+                        return False
+                elif slots != [s.v for s in self.plan.device_props]:
+                    return False
                 # record CONTENT hash, not just the id set: an id-set check
                 # would accept a snapshot predating an in-place record
                 # update that only the store persisted (crash before the
@@ -480,6 +604,11 @@ class DeviceIndex(CandidateIndex):
             logger.exception("snapshot load failed; replaying from store")
             return False
 
+        # every check passed — only now adopt the snapshot's value-slot
+        # widths (a rejected snapshot must leave the plan untouched)
+        if self._auto_value_slots:
+            for spec, v in zip(self.plan.device_props, slots):
+                spec.values_per_record = v
         corpus = self.corpus
         n = len(row_ids)
         rows = corpus.append(
@@ -571,8 +700,12 @@ class _ScorerCache:
             # high-latency device link)
             qfeats = {}
         else:
-            # http-transform: queries are not in the corpus
-            qfeats_np = index._extract(records)
+            # http-transform: queries are not in the corpus; extract under a
+            # query-sized value axis (a probe may carry more values than any
+            # indexed record — the corpus plan must not widen for it)
+            qfeats_np = index._extract(
+                records, plan=index._query_plan(records)
+            )
             qfeats = {
                 prop: {
                     name: jnp.asarray(_pad_rows(arr, bucket))

@@ -24,9 +24,11 @@ from ..core import comparators as C
 from ..core.config import DukeSchema
 from ..core.records import Record
 
-# Static shape defaults (device tensors are padded to these; values are
-# truncated — the only intended divergence from the host oracle, documented
-# in tests/test_ops.py).  Env-tunable: the CPU test backend uses smaller
+# Static shape defaults (device tensors are padded to these; chars/grams
+# beyond the padded width are truncated — documented in tests/test_ops.py;
+# the *value* axis auto-sizes to the data in engine.device_matcher, so
+# multi-valued records are not truncated below DEVICE_VALUE_SLOTS_MAX).
+# Env-tunable: the CPU test backend uses smaller
 # shapes (tests/conftest.py) since it executes the kernels without an MXU.
 # MAX_CHARS defaults to 32 so edit distance rides the Myers bit-parallel
 # kernel (one uint32 word per pattern, ~100x the scan-DP throughput);

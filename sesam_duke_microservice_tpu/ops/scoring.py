@@ -58,29 +58,36 @@ def host_bound_logit(host_props) -> float:
 
 
 def _pair_expand(qa: jnp.ndarray, ca: jnp.ndarray) -> tuple:
-    """(Q, V, ...) x (C, V, ...) -> flat (Q*C*V*V, ...) pair operands."""
-    q, v = qa.shape[0], qa.shape[1]
-    c = ca.shape[0]
+    """(Q, Vq, ...) x (C, Vc, ...) -> flat (Q*C*Vq*Vc, ...) pair operands.
+
+    The value axes may differ: an http-transform query can carry more
+    values than any indexed record, and its extra slots ride a wider query
+    tensor instead of forcing a corpus rebuild (engine.device_matcher).
+    """
+    q, vq = qa.shape[0], qa.shape[1]
+    c, vc = ca.shape[0], ca.shape[1]
     rq = qa.shape[2:]
     rc = ca.shape[2:]
-    a = jnp.broadcast_to(qa[:, None, :, None], (q, c, v, v) + rq)
-    b = jnp.broadcast_to(ca[None, :, None, :], (q, c, v, v) + rc)
-    return a.reshape((q * c * v * v,) + rq), b.reshape((q * c * v * v,) + rc)
+    a = jnp.broadcast_to(qa[:, None, :, None], (q, c, vq, vc) + rq)
+    b = jnp.broadcast_to(ca[None, :, None, :], (q, c, vq, vc) + rc)
+    return (a.reshape((q * c * vq * vc,) + rq),
+            b.reshape((q * c * vq * vc,) + rc))
 
 
 def _pair_expand_gathered(qa: jnp.ndarray, ca: jnp.ndarray) -> tuple:
-    """(Q, V, ...) x gathered (Q, C, V, ...) -> flat (Q*C*V*V, ...) operands.
+    """(Q, Vq, ...) x gathered (Q, C, Vc, ...) -> flat (Q*C*Vq*Vc, ...).
 
     The per-query candidate axis is already aligned (candidate row c of
     query q, not a corpus cross product) — used by the ANN rescoring stage.
     """
-    q, v = qa.shape[0], qa.shape[1]
-    c = ca.shape[1]
+    q, vq = qa.shape[0], qa.shape[1]
+    c, vc = ca.shape[1], ca.shape[2]
     rq = qa.shape[2:]
     rc = ca.shape[3:]
-    a = jnp.broadcast_to(qa[:, None, :, None], (q, c, v, v) + rq)
-    b = jnp.broadcast_to(ca[:, :, None, :], (q, c, v, v) + rc)
-    return a.reshape((q * c * v * v,) + rq), b.reshape((q * c * v * v,) + rc)
+    a = jnp.broadcast_to(qa[:, None, :, None], (q, c, vq, vc) + rq)
+    b = jnp.broadcast_to(ca[:, :, None, :], (q, c, vq, vc) + rc)
+    return (a.reshape((q * c * vq * vc,) + rq),
+            b.reshape((q * c * vq * vc,) + rc))
 
 
 def _tiled_combo_sim(tile_fn, q: int, c: int, vq: int, vc: int,
@@ -229,15 +236,15 @@ def _property_logit(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict,
     semantics match the host engine even for low > 0.5 configs.
     """
     sim, combo_valid = _property_sim(spec, qf, cf, expand, pallas_ok)
-    v = spec.v
     prob = jnp.where(
         sim >= 0.5, (spec.high - 0.5) * sim * sim + 0.5, jnp.float32(spec.low)
     )
     prob = jnp.where(combo_valid, prob, -1.0)
-    prob4 = prob.reshape(q, c, v, v)
-    valid4 = combo_valid.reshape(q, c, v, v)
-    best = prob4.max(axis=(2, 3))
-    any_valid = valid4.any(axis=(2, 3))
+    # the trailing (Vq*Vc) combo axis folds away; Vq may differ from Vc
+    prob4 = prob.reshape(q, c, -1)
+    valid4 = combo_valid.reshape(q, c, -1)
+    best = prob4.max(axis=2)
+    any_valid = valid4.any(axis=2)
     best = jnp.where(any_valid, best, 0.5)
     best = jnp.clip(best, _EPS, 1.0 - _EPS)
     return jnp.log(best) - jnp.log1p(-best)
