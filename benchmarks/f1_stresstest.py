@@ -310,6 +310,33 @@ def run(backend: str, n_entities: int, dup_rate: float, batch: int,
     else:
         pair_items = collector.pairs
 
+    def one_to_one_ceiling():
+        """Structural F1 bound of 1:1 mode against all-truth-pairs ground
+        truth: an identity with a copies in group 1 and b in group 2
+        contributes a*b truth pairs but at most min(a, b) one-to-one links
+        (dedup: k copies -> C(k,2) pairs, floor(k/2) links), so recall —
+        hence F1 — is capped below 1.0 by the corpus itself, not by the
+        matcher.  Returned so the 1:1 score can be read against the number
+        it can actually reach."""
+        if workload == "linkage":
+            c1, c2 = defaultdict(int), defaultdict(int)
+            for ident in t1.values():
+                c1[ident] += 1
+            for ident in t2.values():
+                c2[ident] += 1
+            max_links = sum(
+                min(c1[i], c2[i]) for i in set(c1) & set(c2)
+            )
+            total = len(expected_links)
+        else:
+            counts = defaultdict(int)
+            for ident in truth.values():
+                counts[ident] += 1
+            max_links = sum(k // 2 for k in counts.values())
+            total = sum(k * (k - 1) // 2 for k in counts.values())
+        r = max_links / total if total else 1.0
+        return max_links, (2 * r / (1 + r) if r else 0.0)
+
     stats = getattr(proc, "stats", None)
 
     emitted = {
@@ -334,6 +361,11 @@ def run(backend: str, n_entities: int, dup_rate: float, batch: int,
         "true_pairs": len(expected),
         "emitted_pairs": len(emitted),
     }
+    if one_to_one:
+        max_links, f1_ceiling = one_to_one_ceiling()
+        out["one_to_one_max_links"] = max_links
+        out["f1_ceiling"] = round(f1_ceiling, 4)
+        out["f1_vs_ceiling"] = round(f1 / f1_ceiling, 4) if f1_ceiling else 0.0
     if stats is not None:
         out["retrieval_s"] = round(stats.retrieval_seconds, 2)
         out["compare_s"] = round(stats.compare_seconds, 2)

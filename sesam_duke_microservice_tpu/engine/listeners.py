@@ -57,7 +57,8 @@ class ServiceMatchListener(MatchListener):
     response (``duke_links``)."""
 
     def __init__(self, workload_name: str, linkdb: LinkDatabase,
-                 kind: str = "deduplication", one_to_one: bool = False):
+                 kind: str = "deduplication", one_to_one: bool = False,
+                 record_resolver=None):
         self._wrapped = LinkMatchListener(linkdb)
         self.link_database_updates_disabled = False
         self._entity_matches: Dict[str, List[Tuple[Record, float]]] = {}
@@ -65,10 +66,23 @@ class ServiceMatchListener(MatchListener):
         # link-mode="one-to-one" but never reads the flag (SURVEY.md quirk
         # Q5), so by default every above-threshold pair links.  With
         # ``one_to_one`` definite matches are buffered per batch and
-        # resolved greedily by descending confidence so each record links
-        # to at most one counterpart; maybe-matches pass through.
+        # resolved by descending confidence with displacement repair (see
+        # _flush_one_to_one) so each record links to at most one
+        # counterpart; maybe-matches pass through.
         self.one_to_one = one_to_one
         self._pending_matches: List[Tuple[float, Record, Record]] = []
+        # runner-up pairs kept across recent batches so a record displaced
+        # by a stronger later link can fall back to its next-best candidate
+        # (deferred-acceptance repair); capped per record and pruned by
+        # batch age.  Entries carry the batch number they were seen in;
+        # ``record_resolver`` (id -> live Record or None, wired to the
+        # index by the workload) re-validates both endpoints at replay so
+        # deleted/re-indexed records are never resurrected from stale pairs.
+        self._alternatives: Dict[str, List[Tuple[float, Record, Record]]] = {}
+        self._alt_batch: Dict[str, int] = {}
+        self._batch_no = 0
+        self._record_resolver = record_resolver
+        self._maybe_seen: set = set()
         prefix = (
             "recordLinkageMatchListener" if kind == "recordlinkage"
             else "deduplicationMatchListener"
@@ -82,6 +96,7 @@ class ServiceMatchListener(MatchListener):
     def batch_ready(self, size: int) -> None:
         self._entity_matches = {}
         self._pending_matches = []
+        self._maybe_seen = set()
         self._batch_start = time.monotonic()
         self.logger.info("batchReady(size=%d)", size)
         if not self.link_database_updates_disabled:
@@ -98,48 +113,174 @@ class ServiceMatchListener(MatchListener):
                 time.monotonic() - self._batch_start,
             )
 
+    # runner-up pairs remembered per record for displacement repair, and
+    # how many batches they stay replayable (bounds both memory and the
+    # staleness of a replayed pair's confidence)
+    _ALTERNATIVE_CAP = 8
+    _ALTERNATIVE_MAX_AGE = 32
+
     def _flush_one_to_one(self) -> None:
-        """Greedy max-confidence assignment: each record in at most one
-        definite link — within the batch AND against links asserted by
-        earlier batches (a stronger new pair retracts the weaker existing
-        link; a weaker one is suppressed).  Ties break on record ids so
-        the output is deterministic under threaded scoring."""
+        """Max-confidence one-to-one assignment with displacement repair.
+
+        Pairs are resolved in descending confidence order — within the
+        batch AND against links asserted by earlier batches (one batched
+        link fetch; a stronger new pair retracts the weaker existing link,
+        a weaker one is suppressed).  When an existing link is retracted,
+        its displaced endpoint re-enters the queue with its remembered
+        runner-up candidates (deferred-acceptance style), so displacement
+        chains settle instead of stranding records.  Ties break on record
+        ids so the output is deterministic under threaded scoring.
+
+        Event-protocol note: a record whose every buffered definite match
+        is suppressed here gets an explicit ``no_match_for`` at the end of
+        the flush (unless it produced a maybe-match), keeping the listener
+        contract's "every processed record emits some event" property.
+        """
+        import heapq
+
+        pending = self._pending_matches
+        self._pending_matches = []
+        batch_queries: Dict[str, Record] = {
+            t[1].record_id: t[1] for t in pending
+        }
+
+        transform = self.link_database_updates_disabled
+        self._batch_no += 1
+        if self._batch_no % self._ALTERNATIVE_MAX_AGE == 0:
+            self._prune_alternatives()
+        links_by_id: Dict[str, List[Link]] = {}
+        if not transform and pending:
+            ids = {t[1].record_id for t in pending} | {
+                t[2].record_id for t in pending
+            }
+            # seed every id so unlinked records (the steady-state common
+            # case) don't fall through to per-record lazy DB lookups
+            links_by_id = {rid: [] for rid in ids}
+            for link in self._wrapped.linkdb.get_links_for_ids(ids):
+                links_by_id.setdefault(link.id1, []).append(link)
+                links_by_id.setdefault(link.id2, []).append(link)
+
+        # heap orders by (-confidence, ids); seen_pairs guards against the
+        # same pair re-entering via both endpoints' alternative lists
+        heap: List[Tuple[float, str, str, Record, Record]] = [
+            (-conf, r1.record_id, r2.record_id, r1, r2)
+            for conf, r1, r2 in pending
+        ]
+        heapq.heapify(heap)
+        seen_pairs: set = set()
         taken: set = set()
-        # secondary keys make equal-confidence ordering independent of
-        # listener-call interleaving (THREADS > 1)
-        for confidence, r1, r2 in sorted(
-            self._pending_matches,
-            key=lambda t: (-t[0], t[1].record_id, t[2].record_id),
-        ):
-            if r1.record_id in taken or r2.record_id in taken:
+        linked: set = set()
+
+        while heap:
+            negconf, id1, id2, r1, r2 = heapq.heappop(heap)
+            confidence = -negconf
+            pkey = tuple(sorted((id1, id2)))
+            if pkey in seen_pairs:
                 continue
-            if not self.link_database_updates_disabled:
+            seen_pairs.add(pkey)
+            if id1 in taken or id2 in taken:
+                self._remember_alternative(confidence, r1, r2)
+                continue
+            if not transform:
                 blocked, to_retract = self._existing_conflicts(
-                    r1.record_id, r2.record_id, confidence
+                    links_by_id, id1, id2, confidence
                 )
                 if blocked:
+                    self._remember_alternative(confidence, r1, r2)
                     continue
                 for link in to_retract:
                     link.retract()
                     self._wrapped.linkdb.assert_link(link)
+                    for rid in (link.id1, link.id2):
+                        peers = links_by_id.get(rid)
+                        if peers and link in peers:
+                            peers.remove(link)
+                    # the displaced endpoint re-competes with its
+                    # remembered runner-ups; both endpoints of a replayed
+                    # pair must still resolve to live records (a stale
+                    # pair must never resurrect a deleted/re-indexed id)
+                    displaced = link.id2 if link.id1 in (id1, id2) else link.id1
+                    for alt_conf, a1, a2 in self._alternatives.get(
+                        displaced, ()
+                    ):
+                        akey = tuple(sorted((a1.record_id, a2.record_id)))
+                        if akey in seen_pairs:
+                            continue
+                        if not self._replay_live(a1, a2):
+                            continue
+                        heapq.heappush(
+                            heap,
+                            (-alt_conf, a1.record_id, a2.record_id,
+                             a1, a2),
+                        )
                 self._wrapped.matches(r1, r2, confidence)
-            taken.add(r1.record_id)
-            taken.add(r2.record_id)
+                new = Link(id1, id2, LinkStatus.INFERRED,
+                           LinkKind.DUPLICATE, confidence)
+                links_by_id.setdefault(id1, []).append(new)
+                links_by_id.setdefault(id2, []).append(new)
+            taken.add(id1)
+            taken.add(id2)
             self._record_entity_match(r1, r2, confidence)
-        self._pending_matches = []
 
-    def _existing_conflicts(self, id1: str, id2: str, confidence: float):
+        # ADVICE drift fix: suppressed-everywhere batch records still end
+        # the batch with an event
+        for rid, record in batch_queries.items():
+            if rid not in taken and rid not in self._maybe_seen:
+                self.no_match_for(record)
+
+    def _remember_alternative(self, confidence: float, r1: Record,
+                              r2: Record) -> None:
+        # transform-mode pairs are transient probe queries — they must
+        # never become assertable link material in a later real batch
+        if self.link_database_updates_disabled:
+            return
+        for rid in (r1.record_id, r2.record_id):
+            alts = self._alternatives.setdefault(rid, [])
+            alts.append((confidence, r1, r2))
+            self._alt_batch[rid] = self._batch_no
+            if len(alts) > self._ALTERNATIVE_CAP:
+                alts.sort(key=lambda t: (-t[0], t[1].record_id,
+                                         t[2].record_id))
+                del alts[self._ALTERNATIVE_CAP:]
+
+    def _replay_live(self, r1: Record, r2: Record) -> bool:
+        """Both endpoints of a remembered pair still resolve to live
+        records (when the workload wired a resolver)."""
+        if self._record_resolver is None:
+            return True
+        for rec in (r1, r2):
+            live = self._record_resolver(rec.record_id)
+            if live is None or live.is_deleted():
+                return False
+        return True
+
+    def _prune_alternatives(self) -> None:
+        cutoff = self._batch_no - self._ALTERNATIVE_MAX_AGE
+        stale = [rid for rid, b in self._alt_batch.items() if b <= cutoff]
+        for rid in stale:
+            self._alt_batch.pop(rid, None)
+            self._alternatives.pop(rid, None)
+
+    def _existing_conflicts(self, links_by_id: Dict[str, List[Link]],
+                            id1: str, id2: str, confidence: float):
         """Definite links from earlier batches touching either record.
 
         Returns (blocked, to_retract): blocked when an existing link with
         >= confidence already claims one of the records; otherwise the
         weaker existing links to retract before asserting the new pair.
+        ``links_by_id`` is the flush's batched link fetch — records missing
+        from it (reachable only through displacement-repair alternatives)
+        are fetched lazily.
         """
         pair = {id1, id2}
         blocked = False
         to_retract = []
         for rid in pair:
-            for link in self._wrapped.linkdb.get_all_links_for(rid):
+            if rid not in links_by_id:
+                links_by_id[rid] = list(
+                    self._wrapped.linkdb.get_all_links_for(rid)
+                )
+            for link in links_by_id[rid]:
                 if link.kind != LinkKind.DUPLICATE:
                     continue
                 if link.status == LinkStatus.RETRACTED:
@@ -161,6 +302,8 @@ class ServiceMatchListener(MatchListener):
         self._record_entity_match(r1, r2, confidence)
 
     def matches_perhaps(self, r1: Record, r2: Record, confidence: float) -> None:
+        if self.one_to_one:
+            self._maybe_seen.add(r1.record_id)
         if not self.link_database_updates_disabled:
             self._wrapped.matches_perhaps(r1, r2, confidence)
         self._record_entity_match(r1, r2, confidence)

@@ -221,6 +221,7 @@ def build_workload(wc: WorkloadConfig, sc: ServiceConfig, *,
         listener = ServiceMatchListener(
             wc.name, link_database, kind=wc.kind,
             one_to_one=sc.one_to_one and wc.is_record_linkage,
+            record_resolver=index.find_record_by_id,
         )
         processor.add_match_listener(listener)
 

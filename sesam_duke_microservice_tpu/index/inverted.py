@@ -387,4 +387,8 @@ class InvertedIndex(CandidateIndex):
         pass
 
     def __len__(self) -> int:
-        return len(self._docs)
+        # live indexed records: dukeDeleted rows stay resolvable by id but
+        # are excluded from candidate search, so they don't count as indexed
+        return sum(
+            1 for doc in self._docs.values() if not doc.record.is_deleted()
+        )

@@ -97,6 +97,22 @@ class LinkDatabase:
     def get_all_links_for(self, record_id: str) -> List[Link]:
         raise NotImplementedError
 
+    def get_links_for_ids(self, record_ids) -> List[Link]:
+        """All links touching any of ``record_ids`` — one batched lookup.
+
+        The one-to-one flush needs every existing link for a whole batch of
+        records; per-pair ``get_all_links_for`` calls would dominate
+        ``batch_done`` latency on large linkage batches.  Backends override
+        with a single scan/query; this default keeps tiny custom backends
+        working.
+        """
+        ids = set(record_ids)
+        seen = {}
+        for rid in ids:
+            for link in self.get_all_links_for(rid):
+                seen[link.key()] = link
+        return list(seen.values())
+
     def get_all_links(self) -> List[Link]:
         raise NotImplementedError
 

@@ -28,6 +28,13 @@ class InMemoryLinkDatabase(LinkDatabase):
             if l.id1 == record_id or l.id2 == record_id
         ]
 
+    def get_links_for_ids(self, record_ids) -> List[Link]:
+        ids = set(record_ids)
+        return [
+            l for l in self._links.values()
+            if l.id1 in ids or l.id2 in ids
+        ]
+
     def get_all_links(self) -> List[Link]:
         return list(self._links.values())
 

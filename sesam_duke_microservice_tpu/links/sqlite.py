@@ -73,6 +73,26 @@ class SqliteLinkDatabase(LinkDatabase):
         )
         return [self._row_to_link(r) for r in cur.fetchall()]
 
+    def get_links_for_ids(self, record_ids) -> List[Link]:
+        ids = sorted(set(record_ids))
+        if not ids:
+            return []
+        out: List[Link] = []
+        conn = self._conn()
+        # SQLite caps host parameters (999 on older builds); chunk the IN
+        for start in range(0, len(ids), 450):
+            chunk = ids[start:start + 450]
+            marks = ",".join("?" * len(chunk))
+            cur = conn.execute(
+                "SELECT id1, id2, status, kind, confidence, timestamp "
+                f"FROM links WHERE id1 IN ({marks}) OR id2 IN ({marks})",
+                chunk + chunk,
+            )
+            out.extend(self._row_to_link(r) for r in cur.fetchall())
+        if len(ids) > 450:  # chunks can double-report a link joining two chunks
+            out = list({l.key(): l for l in out}.values())
+        return out
+
     def get_all_links(self) -> List[Link]:
         cur = self._conn().execute(
             "SELECT id1, id2, status, kind, confidence, timestamp FROM links"

@@ -382,21 +382,29 @@ def _intersect_tile_kernel(qg_ref, qn_ref, cgt_ref, cn_ref, out_ref, *, G: int):
     qn = qn_ref[...][:, :1]                          # (TQ, 1)
     cn = cn_ref[...][:1, :]                          # (1, TC)
     qg = qg_ref[...]                                 # (TQ, G)
-    count = jnp.zeros((tq, tc), jnp.int32)
+    lane = lax.broadcasted_iota(jnp.int32, (tq, G), 1)
 
-    # fully static G x G unroll (G <= ~32): Mosaic cannot dynamic-slice the
-    # lane axis, and every step is one (TQ, TC) vector compare on the VPU
-    for i in range(G):
-        qv = qg[:, i : i + 1]                        # (TQ, 1)
-        ivalid = i < qn                              # (TQ, 1)
+    # outer loop over query grams is a fori_loop so the program stays O(G)
+    # (a static G x G unroll produced 4096-step Mosaic programs at the
+    # default DEVICE_MAX_GRAMS=64); Mosaic cannot dynamic-slice the lane
+    # axis, so the query column is extracted by a masked lane reduction.
+    # The inner corpus loop unrolls statically: sublane slices are static
+    # and every step is one (TQ, TC) vector compare on the VPU.
+    def step(i, count):
+        qv = jnp.sum(
+            jnp.where(lane == i, qg, 0), axis=1, keepdims=True
+        )                                            # (TQ, 1)
         hit = jnp.zeros((tq, tc), jnp.bool_)
         for j in range(G):
             jvalid = j < cn                          # (1, TC)
             hit = hit | ((qv == cgt_ref[j : j + 1, :]) & jvalid)
         # sets are distinct: each query element matches at most one corpus
         # element, so OR-then-add counts the intersection exactly
-        count = count + jnp.where(hit & ivalid, 1, 0)
-    out_ref[...] = count
+        return count + jnp.where(hit & (i < qn), 1, 0)
+
+    out_ref[...] = lax.fori_loop(
+        0, G, step, jnp.zeros((tq, tc), jnp.int32)
+    )
 
 
 @functools.partial(

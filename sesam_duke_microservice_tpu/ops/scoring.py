@@ -147,6 +147,11 @@ def _property_sim(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict,
     if (
         pallas_ok
         and kind in (F.GRAM_SET, F.TOKEN_SET)
+        # width guard (mirrors the chars branch's L <= 32): the tile
+        # kernel's inner loop unrolls O(G), so a huge DEVICE_MAX_GRAMS /
+        # DEVICE_MAX_TOKENS falls back to the flat XLA kernels instead of
+        # silently emitting an enormous Mosaic program
+        and qf["grams" if kind == F.GRAM_SET else "tokens"].shape[2] <= 256
         and pk.pallas_enabled()
     ):
         # Pallas tiled path: (TQ, TC) intersection tiles in VMEM from

@@ -257,13 +257,17 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
             for name, wl in registry.items():
                 stats = getattr(wl.processor, "stats", None)
                 # device/ann: the live id->record map (corpus.size would
-                # count tombstoned/superseded rows); host: index length
+                # count tombstoned/superseded rows); dukeDeleted records
+                # stay resolvable by design but are not "indexed" for
+                # matching, so they are excluded from the count; host:
+                # index length
                 live = getattr(wl.index, "records", None)
                 row = {
                     "kind": kind,
                     "name": name,
                     "records_indexed": (
-                        len(live) if live is not None else len(wl.index)
+                        sum(1 for r in live.values() if not r.is_deleted())
+                        if live is not None else len(wl.index)
                     ),
                 }
                 if stats is not None:

@@ -354,6 +354,119 @@ def test_one_to_one_cross_batch_retracts_weaker_link():
     assert live == {("a2", "b1")}
 
 
+def test_one_to_one_displacement_reassigns_runner_up():
+    from sesam_duke_microservice_tpu.core.records import (
+        ID_PROPERTY_NAME,
+        ORIGINAL_ENTITY_ID_PROPERTY_NAME,
+        Record,
+    )
+    from sesam_duke_microservice_tpu.engine.listeners import (
+        ServiceMatchListener,
+    )
+    from sesam_duke_microservice_tpu.links.base import LinkStatus
+    from sesam_duke_microservice_tpu.links.memory import InMemoryLinkDatabase
+
+    def rec(rid):
+        r = Record()
+        r.add_value(ID_PROPERTY_NAME, rid)
+        r.add_value(ORIGINAL_ENTITY_ID_PROPERTY_NAME, rid)
+        return r
+
+    a1, a2, b1, b2 = rec("a1"), rec("a2"), rec("b1"), rec("b2")
+    linkdb = InMemoryLinkDatabase()
+    lis = ServiceMatchListener("t", linkdb, kind="recordlinkage",
+                               one_to_one=True)
+    # batch 1: a1-b1 wins at 0.9; a1's runner-up a1-b2 (0.85) is remembered
+    lis.batch_ready(1)
+    lis.matches(a1, b1, 0.9)
+    lis.matches(a1, b2, 0.85)
+    lis.batch_done()
+    # batch 2: a2-b1 at 0.95 displaces a1 from b1 -> a1 falls back to its
+    # remembered runner-up b2 instead of being stranded
+    lis.batch_ready(1)
+    lis.matches(a2, b1, 0.95)
+    lis.batch_done()
+    live = {(l.id1, l.id2) for l in linkdb.get_changes_since(0)
+            if l.status != LinkStatus.RETRACTED}
+    assert live == {("a2", "b1"), ("a1", "b2")}
+
+
+def test_one_to_one_transform_pairs_never_become_links():
+    from sesam_duke_microservice_tpu.core.records import (
+        ID_PROPERTY_NAME,
+        ORIGINAL_ENTITY_ID_PROPERTY_NAME,
+        Record,
+    )
+    from sesam_duke_microservice_tpu.engine.listeners import (
+        ServiceMatchListener,
+    )
+    from sesam_duke_microservice_tpu.links.base import LinkStatus
+    from sesam_duke_microservice_tpu.links.memory import InMemoryLinkDatabase
+
+    def rec(rid):
+        r = Record()
+        r.add_value(ID_PROPERTY_NAME, rid)
+        r.add_value(ORIGINAL_ENTITY_ID_PROPERTY_NAME, rid)
+        return r
+
+    a1, a2, q, b1 = rec("a1"), rec("a2"), rec("transient-q"), rec("b1")
+    linkdb = InMemoryLinkDatabase()
+    lis = ServiceMatchListener("t", linkdb, kind="recordlinkage",
+                               one_to_one=True)
+    # indexed batch: a1-b1 asserted
+    lis.batch_ready(1)
+    lis.matches(a1, b1, 0.9)
+    lis.batch_done()
+    # http-transform probe: q also matches b1 but loses to nothing —
+    # suppressed because b1 is claimed; its pair must NOT be remembered
+    lis.set_link_database_updates_disabled(True)
+    lis.batch_ready(1)
+    lis.matches(q, b1, 0.85)
+    lis.batch_done()
+    lis.set_link_database_updates_disabled(False)
+    # displacement: a2-b1 at 0.95 retracts a1-b1; the transform probe's
+    # (q, b1) pair must not resurface as an assertable link
+    lis.batch_ready(1)
+    lis.matches(a2, b1, 0.95)
+    lis.batch_done()
+    live = {(l.id1, l.id2) for l in linkdb.get_changes_since(0)
+            if l.status != LinkStatus.RETRACTED}
+    assert live == {("a2", "b1")}
+    assert all("transient-q" not in pair for pair in live)
+
+
+def test_one_to_one_suppressed_record_gets_no_match_event():
+    from sesam_duke_microservice_tpu.core.records import (
+        ID_PROPERTY_NAME,
+        ORIGINAL_ENTITY_ID_PROPERTY_NAME,
+        Record,
+    )
+    from sesam_duke_microservice_tpu.engine.listeners import (
+        ServiceMatchListener,
+    )
+    from sesam_duke_microservice_tpu.links.memory import InMemoryLinkDatabase
+
+    def rec(rid):
+        r = Record()
+        r.add_value(ID_PROPERTY_NAME, rid)
+        r.add_value(ORIGINAL_ENTITY_ID_PROPERTY_NAME, rid)
+        return r
+
+    a1, a2, b1 = rec("a1"), rec("a2"), rec("b1")
+    linkdb = InMemoryLinkDatabase()
+    lis = ServiceMatchListener("t", linkdb, kind="recordlinkage",
+                               one_to_one=True)
+    seen = []
+    lis._wrapped.no_match_for = lambda r: seen.append(r.record_id)
+    lis.batch_ready(2)
+    lis.matches(a1, b1, 0.9)
+    lis.matches(a2, b1, 0.8)   # loses b1 to a1, no other candidate
+    lis.batch_done()
+    # a2's only definite match was suppressed at flush -> the listener
+    # protocol still emits a terminal event for it
+    assert seen == ["a2"]
+
+
 def test_fuzzy_search_expands_tokens():
     from sesam_duke_microservice_tpu.core import comparators as C
     from sesam_duke_microservice_tpu.core.config import (
