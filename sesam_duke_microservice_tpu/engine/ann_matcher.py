@@ -100,10 +100,31 @@ class _AnnScorerCache(_ScorerCache):
             )
         return self._scorers[key]
 
-    def score_block(self, records: Sequence[Record], *,
-                    group_filtering: bool) -> _BlockResult:
+    def _lower_one(self, row_feats, cap: int, bucket: int,
+                   group_filtering: bool):
+        """ANN pre-warm: the scorer signature carries the embedding matrix
+        separately from the feature tree (see dispatch_block)."""
+        import jax
+
+        row_feats = dict(row_feats)
+        emb = row_feats.pop(E.ANN_PROP)[E.ANN_TENSOR]
+        cfeats, (mb, mb2, mi, qg, qr, ml) = self._lower_args(
+            row_feats, cap, bucket
+        )
+        corpus_emb = jax.ShapeDtypeStruct((cap,) + emb.shape[1:], emb.dtype)
+        q_emb = jax.ShapeDtypeStruct((), np.float32)
+        c = min(self.index.initial_top_c, cap)
+        scorer = self._scorer(c, group_filtering, True)
+        scorer.lower(
+            q_emb, {}, corpus_emb, cfeats, mb, mb2, mi, qg, qr, ml
+        ).compile()
+
+    def dispatch_block(self, records: Sequence[Record], *,
+                       group_filtering: bool):
         from ..ops import scoring as S
         import jax.numpy as jnp
+
+        from .device_matcher import _PendingBlock
 
         index = self.index
         corpus = index.corpus
@@ -133,24 +154,20 @@ class _AnnScorerCache(_ScorerCache):
             if prop != E.ANN_PROP
         }
 
-        top_c = index.initial_top_c
-        while True:
-            c = min(top_c, corpus.capacity)
-            scorer = self._scorer(c, group_filtering, from_rows)
-            top_logit, top_index, count = scorer(
+        def call(c):
+            return self._scorer(c, group_filtering, from_rows)(
                 q_emb, qfeats, corpus_emb, corpus_feats, cvalid, cdeleted,
                 cgroup, query_group_j, query_row_j, jnp.float32(min_logit),
             )
-            count_np = np.asarray(count)[:n]
-            if c >= corpus.capacity or count_np.max(initial=0) < c:
-                return _BlockResult(
-                    np.asarray(top_logit), np.asarray(top_index), min_logit
-                )
-            top_c = c * 2
-            logger.info(
-                "recall escalation: all %d retrieved candidates cleared the "
-                "bound, retrying with C=%d", int(count_np.max()), top_c,
-            )
+
+        c = min(index.initial_top_c, corpus.capacity)
+        # recall escalation: when every retrieved candidate cleared the
+        # pruning bound the search saturated — double C so truncation can
+        # never pass silently
+        return _PendingBlock(
+            corpus.capacity, n, min_logit, c, call,
+            lambda cmax, cc: cmax >= cc, *call(c)
+        )
 
 
 class AnnProcessor(DeviceProcessor):

@@ -149,6 +149,10 @@ class ServiceMatchListener(MatchListener):
         if self._batch_no % self._ALTERNATIVE_MAX_AGE == 0:
             self._prune_alternatives()
         links_by_id: Dict[str, List[Link]] = {}
+        # ids whose links are COMPLETE in links_by_id (the batched fetch
+        # also surfaces links of out-of-batch endpoints — those entries are
+        # partial and must not suppress the lazy per-record fetch)
+        fetched: set = set()
         if not transform and pending:
             ids = {t[1].record_id for t in pending} | {
                 t[2].record_id for t in pending
@@ -156,23 +160,28 @@ class ServiceMatchListener(MatchListener):
             # seed every id so unlinked records (the steady-state common
             # case) don't fall through to per-record lazy DB lookups
             links_by_id = {rid: [] for rid in ids}
+            fetched = set(ids)
             for link in self._wrapped.linkdb.get_links_for_ids(ids):
                 links_by_id.setdefault(link.id1, []).append(link)
                 links_by_id.setdefault(link.id2, []).append(link)
 
-        # heap orders by (-confidence, ids); seen_pairs guards against the
-        # same pair re-entering via both endpoints' alternative lists
-        heap: List[Tuple[float, str, str, Record, Record]] = [
-            (-conf, r1.record_id, r2.record_id, r1, r2)
+        # heap orders by (-confidence, ids, tie-counter); the counter makes
+        # every entry totally ordered BEFORE comparison could reach the
+        # Record payloads (Record has __eq__ but no __lt__ — a tie on the
+        # string keys would otherwise raise TypeError); seen_pairs guards
+        # against the same pair re-entering via both endpoints' alternative
+        # lists
+        tie = iter(range(1 << 62))
+        heap: List[tuple] = [
+            (-conf, r1.record_id, r2.record_id, next(tie), r1, r2)
             for conf, r1, r2 in pending
         ]
         heapq.heapify(heap)
         seen_pairs: set = set()
         taken: set = set()
-        linked: set = set()
 
         while heap:
-            negconf, id1, id2, r1, r2 = heapq.heappop(heap)
+            negconf, id1, id2, _, r1, r2 = heapq.heappop(heap)
             confidence = -negconf
             pkey = tuple(sorted((id1, id2)))
             if pkey in seen_pairs:
@@ -183,7 +192,7 @@ class ServiceMatchListener(MatchListener):
                 continue
             if not transform:
                 blocked, to_retract = self._existing_conflicts(
-                    links_by_id, id1, id2, confidence
+                    links_by_id, fetched, id1, id2, confidence
                 )
                 if blocked:
                     self._remember_alternative(confidence, r1, r2)
@@ -211,7 +220,7 @@ class ServiceMatchListener(MatchListener):
                         heapq.heappush(
                             heap,
                             (-alt_conf, a1.record_id, a2.record_id,
-                             a1, a2),
+                             next(tie), a1, a2),
                         )
                 self._wrapped.matches(r1, r2, confidence)
                 new = Link(id1, id2, LinkStatus.INFERRED,
@@ -262,24 +271,29 @@ class ServiceMatchListener(MatchListener):
             self._alternatives.pop(rid, None)
 
     def _existing_conflicts(self, links_by_id: Dict[str, List[Link]],
-                            id1: str, id2: str, confidence: float):
+                            fetched: set, id1: str, id2: str,
+                            confidence: float):
         """Definite links from earlier batches touching either record.
 
         Returns (blocked, to_retract): blocked when an existing link with
         >= confidence already claims one of the records; otherwise the
         weaker existing links to retract before asserting the new pair.
-        ``links_by_id`` is the flush's batched link fetch — records missing
-        from it (reachable only through displacement-repair alternatives)
-        are fetched lazily.
+        ``fetched`` is the set of ids whose links are COMPLETE in
+        ``links_by_id`` (the batched prefetch also creates partial entries
+        for out-of-batch endpoints of fetched links — completeness, not
+        mere presence, decides whether the lazy per-record fetch runs).
         """
         pair = {id1, id2}
         blocked = False
         to_retract = []
         for rid in pair:
-            if rid not in links_by_id:
-                links_by_id[rid] = list(
-                    self._wrapped.linkdb.get_all_links_for(rid)
-                )
+            if rid not in fetched:
+                fetched.add(rid)
+                known = links_by_id.setdefault(rid, [])
+                keys = {l.key() for l in known}
+                for link in self._wrapped.linkdb.get_all_links_for(rid):
+                    if link.key() not in keys:
+                        known.append(link)
             for link in links_by_id[rid]:
                 if link.kind != LinkKind.DUPLICATE:
                     continue

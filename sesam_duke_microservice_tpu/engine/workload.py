@@ -49,6 +49,18 @@ def _snapshot_path(data_folder: str) -> str:
     return os.path.join(data_folder, "corpus_snapshot.npz")
 
 
+class _BatchRequest:
+    """One queued ingest request awaiting the merged device batch."""
+
+    __slots__ = ("dataset_id", "entities", "event", "error")
+
+    def __init__(self, dataset_id: str, entities: Sequence[dict]):
+        self.dataset_id = dataset_id
+        self.entities = entities
+        self.event = threading.Event()
+        self.error: Optional[Exception] = None
+
+
 class Workload:
     def __init__(self, config: WorkloadConfig, index: CandidateIndex,
                  processor: Processor, listener: ServiceMatchListener,
@@ -66,12 +78,102 @@ class Workload:
         # set under self.lock when a config reload replaces this workload;
         # handlers that resolved a stale reference re-check after locking
         self.closed = False
+        # ingest microbatching: concurrent POSTs queue here and whichever
+        # thread wins the workload lock processes the whole queue as ONE
+        # device batch (self._mb_mutex orders queue access; it is never
+        # held while acquiring self.lock)
+        self._mb_mutex = threading.Lock()
+        self._mb_queue: List[_BatchRequest] = []
         self.datasources: Dict[str, IncrementalDataSource] = {
             ds.dataset_id: IncrementalDataSource(ds)
             for ds in config.duke.data_sources
         }
 
-    # -- ingest + match (call with self.lock held) --------------------------
+    # -- ingest + match -----------------------------------------------------
+
+    def submit_batch(self, dataset_id: str, entities: Sequence[dict],
+                     http_transform: bool = False) -> Optional[List[dict]]:
+        """Handler entry: lock discipline + ingest microbatching.
+
+        Non-transform POSTs that arrive while another request holds the
+        workload lock are queued; whichever thread next wins the lock runs
+        the whole queue as ONE merged device batch (per-request conversion
+        errors stay per-request), so many small concurrent POSTs cost one
+        scoring program instead of N — the request-aggregation half of
+        SURVEY.md section 7 hard part 6.  The reference serializes every
+        POST on the workload lock (App.java:947) with no aggregation.
+
+        Transforms keep their own lock-held call: their response rows are
+        per-request state on the shared listener.  Returns None when the
+        workload was replaced by a config reload mid-flight (caller
+        re-resolves the registry and resubmits); raises this request's
+        error otherwise.
+        """
+        if http_transform:
+            with self.lock:
+                if self.closed:
+                    return None
+                return self.process_batch(dataset_id, entities,
+                                          http_transform=True)
+
+        req = _BatchRequest(dataset_id, entities)
+        with self._mb_mutex:
+            self._mb_queue.append(req)
+        with self.lock:
+            if not req.event.is_set():
+                with self._mb_mutex:
+                    if self.closed:
+                        # a reload replaced this workload while we waited;
+                        # withdraw (if a pre-close leader already took the
+                        # request its event is set and we fall through)
+                        if req in self._mb_queue:
+                            self._mb_queue.remove(req)
+                            return None
+                    work, self._mb_queue = self._mb_queue, []
+                if work:
+                    self._run_merged(work)
+        if not req.event.is_set():  # withdrawn post-close without a leader
+            return None
+        if req.error is not None:
+            raise req.error
+        return []
+
+    def _run_merged(self, work: List[_BatchRequest]) -> None:
+        """Process queued requests as one batch (call with self.lock held)."""
+        all_live: List[Record] = []
+        any_deleted = False
+        ok: List[_BatchRequest] = []
+        for req in work:
+            try:
+                datasource = self.datasources[req.dataset_id]
+                records = datasource.records_for_batch(req.entities)
+                if self.record_store is not None:
+                    self.record_store.put_many(records)
+                deleted = [r for r in records if r.is_deleted()]
+                for record in deleted:
+                    self.index.index(record)
+                    for link in self.link_database.get_all_links_for(
+                            record.record_id):
+                        link.retract()
+                        self.link_database.assert_link(link)
+            except Exception as e:  # conversion/store errors stay per-request
+                req.error = e
+                req.event.set()
+                continue
+            any_deleted = any_deleted or bool(deleted)
+            all_live.extend(r for r in records if not r.is_deleted())
+            ok.append(req)
+        try:
+            if any_deleted:
+                self.index.commit()
+            if all_live:
+                self.processor.deduplicate(all_live)
+        except Exception as e:
+            for req in ok:
+                req.error = e
+        finally:
+            for req in ok:
+                req.event.set()
 
     def process_batch(self, dataset_id: str, entities: Sequence[dict],
                       http_transform: bool = False) -> List[dict]:

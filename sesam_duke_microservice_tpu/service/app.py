@@ -260,15 +260,26 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                 # count tombstoned/superseded rows); dukeDeleted records
                 # stay resolvable by design but are not "indexed" for
                 # matching, so they are excluded from the count; host:
-                # index length
-                live = getattr(wl.index, "records", None)
+                # index length.  Counting iterates the index's dicts, so
+                # it needs the workload lock against concurrent ingest
+                # (a resize mid-iteration raises); skip the count rather
+                # than block behind a long-running batch.
+                if wl.lock.acquire(timeout=READ_LOCK_TIMEOUT_SECONDS):
+                    try:
+                        live = getattr(wl.index, "records", None)
+                        indexed = (
+                            sum(1 for r in live.values()
+                                if not r.is_deleted())
+                            if live is not None else len(wl.index)
+                        )
+                    finally:
+                        wl.lock.release()
+                else:
+                    indexed = None
                 row = {
                     "kind": kind,
                     "name": name,
-                    "records_indexed": (
-                        sum(1 for r in live.values() if not r.is_deleted())
-                        if live is not None else len(wl.index)
-                    ),
+                    "records_indexed": indexed,
                 }
                 if stats is not None:
                     row.update(
@@ -322,18 +333,19 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                 raise _HttpError(400, "Batch elements must be JSON objects")
 
         while True:
-            # re-resolve until we hold the lock on a live workload: a config
+            # re-resolve until a live workload accepts the batch: a config
             # reload can replace the registry entry between lookup and lock
+            # (submit_batch returns None for a replaced workload); ingest
+            # requests merge into per-workload device microbatches inside
+            # submit_batch
             kind, workload, dataset_id, transform = self._validate_entity_path(m)
-            with workload.lock:
-                if workload.closed:
-                    continue
-                try:
-                    rows = workload.process_batch(dataset_id, batch,
-                                                  http_transform=transform)
-                except Exception as e:
-                    logger.exception("Batch processing failed")
-                    raise _HttpError(500, f"Batch processing failed: {e}")
+            try:
+                rows = workload.submit_batch(dataset_id, batch,
+                                             http_transform=transform)
+            except Exception as e:
+                logger.exception("Batch processing failed")
+                raise _HttpError(500, f"Batch processing failed: {e}")
+            if rows is not None:
                 break
 
         if transform:

@@ -19,6 +19,9 @@ os.environ.setdefault("DEVICE_QUERY_BUCKETS", "8,32")
 os.environ.setdefault("DEVICE_TOP_K", "16")
 os.environ.setdefault("DEVICE_MAX_CHARS", "24")
 os.environ.setdefault("DEVICE_MAX_GRAMS", "24")
+# background compile pre-warm off by default in tests (it competes with the
+# slow CPU-interpret compiles); test_device_matcher re-enables it explicitly
+os.environ.setdefault("DEVICE_PREWARM", "0")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -400,6 +400,33 @@ class TestDeviceCorpus:
         assert index.corpus.row_valid[:600].all()
 
 
+    def test_prewarm_compiles_ladder_and_scoring_unchanged(self, monkeypatch):
+        """Background pre-warm (enabled explicitly; conftest disables it for
+        suite speed) compiles without error and scoring results match an
+        un-warmed index."""
+        monkeypatch.setenv("DEVICE_PREWARM", "1")
+        schema = dedup_schema()
+        records = random_records(40, seed=7)
+
+        index = DeviceIndex(schema)
+        proc = DeviceProcessor(schema, index)
+        log = EventLog()
+        proc.add_match_listener(log)
+        proc.deduplicate(records)
+        cache = index.scorer_cache
+        assert cache._warm_thread is not None
+        cache._warm_thread.join(timeout=120)
+        assert not cache._warm_thread.is_alive()
+
+        monkeypatch.setenv("DEVICE_PREWARM", "0")
+        index2 = DeviceIndex(schema)
+        proc2 = DeviceProcessor(schema, index2)
+        log2 = EventLog()
+        proc2.add_match_listener(log2)
+        proc2.deduplicate(records)
+        assert log.match_set() == log2.match_set()
+
+
 class TestSnapshot:
     def test_snapshot_roundtrip(self, tmp_path):
         schema = dedup_schema()

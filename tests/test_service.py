@@ -320,6 +320,59 @@ def test_stats_endpoint(server_url):
     assert people["records_processed"] >= 1
 
 
+def test_concurrent_posts_microbatch_and_all_succeed(server_url):
+    """Concurrent small POSTs merge into workload microbatches; every
+    request still gets its own success/error and all links land."""
+    results = []
+    lock = threading.Lock()
+
+    def poster(i):
+        status, _, body = post_json(
+            f"{server_url}/deduplication/people/crm",
+            [{"_id": f"mb{i}", "name": f"micro batch {i}",
+              "email": f"mb{i}@x"},
+             {"_id": f"mb{i}-dup", "name": f"micro batch {i}",
+              "email": f"mb{i}@x"}],
+        )
+        with lock:
+            results.append((status, body))
+
+    threads = [threading.Thread(target=poster, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(s == 200 for s, _ in results), results
+    assert all(json.loads(b)["success"] for _, b in results)
+    _, _, feed = request(f"{server_url}/deduplication/people?since=0")
+    ids = {row["_id"] for row in json.loads(feed)}
+    for i in range(8):
+        assert any(f"mb{i}-dup" in rid and f"mb{i}" in rid for rid in ids), \
+            (i, ids)
+
+    # a bad request merged with good ones fails alone
+    statuses = []
+
+    def post_one(payload):
+        status, _, _ = post_json(
+            f"{server_url}/deduplication/people/crm", payload)
+        with lock:
+            statuses.append(status)
+
+    threads = [
+        threading.Thread(target=post_one,
+                         args=([{"_id": f"ok{i}", "name": f"fine {i}",
+                                 "email": f"ok{i}@x"}],))
+        for i in range(3)
+    ] + [threading.Thread(target=post_one,
+                          args=([{"name": "missing id"}],))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(statuses) == [200, 200, 200, 500]
+
+
 def test_device_reload_uses_corpus_snapshot(tmp_path, monkeypatch):
     """Hot reload must restore the new workloads' corpora from the
     snapshot saved under the quiesce locks, not re-extract features."""
