@@ -687,6 +687,7 @@ class _ScorerCache:
         self._scorers: Dict[Tuple[int, bool], object] = {}
         self._warmed = None
         self._warm_thread: Optional[threading.Thread] = None
+        self._warm_compiled = 0  # successful AOT compiles (observability)
 
     # -- compile-ladder pre-warm --------------------------------------------
 
@@ -759,6 +760,7 @@ class _ScorerCache:
                         return  # superseded / interpreter exiting
                     self._lower_one(row_feats, cap_i, bucket,
                                     group_filtering)
+                    self._warm_compiled += 1
         except Exception:  # pragma: no cover - warm failures are harmless
             logger.exception("scorer pre-warm failed (scoring unaffected)")
 

@@ -353,6 +353,12 @@ def build_workload(wc: WorkloadConfig, sc: ServiceConfig, *,
                 for record in records_by_id.values():
                     index.index(record)
                 index.commit()
+            # the restored corpus' capacity/value-slot fingerprint differs
+            # from the empty-corpus warm the processor ctor kicked; re-warm
+            # so the first real batch doesn't stall on scorer compiles
+            cache = getattr(index, "scorer_cache", None)
+            if records_by_id and cache is not None:
+                cache.prewarm_async(group_filtering)
     except BaseException:
         # a half-built workload never reaches the caller; release whatever
         # opened so a failing hot reload cannot leak handles (quirk Q7)

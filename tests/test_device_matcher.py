@@ -417,6 +417,14 @@ class TestDeviceCorpus:
         assert cache._warm_thread is not None
         cache._warm_thread.join(timeout=120)
         assert not cache._warm_thread.is_alive()
+        # the warm must have actually compiled (a silently-failing prewarm
+        # would leave the feature dead while scoring still works) and built
+        # the from_rows scorer for the initial K
+        from sesam_duke_microservice_tpu.engine import device_matcher as dm
+
+        assert cache._warm_compiled > 0
+        k = min(dm._INITIAL_TOP_K, index.corpus.capacity)
+        assert (k, False, True) in cache._scorers
 
         monkeypatch.setenv("DEVICE_PREWARM", "0")
         index2 = DeviceIndex(schema)

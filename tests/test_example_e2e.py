@@ -1,0 +1,232 @@
+"""End-to-end replication of the reference's example-config flow.
+
+The reference's only functional system test is the Sesam pipe config
+``sesam_node_example_config.conf.json``: it pulls the Duke example country
+CSVs, pushes them through BOTH workloads' sink endpoints
+(``/deduplication/...`` and ``/recordlinkage/...`` for each dataset,
+lines 2-93), polls results back with ``supports_since`` (lines 94-119),
+and exercises all four http-transform endpoints (lines 120-186).  This
+test is that flow in-process: CSV fixtures -> HTTP POST per dataset ->
+since-feed -> http-transforms, against the bundled default config
+(the port of testdukeconfig.xml) — asserting against *longhand-computed*
+expected links (textbook comparator math + Duke's published probability
+map and Bayes combination), so the assertion chain never passes through
+the engine's own oracle.
+
+Note the reference config's ``capical`` column-name typo for the dbpedia
+dataset is part of the schema and preserved here.
+"""
+
+import csv
+import json
+import os
+import threading
+import urllib.request
+
+import pytest
+
+from sesam_duke_microservice_tpu.core.config import load_default_config
+from sesam_duke_microservice_tpu.service.app import DukeApp, serve
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _read_csv(name):
+    with open(os.path.join(FIXTURES, name), newline="") as f:
+        return list(csv.DictReader(f))
+
+
+def _entities(rows):
+    out = []
+    for row in rows:
+        entity = dict(row)
+        entity["_id"] = entity.pop("id")
+        out.append(entity)
+    return out
+
+
+# -- longhand Duke math (independent of the library; see test_goldens) ------
+
+def _lev_distance(a, b):
+    m, n = len(a), len(b)
+    d = [[0] * (n + 1) for _ in range(m + 1)]
+    for i in range(m + 1):
+        d[i][0] = i
+    for j in range(n + 1):
+        d[0][j] = j
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            d[i][j] = min(d[i - 1][j] + 1, d[i][j - 1] + 1,
+                          d[i - 1][j - 1] + (a[i - 1] != b[j - 1]))
+    return d[m][n]
+
+
+def _lev_sim(a, b):
+    if a == b:
+        return 1.0
+    s, l = min(len(a), len(b)), max(len(a), len(b))
+    if s == 0 or (l - s) * 2 > s:
+        return 0.0
+    return 1.0 - min(_lev_distance(a, b), s) / s
+
+
+def _numeric_sim(a, b, min_ratio=0.7):
+    d1, d2 = float(a), float(b)
+    if d1 == d2:
+        return 1.0
+    ratio = min(abs(d1), abs(d2)) / max(abs(d1), abs(d2))
+    return ratio if ratio >= min_ratio else 0.0
+
+
+def _pmap(sim, low, high):
+    return (high - 0.5) * sim * sim + 0.5 if sim >= 0.5 else low
+
+
+def _bayes(ps):
+    num = den = 1.0
+    for p in ps:
+        num *= p
+        den *= 1.0 - p
+    return num / (num + den)
+
+
+def expected_confidence(db_row, mo_row):
+    """Longhand pair probability under the demo schema: NAME .09/.93
+    Levenshtein, AREA .04/.73 Numeric(0.7), CAPITAL .12/.61 Levenshtein;
+    values lower-cased by the cleaners."""
+    name = _pmap(_lev_sim(db_row["country"].lower(),
+                          mo_row["country"].lower()), 0.09, 0.93)
+    area = _pmap(_numeric_sim(db_row["area"], mo_row["area"]), 0.04, 0.73)
+    cap = _pmap(_lev_sim(db_row["capical"].lower(),
+                         mo_row["capital"].lower()), 0.12, 0.61)
+    return _bayes([name, area, cap])
+
+
+def expected_links(threshold):
+    """Cross-dataset country pairs whose longhand probability clears the
+    threshold (same-name rows were built to match, Germany/Georgia to not)."""
+    out = {}
+    for db_row in _read_csv("countries_dbpedia.csv"):
+        for mo_row in _read_csv("countries_mondial.csv"):
+            conf = expected_confidence(db_row, mo_row)
+            if conf > threshold:
+                out[(db_row["id"], mo_row["id"])] = conf
+    return out
+
+
+@pytest.fixture(scope="module", params=["host", "device"])
+def example_server(request):
+    os.environ["MIN_RELEVANCE"] = "0.05"  # tiny corpus: don't prune on tf-idf
+    try:
+        app = DukeApp(load_default_config(), backend=request.param,
+                      persistent=False)
+        server = serve(app, port=0, host="127.0.0.1")
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        yield f"http://127.0.0.1:{server.server_address[1]}"
+        server.shutdown()
+    finally:
+        os.environ.pop("MIN_RELEVANCE", None)
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as resp:
+        return json.loads(resp.read())
+
+
+def test_example_config_flow(example_server):
+    base = example_server
+    dbpedia = _entities(_read_csv("countries_dbpedia.csv"))
+    mondial = _entities(_read_csv("countries_mondial.csv"))
+
+    # 1. sink pushes: each dataset into BOTH workloads (example config
+    #    pipes countries-*-to-duke / countries-*-to-duke-deduplication)
+    for kind in ("recordlinkage", "deduplication"):
+        s, body = _post(
+            f"{base}/{kind}/countries-dbpedia-mondial/countries-dbpedia",
+            dbpedia)
+        assert (s, body) == (200, {"success": True})
+        s, body = _post(
+            f"{base}/{kind}/countries-dbpedia-mondial/countries-mondial",
+            mondial)
+        assert (s, body) == (200, {"success": True})
+
+    # 2. the since-feed (supports_since source pipes): linkage at
+    #    threshold 0.7 must contain exactly the longhand-expected pairs
+    #    with longhand-exact confidences
+    rows = _get(f"{base}/recordlinkage/countries-dbpedia-mondial?since=0")
+    got = {
+        (r["entity1"], r["entity2"]): r for r in rows if not r["_deleted"]
+    }
+    want = expected_links(0.7)
+    assert set(got) == set(want)
+    for pair, conf in want.items():
+        assert got[pair]["confidence"] == pytest.approx(conf, abs=1e-9)
+        assert got[pair]["dataset1"] == "countries-dbpedia"
+        assert got[pair]["dataset2"] == "countries-mondial"
+    # wire format: link _id is id1_id2 with ':' mapped to '_'
+    # (App.java:758-767); the France row's entity id carries a ':'
+    fr = next(r for r in rows if r["entity1"] == "fr:7")
+    assert ":" not in fr["_id"]
+    assert "fr_7" in fr["_id"]
+    assert set(fr) == {"_id", "_updated", "_deleted", "entity1", "entity2",
+                       "dataset1", "dataset2", "confidence"}
+
+    # 3. dedup workload: same corpora in one group-free workload at
+    #    threshold 0.9 — cross-dataset duplicates only for the pairs whose
+    #    longhand probability clears 0.9
+    rows = _get(f"{base}/deduplication/countries-dbpedia-mondial?since=0")
+    got_dedup = {
+        frozenset((r["entity1"], r["entity2"]))
+        for r in rows if not r["_deleted"]
+    }
+    want_dedup = {frozenset(p) for p, c in expected_links(0.9).items()}
+    assert got_dedup == want_dedup
+
+    # 4. incremental since: polling from the max timestamp returns nothing
+    last = max(r["_updated"] for r in rows)
+    assert _get(
+        f"{base}/deduplication/countries-dbpedia-mondial?since={last}") == []
+
+    # 5. all four http-transform endpoints (…-http-transform pipes):
+    #    entities echoed with duke_links; no link-db side effects
+    before = _get(f"{base}/recordlinkage/countries-dbpedia-mondial?since=0")
+    probe = [{"_id": "probe1", "country": "Norway", "area": "385000",
+              "capical": "Oslo"}]
+    s, body = _post(
+        f"{base}/recordlinkage/countries-dbpedia-mondial/countries-dbpedia"
+        "/httptransform", probe)
+    assert s == 200
+    assert body[0]["_id"] == "probe1"
+    linked = {d["entityId"] for d in body[0]["duke_links"]}
+    assert "m1" in linked          # mondial Norway
+    assert "1" not in linked       # same-group dbpedia row excluded
+    probe_mo = [{"_id": "probe2", "country": "Sweden", "capital": "Stockholm",
+                 "area": "449000"}]
+    s, body = _post(
+        f"{base}/recordlinkage/countries-dbpedia-mondial/countries-mondial"
+        "/httptransform", probe_mo)
+    assert s == 200
+    assert {d["entityId"] for d in body[0]["duke_links"]} >= {"2"}
+    for dataset, payload in (("countries-dbpedia", probe),
+                             ("countries-mondial", probe_mo)):
+        s, body = _post(
+            f"{base}/deduplication/countries-dbpedia-mondial/{dataset}"
+            "/httptransform", payload)
+        assert s == 200
+        assert body[0]["duke_links"], (dataset, body)
+    after = _get(f"{base}/recordlinkage/countries-dbpedia-mondial?since=0")
+    assert after == before         # transforms never wrote links
+    # transform probes were never indexed either: feeds still resolve only
+    # fixture entity ids
+    ids = {r["entity1"] for r in after} | {r["entity2"] for r in after}
+    assert "probe1" not in ids and "probe2" not in ids
