@@ -1,0 +1,157 @@
+"""Child process for the two-process DCN smoke test (test_multihost.py).
+
+Each process: join the jax.distributed job over the localhost coordinator
+(DCN path), build the global corpus mesh spanning BOTH processes' devices,
+and run (a) a psum/all_gather collective and (b) the real sharded corpus
+scorer (parallel.sharded.build_sharded_scorer) over a corpus whose record
+axis shards across the two processes — the cross-host layout
+parallel/multihost.py documents.
+
+Usage: dcn_smoke_child.py <process_id> <coordinator_host:port>
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=2"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    process_id = int(sys.argv[1])
+    coordinator = sys.argv[2]
+
+    import numpy as np
+
+    from sesam_duke_microservice_tpu.parallel import multihost
+
+    assert multihost.initialize(
+        coordinator_address=coordinator, num_processes=2,
+        process_id=process_id,
+    ), "initialize() must report distributed"
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 4, jax.device_count()
+
+    mesh = multihost.global_corpus_mesh()
+    assert mesh.size == 4
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sesam_duke_microservice_tpu.parallel.sharded import SHARD_AXIS
+
+    # (a) collective smoke: psum + all_gather over the global mesh — the
+    # same collectives the corpus merge uses, here crossing the process
+    # boundary (DCN)
+    sharding = NamedSharding(mesh, P(SHARD_AXIS))
+
+    def local_block(index):
+        # global array (4, 8): row d holds value d
+        start = index[0].start or 0
+        rows = np.arange(start, start + 1, dtype=np.float32)
+        return np.broadcast_to(rows[:, None], (1, 8)).copy()
+
+    arr = jax.make_array_from_callback((4, 8), sharding, local_block)
+
+    @jax.jit
+    def collect(x):
+        def body(x):
+            total = jax.lax.psum(x, SHARD_AXIS)
+            gathered = jax.lax.all_gather(x, SHARD_AXIS)
+            return total, gathered
+
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=P(SHARD_AXIS),
+            out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        )(x)
+
+    total, gathered = collect(arr)
+    local_total = np.asarray(
+        [s.data for s in total.addressable_shards][0]
+    )
+    assert float(local_total[0, 0]) == 0.0 + 1.0 + 2.0 + 3.0, local_total
+
+    # (b) the real sharded scorer over a cross-process record axis
+    from sesam_duke_microservice_tpu.core import comparators as C
+    from sesam_duke_microservice_tpu.core.config import DukeSchema
+    from sesam_duke_microservice_tpu.core.records import (
+        ID_PROPERTY_NAME,
+        Property,
+        Record,
+    )
+    from sesam_duke_microservice_tpu.ops import features as F
+    from sesam_duke_microservice_tpu.parallel.sharded import (
+        build_sharded_scorer,
+    )
+
+    schema = DukeSchema(
+        threshold=0.8, maybe_threshold=None,
+        properties=[
+            Property(ID_PROPERTY_NAME, id_property=True),
+            Property("name", C.Levenshtein(), 0.2, 0.9),
+        ],
+        data_sources=[],
+    )
+    plan = F.SchemaFeatures.plan(schema)
+
+    chunk, top_k, n_queries = 4, 4, 4
+    n_corpus = mesh.size * chunk  # one chunk per shard
+    records = []
+    for i in range(n_corpus):
+        r = Record()
+        r.add_value(ID_PROPERTY_NAME, f"ds__{i}")
+        r.add_value("name", f"name{i % 5}")
+        records.append(r)
+    feats = F.extract_batch(plan, records)
+    qfeats = F.extract_batch(plan, records[:n_queries])
+
+    def place(arr, fill=0):
+        spec = P(SHARD_AXIS, *([None] * (arr.ndim - 1)))
+        sh = NamedSharding(mesh, spec)
+        local = n_corpus // mesh.size
+
+        def cb(index):
+            start = index[0].start or 0
+            return arr[start:start + local]
+
+        return jax.make_array_from_callback(arr.shape, sh, cb)
+
+    sfeats = {
+        prop: {name: place(a) for name, a in tensors.items()}
+        for prop, tensors in feats.items()
+    }
+    svalid = place(np.ones((n_corpus,), dtype=bool))
+    sdeleted = place(np.zeros((n_corpus,), dtype=bool))
+    sgroup = place(np.full((n_corpus,), -1, dtype=np.int32))
+
+    scorer = build_sharded_scorer(plan, mesh, chunk=chunk, top_k=top_k)
+    qf = {p: {k: jnp.asarray(a) for k, a in t.items()}
+          for p, t in qfeats.items()}
+    top_logit, top_index, count = scorer(
+        qf, sfeats, svalid, sdeleted, sgroup,
+        jnp.full((n_queries,), -2, jnp.int32),
+        jnp.arange(n_queries, dtype=jnp.int32),
+        jnp.float32(-100.0),
+    )
+    ti = np.asarray(top_index)  # replicated output: gatherable everywhere
+    assert ti.shape == (n_queries, top_k)
+    # every query's exact-duplicate rows live i%5 apart — the top-K must
+    # surface rows from BOTH processes' shards (global row ids >= 8 live
+    # on process 1)
+    assert (ti >= 8).any(), ti
+    for qi in range(n_queries):
+        assert qi not in ti[qi], "self-pair leaked"
+
+    print(f"DCN_OK process={jax.process_index()} devices={jax.device_count()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -32,11 +32,6 @@ def goldens():
         return json.load(f)
 
 
-def _cases(goldens, key):
-    return [pytest.param(c, id=f"{c['v1']}~{c['v2']}")
-            for c in goldens[key]] if goldens else []
-
-
 def test_levenshtein_goldens(goldens):
     cmp = C.Levenshtein()
     for case in goldens["levenshtein"]:

@@ -1,0 +1,57 @@
+"""Two-process DCN smoke test: jax.distributed over localhost.
+
+``parallel/multihost.py`` is the multi-host entrypoint (one controller
+process per host, coordinator over DCN).  This test actually exercises it:
+two OS processes join a distributed job through
+``multihost.initialize(coordinator_address="localhost:<port>")``, build
+the global corpus mesh spanning both processes' devices (2 virtual CPU
+devices each -> 4 global), run a psum/all_gather across the process
+boundary, and execute the real sharded corpus scorer with the record axis
+sharded across processes (see ``dcn_smoke_child.py``).  This is the
+closest a single machine gets to the v5e multi-host deployment — same
+code path, coordinator handshake, and collectives, with gRPC-over-
+localhost standing in for DCN.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+CHILD = os.path.join(os.path.dirname(__file__), "dcn_smoke_child.py")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_init_and_sharded_scoring():
+    coordinator = f"localhost:{_free_port()}"
+    env = dict(os.environ)
+    # children force their own platform/device-count; scrub the suite's
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, CHILD, str(pid), coordinator],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=REPO,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    for rc, out, err in outs:
+        assert rc == 0, f"child failed (rc={rc}):\n{err[-4000:]}"
+        assert "DCN_OK" in out, (out, err[-2000:])
