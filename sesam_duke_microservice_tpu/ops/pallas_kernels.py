@@ -15,9 +15,14 @@ Duke Processor.compare) becomes one device program.
 Kernel inventory:
 
   * ``myers_distance_tiles`` — batched Levenshtein distance over all
-    query x corpus pairs via Myers/Hyyro bit-parallel DP (pattern <= 32
-    codepoints, one uint32 word per pair).  Differentially tested against
-    ``ops.pairwise.levenshtein_distance_myers`` and the scalar oracle.
+    query x corpus pairs via Myers/Hyyro bit-parallel DP: one uint32 word
+    per pair for patterns <= 32 codepoints, and a two-word
+    carry-propagated variant for 33..64 (Hyyro's block formulation), so
+    the default ``DEVICE_MAX_CHARS=64`` configs stay on the Pallas path.
+    Differentially tested against ``ops.pairwise`` and the scalar oracle.
+  * ``myers_distance_gathered`` — the same DP in the ANN-rescoring layout:
+    candidate c of query q is a specific gathered row, so the candidate
+    axis rides the lanes and text chars differ per pair.
   * ``set_intersection_tiles`` — |A ∩ B| for all query x corpus pairs of
     hashed id sets (q-grams / tokens): dense equality compare in VMEM,
     O(T*G) HBM traffic per tile instead of the XLA path's expanded
@@ -25,8 +30,9 @@ Kernel inventory:
     Dice).
   * ``jaro_winkler_sim_tiles`` — Jaro-Winkler over all pairs via matched-
     position uint32 bitmasks (greedy window matching + lowest-bit
-    transposition walk); 5.5x the flat XLA path on v5e.  Differentially
-    tested against the scalar comparator oracle.
+    transposition walk); 31x the flat XLA path end-to-end at the
+    production scan config (BASELINE.md).  Differentially tested against
+    the scalar comparator oracle.
 
 Enabling: ``pallas_enabled()`` — env ``DUKE_TPU_PALLAS`` ("1" force on,
 "0" force off); default on only when the active JAX backend is TPU.  On
@@ -112,6 +118,39 @@ def _stage_pair_operands(qx, qn, cx, cn, *, tile_q_cap: int,
 # -- Myers bit-parallel Levenshtein, tiled over the pair matrix --------------
 
 
+def _myers_word_init(ql):
+    """One-word DP init: (pv0, hibit) for pattern lengths <= 32.
+
+    min/max on int32 (Mosaic lacks unsigned vector min), then cast to
+    uint32 for the shifts.  bit j of pv0 set iff j < ql (guard the
+    undefined <<32).
+    """
+    one = jnp.uint32(1)
+    full = jnp.uint32(0xFFFFFFFF)
+    pv0 = jnp.where(
+        ql >= 32, full, (one << jnp.minimum(ql, 31).astype(jnp.uint32)) - one
+    )
+    hibit = one << (jnp.maximum(ql, 1) - 1).astype(jnp.uint32)
+    return pv0, hibit
+
+
+def _myers_word_step(eq, pv, mv, score, active, hibit):
+    """One text step of the one-word Myers recurrence (shared by the
+    cross-product and gathered kernels — one copy of the math)."""
+    one = jnp.uint32(1)
+    xv = eq | mv
+    xh = (((eq & pv) + pv) ^ pv) | eq
+    ph = mv | ~(xh | pv)
+    mh = pv & xh
+    score = score + jnp.where(active & ((ph & hibit) != 0), 1, 0)
+    score = score - jnp.where(active & ((mh & hibit) != 0), 1, 0)
+    ph = (ph << one) | one
+    mh = mh << one
+    pv = jnp.where(active, mh | ~(xv | ph), pv)
+    mv = jnp.where(active, ph & xv, mv)
+    return pv, mv, score
+
+
 def _myers_tile_kernel(qc_ref, ql_ref, cct_ref, cl_ref, out_ref, *, L: int):
     """One (TQ, TC) distance tile.
 
@@ -127,15 +166,7 @@ def _myers_tile_kernel(qc_ref, ql_ref, cct_ref, cl_ref, out_ref, *, L: int):
     ql = ql_ref[...][:, :1]               # (TQ, 1)
     cl = cl_ref[...][:1, :]               # (1, TC)
 
-    one = jnp.uint32(1)
-    full = jnp.uint32(0xFFFFFFFF)
-    # min/max on int32 (Mosaic lacks unsigned vector min), then cast to
-    # uint32 for the shifts.  bit j of pv0 set iff j < ql (ql <= 32; guard
-    # the undefined <<32).
-    pv0 = jnp.where(
-        ql >= 32, full, (one << jnp.minimum(ql, 31).astype(jnp.uint32)) - one
-    )                                     # (TQ, 1)
-    hibit = one << (jnp.maximum(ql, 1) - 1).astype(jnp.uint32)  # (TQ, 1)
+    pv0, hibit = _myers_word_init(ql)     # (TQ, 1)
 
     pv = jnp.broadcast_to(pv0, (tq, tc))
     mv = jnp.zeros((tq, tc), jnp.uint32)
@@ -147,18 +178,7 @@ def _myers_tile_kernel(qc_ref, ql_ref, cct_ref, cl_ref, out_ref, *, L: int):
         eq = jnp.zeros((tq, tc), jnp.uint32)
         for j in range(L):  # static unroll: disjoint bits, pure VPU work
             eq = eq | jnp.where(qc[:, j : j + 1] == t, jnp.uint32(1 << j), 0)
-        xv = eq | mv
-        xh = (((eq & pv) + pv) ^ pv) | eq
-        ph = mv | ~(xh | pv)
-        mh = pv & xh
-        active = i < cl                                    # (1, TC)
-        score = score + jnp.where(active & ((ph & hibit) != 0), 1, 0)
-        score = score - jnp.where(active & ((mh & hibit) != 0), 1, 0)
-        ph = (ph << one) | one
-        mh = mh << one
-        pv = jnp.where(active, mh | ~(xv | ph), pv)
-        mv = jnp.where(active, ph & xv, mv)
-        return (pv, mv, score)
+        return _myers_word_step(eq, pv, mv, score, i < cl, hibit)
 
     pv, mv, score = lax.fori_loop(0, L, step, (pv, mv, score))
     # empty pattern: distance is the text length
@@ -190,27 +210,150 @@ def _myers_tiles_padded(qc, ql2, cct, cl2, *, tile_q, tile_c, interpret):
 def myers_distance_tiles(qchars, qlen, cchars, clen, *, interpret=None):
     """All-pairs Levenshtein distance d(query_i, corpus_j) -> (Q, C) int32.
 
-    qchars: (Q, L) int32 codepoints (0-padded), L <= 32; qlen: (Q,) int32
+    qchars: (Q, L) int32 codepoints (0-padded), L <= 64; qlen: (Q,) int32
     cchars: (C, L) int32; clen: (C,) int32
 
-    Pads Q up to a sublane multiple and C up to a lane multiple; padded rows
-    compute garbage distances that callers mask via their validity bits.
+    L <= 32 runs the one-word kernel; 32 < L <= 64 the two-word Hyyro
+    variant (explicit carry propagation) — so the default 64-char configs
+    (``DEVICE_MAX_CHARS=64``) stay on the Pallas path instead of the slow
+    scan-DP fallback.  Pads Q up to a sublane multiple and C up to a lane
+    multiple; padded rows compute garbage distances that callers mask via
+    their validity bits.
     """
     q = qchars.shape[0]
     c = cchars.shape[0]
-    if qchars.shape[1] > 32:
+    if qchars.shape[1] > 64:
         raise ValueError(
-            f"Myers pallas kernel needs L <= 32, got {qchars.shape[1]}"
+            f"Myers pallas kernels need L <= 64, got {qchars.shape[1]}"
         )
     if interpret is None:
         interpret = _interpret()
+    two_word = qchars.shape[1] > 32
     qc, ql2, cct, cl2, tile_q, tile_c = _stage_pair_operands(
-        qchars, qlen, cchars, clen, tile_q_cap=128, tile_c_cap=512
+        qchars, qlen, cchars, clen,
+        tile_q_cap=128, tile_c_cap=256 if two_word else 512,
     )
-    out = _myers_tiles_padded(
+    call = _myers2_tiles_padded if two_word else _myers_tiles_padded
+    out = call(
         qc, ql2, cct, cl2, tile_q=tile_q, tile_c=tile_c, interpret=interpret
     )
     return out[:q, :c]
+
+
+def _carry_out(a: jnp.ndarray, b: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
+    """Carry out of the uint32 addition s = a + b, as 0/1 uint32.
+
+    Bitwise majority of the sign bits — Mosaic has no unsigned vector
+    compare, so overflow is detected without one.
+    """
+    return ((a & b) | ((a ^ b) & ~s)) >> jnp.uint32(31)
+
+
+def _myers2_tile_kernel(qc_ref, ql_ref, cct_ref, cl_ref, out_ref, *, L: int):
+    """Two-word Myers/Hyyro tile: pattern lengths 33..64 (2x uint32 words).
+
+    Same layout contract as ``_myers_tile_kernel``; the bit-parallel DP
+    state (Pv/Mv) spans two 32-bit words with explicit carry propagation
+    through the add and the horizontal shifts (Hyyro's block formulation).
+    """
+    tq = qc_ref.shape[0]
+    tc = cct_ref.shape[1]
+    qc = qc_ref[...]                      # (TQ, L)
+    ql = ql_ref[...][:, :1]               # (TQ, 1)
+    cl = cl_ref[...][:1, :]               # (1, TC)
+
+    one = jnp.uint32(1)
+    full = jnp.uint32(0xFFFFFFFF)
+
+    def bits_below(n):  # (1 << n) - 1 for n in [0, 32]
+        nn = jnp.clip(n, 0, 32)
+        return jnp.where(nn >= 32, full,
+                         (one << nn.astype(jnp.uint32)) - one)
+
+    pv0_init = bits_below(ql)                       # (TQ, 1)
+    pv1_init = bits_below(ql - 32)
+    hi_word1 = ql > 32                              # (TQ, 1)
+    hibit = one << ((jnp.maximum(ql, 1) - 1) % 32).astype(jnp.uint32)
+
+    pv0 = jnp.broadcast_to(pv0_init, (tq, tc))
+    pv1 = jnp.broadcast_to(pv1_init, (tq, tc))
+    mv0 = jnp.zeros((tq, tc), jnp.uint32)
+    mv1 = jnp.zeros((tq, tc), jnp.uint32)
+    score = jnp.broadcast_to(ql.astype(jnp.int32), (tq, tc))
+
+    def step(i, carry):
+        pv0, pv1, mv0, mv1, score = carry
+        t = cct_ref[pl.ds(i, 1), :]                       # (1, TC)
+        eq0 = jnp.zeros((tq, tc), jnp.uint32)
+        eq1 = jnp.zeros((tq, tc), jnp.uint32)
+        for j in range(min(L, 32)):
+            eq0 = eq0 | jnp.where(
+                qc[:, j : j + 1] == t, jnp.uint32(1 << j), 0
+            )
+        for j in range(32, L):
+            eq1 = eq1 | jnp.where(
+                qc[:, j : j + 1] == t, jnp.uint32(1 << (j - 32)), 0
+            )
+        xv0 = eq0 | mv0
+        xv1 = eq1 | mv1
+        # xh = (((eq & pv) + pv) ^ pv) | eq with carry across words
+        a0 = eq0 & pv0
+        s0 = a0 + pv0
+        c0 = _carry_out(a0, pv0, s0)
+        a1 = eq1 & pv1
+        s1 = a1 + c0 + pv1
+        # (the carry OUT of word 1 falls off the 64-bit pattern window)
+        xh0 = (s0 ^ pv0) | eq0
+        xh1 = (s1 ^ pv1) | eq1
+        ph0 = mv0 | ~(xh0 | pv0)
+        mh0 = pv0 & xh0
+        ph1 = mv1 | ~(xh1 | pv1)
+        mh1 = pv1 & xh1
+
+        active = i < cl                                   # (1, TC)
+        ph_hi = jnp.where(hi_word1, ph1, ph0)
+        mh_hi = jnp.where(hi_word1, mh1, mh0)
+        score = score + jnp.where(active & ((ph_hi & hibit) != 0), 1, 0)
+        score = score - jnp.where(active & ((mh_hi & hibit) != 0), 1, 0)
+
+        ph_c = ph0 >> jnp.uint32(31)
+        mh_c = mh0 >> jnp.uint32(31)
+        ph0 = (ph0 << one) | one
+        ph1 = (ph1 << one) | ph_c
+        mh1 = (mh1 << one) | mh_c
+        mh0 = mh0 << one
+        pv0 = jnp.where(active, mh0 | ~(xv0 | ph0), pv0)
+        pv1 = jnp.where(active, mh1 | ~(xv1 | ph1), pv1)
+        mv0 = jnp.where(active, ph0 & xv0, mv0)
+        mv1 = jnp.where(active, ph1 & xv1, mv1)
+        return (pv0, pv1, mv0, mv1, score)
+
+    pv0, pv1, mv0, mv1, score = lax.fori_loop(
+        0, L, step, (pv0, pv1, mv0, mv1, score)
+    )
+    out_ref[...] = jnp.where(
+        ql == 0, jnp.broadcast_to(cl.astype(jnp.int32), (tq, tc)), score
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tile_q", "tile_c", "interpret")
+)
+def _myers2_tiles_padded(qc, ql2, cct, cl2, *, tile_q, tile_c, interpret):
+    qp, l = qc.shape
+    cp = cct.shape[1]
+    grid = (qp // tile_q, cp // tile_c)
+    kernel = functools.partial(_myers2_tile_kernel, L=l)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((qp, cp), jnp.int32),
+        grid=grid,
+        in_specs=_pair_tile_specs(l, l, tile_q, tile_c),
+        out_specs=pl.BlockSpec(
+            (tile_q, tile_c), lambda i, j: (i, j), memory_space=_VMEM
+        ),
+        interpret=interpret,
+    )(qc, ql2, cct, cl2)
 
 
 # -- Jaro-Winkler, tiled over the pair matrix --------------------------------
@@ -363,6 +506,120 @@ def jaro_winkler_sim_tiles(qchars, qlen, cchars, clen, equal, *,
         boost_threshold=float(boost_threshold), max_prefix=int(max_prefix),
     )[:q, :c]
     return jnp.where(equal, 1.0, out)
+
+
+# -- gathered-candidate Myers (ANN rescoring layout) -------------------------
+
+
+def _myers_gathered_kernel(qc_ref, ql_ref, cclt_ref, cl_ref, out_ref, *,
+                           L: int):
+    """Per-query gathered-candidate Levenshtein tile.
+
+    Unlike the cross-product tiles, candidate c of query q here is a
+    SPECIFIC gathered corpus row (the ANN rescoring layout): text chars
+    differ per (q, c) pair, so the candidate axis rides the lanes and the
+    bit-parallel DP state is (TQ, TC) with per-pair text.
+
+    qc_ref:   (TQ, L)      query codepoints (pattern)
+    ql_ref:   (TQ, 1)      query lengths
+    cclt_ref: (TQ, L, TC)  candidate codepoints, char axis in sublanes
+    cl_ref:   (TQ, TC)     candidate lengths
+    out_ref:  (TQ, TC)     int32 distances
+    """
+    tq = qc_ref.shape[0]
+    tc = cl_ref.shape[1]
+    qc = qc_ref[...]                      # (TQ, L)
+    ql = ql_ref[...][:, :1]               # (TQ, 1)
+    cl = cl_ref[...]                      # (TQ, TC)
+
+    pv0, hibit = _myers_word_init(ql)     # (TQ, 1)
+
+    pv = jnp.broadcast_to(pv0, (tq, tc))
+    mv = jnp.zeros((tq, tc), jnp.uint32)
+    score = jnp.broadcast_to(ql.astype(jnp.int32), (tq, tc))
+
+    def step(i, carry):
+        pv, mv, score = carry
+        t = cclt_ref[:, pl.ds(i, 1), :].reshape(tq, tc)   # (TQ, TC)
+        eq = jnp.zeros((tq, tc), jnp.uint32)
+        for j in range(L):
+            eq = eq | jnp.where(qc[:, j : j + 1] == t, jnp.uint32(1 << j), 0)
+        return _myers_word_step(eq, pv, mv, score, i < cl, hibit)
+
+    pv, mv, score = lax.fori_loop(0, L, step, (pv, mv, score))
+    out_ref[...] = jnp.where(
+        ql == 0, cl.astype(jnp.int32), score
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tile_q", "tile_c", "interpret")
+)
+def _myers_gathered_padded(qc, ql2, cclt, cl2, *, tile_q, tile_c, interpret):
+    qp, l = qc.shape
+    cp = cclt.shape[2]
+    grid = (qp // tile_q, cp // tile_c)
+    kernel = functools.partial(_myers_gathered_kernel, L=l)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((qp, cp), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_q, l), lambda i, j: (i, 0),
+                         memory_space=_VMEM),
+            pl.BlockSpec((tile_q, 1), lambda i, j: (i, 0),
+                         memory_space=_VMEM),
+            pl.BlockSpec((tile_q, l, tile_c), lambda i, j: (i, 0, j),
+                         memory_space=_VMEM),
+            pl.BlockSpec((tile_q, tile_c), lambda i, j: (i, j),
+                         memory_space=_VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (tile_q, tile_c), lambda i, j: (i, j), memory_space=_VMEM
+        ),
+        interpret=interpret,
+    )(qc, ql2, cclt, cl2)
+
+
+def myers_distance_gathered(qchars, qlen, cchars, clen, *, interpret=None):
+    """Levenshtein distance for gathered candidates -> (Q, C) int32.
+
+    qchars: (Q, L) int32, L <= 32; qlen: (Q,)
+    cchars: (Q, C, L) int32 — candidate c of query q; clen: (Q, C)
+    """
+    q, l = qchars.shape
+    c = cchars.shape[1]
+    if l > 32:
+        raise ValueError(f"gathered Myers kernel needs L <= 32, got {l}")
+    if interpret is None:
+        interpret = _interpret()
+    tile_q = min(64, _round_up(max(q, 1), 8))
+    tile_c = 128  # candidate axis always pads to (at least) one full lane
+    qp = _round_up(max(q, 1), tile_q)
+    cp = _round_up(max(c, 1), tile_c)
+    qc = jnp.zeros((qp, l), jnp.int32).at[:q].set(qchars)
+    ql2 = jnp.zeros((qp, 1), jnp.int32).at[:q, 0].set(qlen)
+    cclt = jnp.zeros((qp, l, cp), jnp.int32).at[:q, :, :c].set(
+        jnp.transpose(cchars, (0, 2, 1))
+    )
+    cl2 = jnp.zeros((qp, cp), jnp.int32).at[:q, :c].set(clen)
+    out = _myers_gathered_padded(
+        qc, ql2, cclt, cl2, tile_q=tile_q, tile_c=tile_c, interpret=interpret
+    )
+    return out[:q, :c]
+
+
+def levenshtein_sim_gathered(qchars, qlen, cchars, clen, equal, *,
+                             interpret=None):
+    """Duke Levenshtein similarity for gathered candidates: (Q, C) f32."""
+    from .pairwise import levenshtein_sim_from_distance
+
+    dist = myers_distance_gathered(
+        qchars, qlen, cchars, clen, interpret=interpret
+    )
+    return levenshtein_sim_from_distance(
+        dist, qlen[:, None], clen, equal
+    )
 
 
 # -- set intersection (q-grams / token sets), tiled --------------------------

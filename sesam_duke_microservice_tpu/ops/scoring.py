@@ -104,10 +104,14 @@ def _tiled_combo_sim(tile_fn, q: int, c: int, vq: int, vc: int,
 
 
 def _property_sim(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict,
-                  expand=_pair_expand, pallas_ok: bool = True) -> tuple:
+                  expand=_pair_expand, pallas_ok: bool = True,
+                  gathered: bool = False) -> tuple:
     """Pair similarity for one property.
 
-    Returns (sim, combo_valid), both flat (Q*C*V*V,).
+    Returns (sim, combo_valid), both flat (Q*C*V*V,).  ``gathered`` marks
+    the aligned-candidate layout (cf tensors are (Q, C, V, ...) gathered
+    rows, not a corpus cross product) — it selects the gathered Pallas
+    branch and disables the cross-product tile branches.
     """
     hh1, hh2 = expand(qf["hash_hi"], cf["hash_hi"])
     hl1, hl2 = expand(qf["hash_lo"], cf["hash_lo"])
@@ -118,9 +122,35 @@ def _property_sim(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict,
     kind = spec.kind
     cmp = spec.comparator
     if (
+        gathered
+        and pallas_ok
+        and kind == F.CHARS
+        and not isinstance(cmp, C.JaroWinkler)
+        and qf["chars"].shape[1] == 1      # single value slot per side —
+        and cf["chars"].shape[2] == 1      # the dominant rescoring shape
+        and qf["chars"].shape[2] <= 32
+        and pk.pallas_enabled()
+    ):
+        # ANN rescoring path: candidate chars ride VMEM tiles with the
+        # candidate axis on lanes (per-pair text), instead of the flat
+        # XLA kernels over expanded (Q*C, L) HBM operands
+        q = qf["valid"].shape[0]
+        c = cf["valid"].shape[1]
+        sim = pk.levenshtein_sim_gathered(
+            qf["chars"][:, 0], qf["length"][:, 0],
+            cf["chars"][:, :, 0], cf["length"][:, :, 0],
+            equal.reshape(q, c),
+        ).reshape(-1)
+        return sim, combo_valid
+    if (
+        not gathered
+        and
         pallas_ok
         and kind == F.CHARS
-        and qf["chars"].shape[2] <= 32
+        # Levenshtein rides the 1-/2-word Myers kernels up to 64 chars;
+        # the Jaro-Winkler tile kernel is single-word bitmask only
+        and qf["chars"].shape[2]
+        <= (32 if isinstance(cmp, C.JaroWinkler) else 64)
         and pk.pallas_enabled()
     ):
         # Pallas tiled path: (TQ, TC) similarity tiles computed in VMEM
@@ -145,7 +175,8 @@ def _property_sim(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict,
         )
         return sim, combo_valid
     if (
-        pallas_ok
+        not gathered
+        and pallas_ok
         and kind in (F.GRAM_SET, F.TOKEN_SET)
         # width guard (mirrors the chars branch's L <= 32): the tile
         # kernel's inner loop unrolls O(G), so a huge DEVICE_MAX_GRAMS /
@@ -231,7 +262,8 @@ def _property_sim(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict,
 
 def _property_logit(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict,
                     q: int, c: int, expand=_pair_expand,
-                    pallas_ok: bool = True) -> jnp.ndarray:
+                    pallas_ok: bool = True,
+                    gathered: bool = False) -> jnp.ndarray:
     """Per-pair clamped log-odds contribution of one property: (Q, C) f32.
 
     Duke's PropertyImpl.compare map (core.records.Property.compare_probability):
@@ -240,7 +272,8 @@ def _property_logit(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict,
     combos is taken in probability space — the map is applied per combo, so
     semantics match the host engine even for low > 0.5 configs.
     """
-    sim, combo_valid = _property_sim(spec, qf, cf, expand, pallas_ok)
+    sim, combo_valid = _property_sim(spec, qf, cf, expand, pallas_ok,
+                                     gathered)
     prob = jnp.where(
         sim >= 0.5, (spec.high - 0.5) * sim * sim + 0.5, jnp.float32(spec.low)
     )
@@ -295,8 +328,10 @@ def build_gathered_pair_logits(plan: F.SchemaFeatures) -> Callable:
 
     The aligned-candidate variant of ``build_pair_logits`` used by the ANN
     rescoring stage: candidate c of query q is a specific gathered corpus
-    row, not a cross product.  Flat (non-Pallas) kernels — the pair count
-    here is Q*C, already pruned by retrieval.
+    row, not a cross product.  Levenshtein single-value properties ride
+    the gathered Pallas Myers kernel (candidate axis on lanes); other
+    kinds use the flat XLA kernels — the pair count here is Q*C, already
+    pruned by retrieval.
     """
     specs = list(plan.device_props)
 
@@ -307,7 +342,7 @@ def build_gathered_pair_logits(plan: F.SchemaFeatures) -> Callable:
         for spec in specs:
             total = total + _property_logit(
                 spec, qfeats[spec.name], cfeats[spec.name], q, c,
-                expand=_pair_expand_gathered, pallas_ok=False,
+                expand=_pair_expand_gathered, gathered=True,
             )
         return total
 

@@ -73,6 +73,39 @@ def test_myers_tiles_padding_sizes():
             assert got[i, j] == C.levenshtein_distance(strings[i], strings[j])
 
 
+def test_myers_two_word_tiles_vs_scalar_oracle():
+    """32 < L <= 64 routes to the two-word Hyyro kernel; exact vs the
+    scalar DP, including lengths straddling the word boundary."""
+    rng = np.random.default_rng(11)
+    lens = [0, 1, 31, 32, 33, 40, 47, 63, 64, 20, 50]
+    strings = [
+        "".join(chr(97 + rng.integers(5)) for _ in range(n)) for n in lens
+    ]
+    qc, ql = _encode(strings, max_chars=64)
+    cc, cl = _encode(strings[::-1], max_chars=64)
+    got = np.asarray(pk.myers_distance_tiles(qc, ql, cc, cl, interpret=True))
+    rev = strings[::-1]
+    for i, s1 in enumerate(strings):
+        for j, s2 in enumerate(rev):
+            assert got[i, j] == C.levenshtein_distance(s1, s2), (
+                len(s1), len(s2), got[i, j]
+            )
+
+
+def test_myers_two_word_matches_one_word_on_short_strings():
+    """The two-word kernel degenerates exactly to the one-word result when
+    every pattern fits a single word (cross-check of the carry plumbing)."""
+    qc, ql = _encode(QUERIES, max_chars=40)   # L=40 -> two-word kernel
+    cc, cl = _encode(CORPUS, max_chars=40)
+    got = np.asarray(pk.myers_distance_tiles(qc, ql, cc, cl, interpret=True))
+    qc1, ql1 = _encode(QUERIES, max_chars=32)
+    cc1, cl1 = _encode(CORPUS, max_chars=32)
+    want = np.asarray(
+        pk.myers_distance_tiles(qc1, ql1, cc1, cl1, interpret=True)
+    )
+    np.testing.assert_array_equal(got, want)
+
+
 def test_levenshtein_sim_tiles_matches_comparator():
     qc, ql = _encode(QUERIES)
     cc, cl = _encode(CORPUS)
@@ -152,6 +185,84 @@ def test_scoring_program_with_pallas_enabled(monkeypatch):
     np.testing.assert_allclose(pal[0], base[0], rtol=1e-5, atol=1e-5)
     np.testing.assert_array_equal(pal[1], base[1])
     np.testing.assert_array_equal(pal[2], base[2])
+
+
+def test_myers_gathered_vs_scalar_oracle():
+    """The gathered-candidate (ANN rescoring layout) kernel: candidate c of
+    query q is a specific row, exact vs the scalar DP."""
+    rng = np.random.default_rng(5)
+    q, c, L = 6, 7, 16
+    qs = ["kitten", "saturday", "", "abc", "a" * 16, "flaw"]
+    cands = [
+        ["".join(chr(97 + rng.integers(5))
+                 for _ in range(rng.integers(0, L + 1)))
+         for _ in range(c)]
+        for _ in range(q)
+    ]
+    qc, ql = _encode(qs, max_chars=L)
+    cc = np.zeros((q, c, L), np.int32)
+    cl = np.zeros((q, c), np.int32)
+    for i in range(q):
+        ch, ln = _encode(cands[i], max_chars=L)
+        cc[i] = np.asarray(ch)
+        cl[i] = np.asarray(ln)
+    got = np.asarray(pk.myers_distance_gathered(
+        qc, ql, jnp.asarray(cc), jnp.asarray(cl), interpret=True
+    ))
+    for i in range(q):
+        for j in range(c):
+            assert got[i, j] == C.levenshtein_distance(qs[i], cands[i][j]), (
+                qs[i], cands[i][j]
+            )
+
+
+def test_gathered_pair_logits_pallas_wiring(monkeypatch):
+    """build_gathered_pair_logits routes single-value Levenshtein through
+    the gathered kernel and agrees with the flat path."""
+    import jax
+
+    from sesam_duke_microservice_tpu.core.config import DukeSchema
+    from sesam_duke_microservice_tpu.core.records import (
+        ID_PROPERTY_NAME,
+        Property,
+        Record,
+    )
+    from sesam_duke_microservice_tpu.ops import features as F
+    from sesam_duke_microservice_tpu.ops import scoring as S
+
+    schema = DukeSchema(
+        threshold=0.8, maybe_threshold=None,
+        properties=[
+            Property(ID_PROPERTY_NAME, id_property=True),
+            Property("NAME", C.Levenshtein(), 0.3, 0.88),
+        ],
+        data_sources=[],
+    )
+    plan = F.SchemaFeatures.plan(schema)
+    names = ["oslo", "bergen", "bergn", "trondheim", "stavanger", "tromso"]
+    records = []
+    for i, nm in enumerate(names):
+        r = Record()
+        r.add_value(ID_PROPERTY_NAME, f"d__{i}")
+        r.add_value("NAME", nm)
+        records.append(r)
+    feats = F.extract_batch(plan, records)
+    n = len(records)
+    c = 4
+    rng = np.random.default_rng(0)
+    rows = rng.integers(0, n, size=(n, c))
+    qf = {p: {k: jnp.asarray(a) for k, a in t.items()}
+          for p, t in feats.items()}
+    cf = {p: {k: jnp.asarray(a[rows.reshape(-1)]).reshape(
+              (n, c) + a.shape[1:])
+              for k, a in t.items()}
+          for p, t in feats.items()}
+
+    monkeypatch.setenv("DUKE_TPU_PALLAS", "0")
+    base = np.asarray(S.build_gathered_pair_logits(plan)(qf, cf))
+    monkeypatch.setenv("DUKE_TPU_PALLAS", "1")
+    pal = np.asarray(S.build_gathered_pair_logits(plan)(qf, cf))
+    np.testing.assert_allclose(pal, base, rtol=1e-5, atol=1e-5)
 
 
 def _encode_sets(value_lists, slots=12):
