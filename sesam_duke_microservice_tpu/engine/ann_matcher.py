@@ -88,17 +88,13 @@ class _AnnScorerCache(_ScorerCache):
     """Caches jitted ANN scorers per (top_c, group_filtering) and runs the
     recall-escalation loop."""
 
-    def _scorer(self, top_c: int, group_filtering: bool,
-                from_rows: bool = False):
+    def _build(self, top_c: int, group_filtering: bool, from_rows: bool):
         from ..ops import scoring as S
 
-        key = (top_c, group_filtering, from_rows)
-        if key not in self._scorers:
-            self._scorers[key] = S.build_ann_scorer(
-                self.index.plan, chunk=_CHUNK, top_c=top_c,
-                group_filtering=group_filtering, queries_from_rows=from_rows,
-            )
-        return self._scorers[key]
+        return S.build_ann_scorer(
+            self.index.plan, chunk=_CHUNK, top_c=top_c,
+            group_filtering=group_filtering, queries_from_rows=from_rows,
+        )
 
     def _lower_one(self, row_feats, cap: int, bucket: int,
                    group_filtering: bool):
@@ -114,7 +110,9 @@ class _AnnScorerCache(_ScorerCache):
         corpus_emb = jax.ShapeDtypeStruct((cap,) + emb.shape[1:], emb.dtype)
         q_emb = jax.ShapeDtypeStruct((), np.float32)
         c = min(self.index.initial_top_c, cap)
-        scorer = self._scorer(c, group_filtering, True)
+        # private jit instance via the shared builder — see
+        # _ScorerCache._lower_one
+        scorer = self._build(c, group_filtering, True)
         scorer.lower(
             q_emb, {}, corpus_emb, cfeats, mb, mb2, mi, qg, qr, ml
         ).compile()

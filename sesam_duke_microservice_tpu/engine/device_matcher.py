@@ -700,6 +700,13 @@ class _ScorerCache:
         no-ops while the shape fingerprint is unchanged."""
         if os.environ.get("DEVICE_PREWARM", "1") == "0":
             return
+        # the warm compiles land in the persistent XLA cache (private jit
+        # instances; the live scorer reads the cache on first contact) —
+        # make sure it is actually on, whatever the embedding context
+        from ..utils.jit_cache import enable_persistent_cache
+
+        if enable_persistent_cache() is None:
+            return  # no cache -> warming could never help the live scorer
         cap = max(self.index.corpus.capacity, _CHUNK)
         key = (
             cap,
@@ -743,11 +750,18 @@ class _ScorerCache:
 
     def _lower_one(self, row_feats, cap: int, bucket: int,
                    group_filtering: bool):
+        from ..ops import scoring as S
+
         cfeats, (mb, mb2, mi, qg, qr, ml) = self._lower_args(
             row_feats, cap, bucket
         )
         k = min(_INITIAL_TOP_K, cap)
-        scorer = self._scorer(k, group_filtering, True)
+        # a PRIVATE jit instance: tracing the live scorer object from this
+        # thread while the main thread traces it too corrupts shared pjit
+        # state; _build is the single builder both paths share, so the HLO
+        # is identical and the XLA compile lands in the persistent cache
+        # the live scorer reads
+        scorer = self._build(k, group_filtering, True)
         scorer.lower({}, cfeats, mb, mb2, mi, qg, qr, ml).compile()
 
     def _prewarm(self, group_filtering: bool, key) -> None:
@@ -764,16 +778,24 @@ class _ScorerCache:
         except Exception:  # pragma: no cover - warm failures are harmless
             logger.exception("scorer pre-warm failed (scoring unaffected)")
 
-    def _scorer(self, top_k: int, group_filtering: bool,
-                from_rows: bool = False):
+    def _build(self, top_k: int, group_filtering: bool, from_rows: bool):
+        """The ONE scorer builder — both the live cached path (_scorer) and
+        the prewarm's private instances (_lower_one) go through it, so the
+        two can never drift onto different HLO (which would silently turn
+        pre-warming into cache-missing busywork)."""
         from ..ops import scoring as S
 
+        return S.build_corpus_scorer(
+            self.index.plan, chunk=_CHUNK, top_k=top_k,
+            group_filtering=group_filtering, queries_from_rows=from_rows,
+        )
+
+    def _scorer(self, top_k: int, group_filtering: bool,
+                from_rows: bool = False):
         key = (top_k, group_filtering, from_rows)
         if key not in self._scorers:
-            self._scorers[key] = S.build_corpus_scorer(
-                self.index.plan, chunk=_CHUNK, top_k=top_k,
-                group_filtering=group_filtering, queries_from_rows=from_rows,
-            )
+            self._scorers[key] = self._build(top_k, group_filtering,
+                                             from_rows)
         return self._scorers[key]
 
     def _min_logit(self) -> float:

@@ -418,13 +418,10 @@ class TestDeviceCorpus:
         cache._warm_thread.join(timeout=120)
         assert not cache._warm_thread.is_alive()
         # the warm must have actually compiled (a silently-failing prewarm
-        # would leave the feature dead while scoring still works) and built
-        # the from_rows scorer for the initial K
-        from sesam_duke_microservice_tpu.engine import device_matcher as dm
-
+        # would leave the feature dead while scoring still works); it
+        # compiles PRIVATE jit instances (shared-instance tracing races the
+        # main thread), so success is observed via the compile counter
         assert cache._warm_compiled > 0
-        k = min(dm._INITIAL_TOP_K, index.corpus.capacity)
-        assert (k, False, True) in cache._scorers
 
         monkeypatch.setenv("DEVICE_PREWARM", "0")
         index2 = DeviceIndex(schema)
