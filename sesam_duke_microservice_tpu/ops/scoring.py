@@ -443,6 +443,7 @@ def scan_topk(
     top_k: int,
     group_filtering: bool,
     row_offset=0,
+    init=None,
 ):
     """The blockwise scan core: scores Q queries against a (local) corpus.
 
@@ -450,15 +451,22 @@ def scan_topk(
     device; ``shard_index * shard_capacity`` inside ``shard_map`` (see
     parallel.sharded), so self-exclusion via ``query_row`` and the returned
     ``top_index`` stay global.  Traced (non-static) offsets are fine.
+
+    ``init`` seeds the running (top_logit, top_index, count) carry — the
+    ring scorer (parallel.ring) threads a query block's accumulated top-K
+    through successive corpus shards with it.
     """
     first = next(iter(qfeats.values()))
     q = first["valid"].shape[0]
     cap = corpus_valid.shape[0]
     nchunks = cap // chunk
 
-    init_logit = jnp.full((q, top_k), NEG_INF, jnp.float32)
-    init_index = jnp.full((q, top_k), -1, jnp.int32)
-    init_count = jnp.zeros((q,), jnp.int32)
+    if init is not None:
+        init_logit, init_index, init_count = init
+    else:
+        init_logit = jnp.full((q, top_k), NEG_INF, jnp.float32)
+        init_index = jnp.full((q, top_k), -1, jnp.int32)
+        init_count = jnp.zeros((q,), jnp.int32)
 
     def body(carry, ci):
         top_logit, top_index, count = carry

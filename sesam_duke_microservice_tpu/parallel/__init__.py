@@ -2,19 +2,30 @@
 
 The reference has no distributed backend of any kind (SURVEY.md section 2
 component #16 — one JVM, one thread pool).  This package is its TPU-native
-replacement: the corpus feature tensors are sharded across a
-``jax.sharding.Mesh``, every device scores the replicated query block
-against its local shard keeping a local top-K, and one ``all_gather`` over
-the mesh axis merges the per-shard top-Ks into the global result — the
-ring-structured candidate merge sketched in SURVEY.md section 5.7.
+replacement, with two layouts for the corpus-axis scale-out sketched in
+SURVEY.md section 5.7:
+
+  * ``sharded`` / ``ann_sharded`` — corpus record-axis sharded across a
+    ``jax.sharding.Mesh``, queries replicated; every device scores the
+    block against its local shard and one ``all_gather`` merges the
+    per-shard top-Ks.  The default for service-sized query batches.
+  * ``ring`` — queries sharded too; blocks rotate around the mesh over
+    ``ppermute`` carrying their running top-K (the ring-attention pattern
+    on the corpus axis).  The regime for large query blocks, where
+    replication would dominate HBM/ICI.
+
+``multihost`` extends either mesh across hosts (jax.distributed over DCN).
 """
 
 from .ann_sharded import build_sharded_ann_scorer
 from .multihost import global_corpus_mesh, initialize as initialize_distributed
+from .ring import RingQueryPlacer, build_ring_scorer
 from .sharded import ShardedCorpus, build_sharded_scorer, corpus_mesh
 
 __all__ = [
+    "RingQueryPlacer",
     "ShardedCorpus",
+    "build_ring_scorer",
     "build_sharded_ann_scorer",
     "build_sharded_scorer",
     "corpus_mesh",

@@ -1,0 +1,133 @@
+"""Ring-parallel corpus scoring: rotating query blocks over ppermute.
+
+The ring-attention pattern applied to this workload's scaling axis
+(SURVEY.md section 5.7 — "ring-structured pass of query blocks around the
+mesh").  Where ``parallel.sharded`` replicates the whole query block to
+every device and merges per-shard top-Ks with one ``all_gather``, the ring
+scorer shards BOTH axes:
+
+  * corpus feature tensors: record-axis sharded (as in parallel.sharded);
+  * query block: ALSO sharded — each device starts with Q/D queries;
+  * D ring steps: every device scores its resident query block against its
+    local corpus shard, threading the block's accumulated global top-K
+    through the scan (``ops.scoring.scan_topk(init=...)``), then
+    ``ppermute``s the block + its carry to the next device.  After D hops
+    each block has visited every shard and is back home with its global
+    top-K — no all_gather, no replication.
+
+Communication per step is O((Q/D) * (features + K)) point-to-point over
+ICI — independent of corpus size and of D — while per-device compute and
+query memory drop by 1/D versus the replicated layout.  The replicated
+all_gather layout is the right default for service batches (queries are
+small); the ring is the regime for *large* query blocks (bulk re-matching,
+backfills, corpus-vs-corpus joins) where replicating Q feature tensors to
+every device would dominate HBM or ICI.
+
+Exactness: each (query, corpus-row) pair is scored by exactly one device
+at exactly one step, and the carry merge is the same running-top-K the
+single-device scan uses — results equal the single-device scorer
+(tests/test_ring.py pins this on the virtual mesh).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import scoring as S
+from .sharded import SHARD_AXIS, LeadingAxisPlacer
+
+
+def build_ring_scorer(
+    plan,
+    mesh: Mesh,
+    *,
+    chunk: int = 512,
+    top_k: int = 64,
+    group_filtering: bool = False,
+) -> Callable:
+    """Ring variant of ``parallel.sharded.build_sharded_scorer``.
+
+    Signature matches the sharded scorer, but ``qfeats``, ``query_group``
+    and ``query_row`` must be sharded on the query (leading) axis with the
+    total query count divisible by ``mesh.size``
+    (``RingQueryPlacer.place`` does both), and the outputs come back
+    query-axis sharded the same way.
+    """
+    pair_logits = S.build_pair_logits(plan)
+    ndev = mesh.size
+    perm = [(i, (i + 1) % ndev) for i in range(ndev)]
+
+    shard_spec = P(SHARD_AXIS)
+    repl = P()
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(shard_spec, shard_spec, shard_spec, shard_spec,
+                  shard_spec, shard_spec, shard_spec, repl),
+        out_specs=(shard_spec, shard_spec, shard_spec),
+        check_vma=False,
+    )
+    def score_ring(qfeats, corpus_feats, corpus_valid, corpus_deleted,
+                   corpus_group, query_group, query_row, min_logit):
+        local_cap = corpus_valid.shape[0]
+        shard = lax.axis_index(SHARD_AXIS)
+        row_offset = shard.astype(jnp.int32) * jnp.int32(local_cap)
+
+        first = next(iter(qfeats.values()))
+        qlocal = first["valid"].shape[0]
+        carry_logit = jnp.full((qlocal, top_k), S.NEG_INF, jnp.float32)
+        carry_index = jnp.full((qlocal, top_k), -1, jnp.int32)
+        carry_count = jnp.zeros((qlocal,), jnp.int32)
+
+        rotate = lambda a: lax.ppermute(a, SHARD_AXIS, perm)
+        qf, qg, qr = qfeats, query_group, query_row
+        tl, ti, cnt = carry_logit, carry_index, carry_count
+        # D is small and static: unroll the ring so each step's ppermute
+        # can overlap the next step's compute under XLA's scheduler
+        for step in range(ndev):
+            tl, ti, cnt = S.scan_topk(
+                pair_logits, qf, corpus_feats, corpus_valid,
+                corpus_deleted, corpus_group, qg, qr, min_logit,
+                chunk=chunk, top_k=top_k, group_filtering=group_filtering,
+                row_offset=row_offset, init=(tl, ti, cnt),
+            )
+            if step + 1 < ndev:
+                qf = jax.tree_util.tree_map(rotate, qf)
+                qg, qr = rotate(qg), rotate(qr)
+            # the carry rotates on EVERY hop (the last one brings each
+            # block's top-K home); the query payload — the big per-hop
+            # transfer — skips the final dead rotation
+            tl, ti, cnt = rotate(tl), rotate(ti), rotate(cnt)
+        return tl, ti, cnt
+
+    return jax.jit(score_ring)
+
+
+class RingQueryPlacer(LeadingAxisPlacer):
+    """Places query-side arrays onto the mesh, query-axis sharded.
+
+    Pads the query count up to a multiple of ``mesh.size`` (padding rows
+    get ``query_row=-1`` / ``query_group=-2`` and score against nothing the
+    caller keeps).
+    """
+
+    def __init__(self, mesh: Mesh):
+        super().__init__(mesh, mesh.size)
+
+    def place(self, qfeats, query_group: np.ndarray,
+              query_row: np.ndarray):
+        n = query_group.shape[0]
+        cap = self.padded_capacity(n)
+        feats = self._put_tree(qfeats, n, cap)
+        group = self._put(query_group, n, cap, -2)
+        row = self._put(query_row, n, cap, -1)
+        return feats, group, row

@@ -104,18 +104,18 @@ def build_sharded_scorer(
     return jax.jit(score_shard)
 
 
-class ShardedCorpus:
-    """Places host corpus arrays onto the mesh, record-axis sharded.
+class LeadingAxisPlacer:
+    """Shared placement machinery: pad the leading axis to ``granule``
+    multiples and device_put with leading-axis sharding over the mesh.
 
-    The capacity is padded up to a multiple of ``mesh.size * chunk`` so
-    every shard gets the same number of whole scan chunks (padding rows are
-    ``valid=False`` and masked out by the scorer).
+    Base for ``ShardedCorpus`` (record axis, granule = mesh.size * chunk)
+    and ``parallel.ring.RingQueryPlacer`` (query axis, granule =
+    mesh.size) — one copy of the padding/sharding conventions.
     """
 
-    def __init__(self, mesh: Mesh, *, chunk: int = 512):
+    def __init__(self, mesh: Mesh, granule: int):
         self.mesh = mesh
-        self.chunk = chunk
-        self.granule = mesh.size * chunk
+        self.granule = granule
         self._sharding_cache: Dict[int, NamedSharding] = {}
 
     def padded_capacity(self, size: int) -> int:
@@ -128,6 +128,36 @@ class ShardedCorpus:
             self._sharding_cache[ndim] = NamedSharding(self.mesh, spec)
         return self._sharding_cache[ndim]
 
+    def _put(self, arr: np.ndarray, size: int, cap: int, fill=0):
+        if arr.shape[0] != cap:
+            out = np.full((cap,) + arr.shape[1:], fill, dtype=arr.dtype)
+            out[:size] = arr[:size]
+            arr = out
+        return jax.device_put(arr, self._sharding(arr.ndim))
+
+    def _put_tree(self, feats: Dict[str, Dict[str, np.ndarray]],
+                  size: int, cap: int):
+        return {
+            prop: {
+                name: self._put(arr, size, cap)
+                for name, arr in tensors.items()
+            }
+            for prop, tensors in feats.items()
+        }
+
+
+class ShardedCorpus(LeadingAxisPlacer):
+    """Places host corpus arrays onto the mesh, record-axis sharded.
+
+    The capacity is padded up to a multiple of ``mesh.size * chunk`` so
+    every shard gets the same number of whole scan chunks (padding rows are
+    ``valid=False`` and masked out by the scorer).
+    """
+
+    def __init__(self, mesh: Mesh, *, chunk: int = 512):
+        super().__init__(mesh, mesh.size * chunk)
+        self.chunk = chunk
+
     def place(self, feats: Dict[str, Dict[str, np.ndarray]],
               row_valid: np.ndarray, row_deleted: np.ndarray,
               row_group: np.ndarray):
@@ -137,22 +167,8 @@ class ShardedCorpus:
         """
         size = row_valid.shape[0]
         cap = self.padded_capacity(size)
-
-        def pad(arr: np.ndarray, fill=0) -> np.ndarray:
-            if arr.shape[0] == cap:
-                return arr
-            out = np.full((cap,) + arr.shape[1:], fill, dtype=arr.dtype)
-            out[:size] = arr[:size]
-            return out
-
-        dev_feats = {
-            prop: {
-                name: jax.device_put(pad(arr), self._sharding(arr.ndim))
-                for name, arr in tensors.items()
-            }
-            for prop, tensors in feats.items()
-        }
-        valid = jax.device_put(pad(row_valid, False), self._sharding(1))
-        deleted = jax.device_put(pad(row_deleted, False), self._sharding(1))
-        group = jax.device_put(pad(row_group, -1), self._sharding(1))
+        dev_feats = self._put_tree(feats, size, cap)
+        valid = self._put(row_valid, size, cap, False)
+        deleted = self._put(row_deleted, size, cap, False)
+        group = self._put(row_group, size, cap, -1)
         return dev_feats, valid, deleted, group

@@ -1,0 +1,126 @@
+"""Ring scorer vs single-device scorer on the virtual 8-device CPU mesh.
+
+Contract (parallel/ring.py): with queries AND corpus sharded, D ppermute
+hops return each query block to its home device carrying the same global
+top-K the single-device scorer computes over the concatenated corpus.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sesam_duke_microservice_tpu.ops import features as F
+from sesam_duke_microservice_tpu.ops import scoring as S
+from sesam_duke_microservice_tpu.parallel import (
+    RingQueryPlacer,
+    ShardedCorpus,
+    build_ring_scorer,
+    corpus_mesh,
+)
+
+from test_parallel import CHUNK, TOP_K, build_inputs
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert jax.device_count() == 8, "conftest must force 8 virtual CPU devices"
+    return corpus_mesh()
+
+
+def _run_ring(mesh, n_corpus, n_queries, group=None, query_group_np=None,
+              group_filtering=False):
+    (plan, feats, valid, deleted, grp,
+     qfeats, query_row, query_group) = build_inputs(n_corpus, n_queries)
+    if group is not None:
+        grp = group
+    if query_group_np is not None:
+        query_group = query_group_np
+
+    placer = ShardedCorpus(mesh, chunk=CHUNK)
+    sfeats, svalid, sdeleted, sgroup = placer.place(
+        feats, valid, deleted, grp
+    )
+    qplacer = RingQueryPlacer(mesh)
+    rqfeats, rqgroup, rqrow = qplacer.place(qfeats, query_group, query_row)
+    ring = build_ring_scorer(
+        plan, mesh, chunk=CHUNK, top_k=TOP_K,
+        group_filtering=group_filtering,
+    )
+    r_logit, r_index, r_count = ring(
+        rqfeats, sfeats, svalid, sdeleted, sgroup, rqgroup, rqrow,
+        jnp.float32(-5.0),
+    )
+    # single-device reference over the same padded corpus
+    cap = placer.padded_capacity(n_corpus)
+
+    def pad(a, fill=0):
+        out = np.full((cap,) + a.shape[1:], fill, dtype=a.dtype)
+        out[:n_corpus] = a
+        return out
+
+    single = S.build_corpus_scorer(
+        plan, chunk=CHUNK, top_k=TOP_K, group_filtering=group_filtering
+    )
+    qf = {p: {k: jnp.asarray(a) for k, a in t.items()}
+          for p, t in qfeats.items()}
+    d_logit, d_index, d_count = single(
+        qf,
+        {p: {k: jnp.asarray(pad(a)) for k, a in t.items()}
+         for p, t in feats.items()},
+        jnp.asarray(pad(valid, False)), jnp.asarray(pad(deleted, False)),
+        jnp.asarray(pad(grp, -1)),
+        jnp.asarray(query_group), jnp.asarray(query_row),
+        jnp.float32(-5.0),
+    )
+    n = n_queries
+    return (np.asarray(r_logit)[:n], np.asarray(r_index)[:n],
+            np.asarray(r_count)[:n], np.asarray(d_logit),
+            np.asarray(d_index), np.asarray(d_count))
+
+
+def test_ring_matches_single_device(mesh):
+    n = 8 * CHUNK * 2   # 2 chunks per shard
+    n_queries = 16      # 2 queries per device
+    (r_log, r_idx, r_cnt, d_log, d_idx, d_cnt) = _run_ring(
+        mesh, n, n_queries
+    )
+    np.testing.assert_allclose(r_log, d_log, rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(r_cnt, d_cnt)
+    # tie rows can order differently across hop boundaries; rows scoring
+    # strictly above the K-th score are unambiguous and must agree
+    for qi in range(n_queries):
+        kth = d_log[qi, -1]
+        strict_d = {int(r) for r, v in zip(d_idx[qi], d_log[qi])
+                    if v > kth + 1e-4}
+        strict_r = {int(r) for r, v in zip(r_idx[qi], r_log[qi])
+                    if v > kth + 1e-4}
+        assert strict_d == strict_r
+
+
+def test_ring_group_filtering_and_self_exclusion(mesh):
+    n = 8 * CHUNK
+    n_queries = 16
+    group = np.asarray([1 + (i % 2) for i in range(n)], dtype=np.int32)
+    qgroup = np.asarray([1 + (i % 2) for i in range(n_queries)],
+                        dtype=np.int32)
+    (r_log, r_idx, _, d_log, _, _) = _run_ring(
+        mesh, n, n_queries, group=group, query_group_np=qgroup,
+        group_filtering=True,
+    )
+    np.testing.assert_allclose(r_log, d_log, rtol=1e-5, atol=1e-5)
+    for qi in range(n_queries):
+        live = r_idx[qi][r_log[qi] > S.NEG_INF / 2]
+        assert qi not in live                       # self-pair exclusion
+        for row in live:
+            assert group[row] != qgroup[qi]         # group exclusion
+
+
+def test_ring_query_padding(mesh):
+    # query counts not divisible by the mesh size pad cleanly
+    n = 8 * CHUNK
+    n_queries = 11
+    (r_log, _, r_cnt, d_log, _, d_cnt) = _run_ring(mesh, n, n_queries)
+    np.testing.assert_allclose(r_log, d_log, rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(r_cnt, d_cnt)
