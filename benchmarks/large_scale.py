@@ -70,6 +70,7 @@ def run_sharded(args) -> None:
         return
 
     import jax.numpy as jnp
+    import ml_dtypes
     import numpy as np
 
     from f1_stresstest import generate, stresstest_schema, to_records
@@ -107,7 +108,12 @@ def run_sharded(args) -> None:
         for r in records:
             r._values["ID"] = [f"s{seed}__{r.record_id}"]
         feats = F.extract_batch(plan, records)
-        feats[E.ANN_PROP] = {E.ANN_TENSOR: enc.encode_batch(records)}
+        # bf16 embedding storage, matching AnnIndex._extract
+        feats[E.ANN_PROP] = {
+            E.ANN_TENSOR: enc.encode_batch(records).astype(
+                ml_dtypes.bfloat16
+            )
+        }
         slabs.append(feats)
         remaining -= n
         seed += 1
