@@ -70,7 +70,6 @@ def run_sharded(args) -> None:
         return
 
     import jax.numpy as jnp
-    import ml_dtypes
     import numpy as np
 
     from f1_stresstest import generate, stresstest_schema, to_records
@@ -108,12 +107,8 @@ def run_sharded(args) -> None:
         for r in records:
             r._values["ID"] = [f"s{seed}__{r.record_id}"]
         feats = F.extract_batch(plan, records)
-        # bf16 embedding storage, matching AnnIndex._extract
-        feats[E.ANN_PROP] = {
-            E.ANN_TENSOR: enc.encode_batch(records).astype(
-                ml_dtypes.bfloat16
-            )
-        }
+        # the production corpus storage dtype (E.STORAGE_DTYPE)
+        feats[E.ANN_PROP] = {E.ANN_TENSOR: enc.encode_corpus(records)}
         slabs.append(feats)
         remaining -= n
         seed += 1

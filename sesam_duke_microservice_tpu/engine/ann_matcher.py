@@ -30,7 +30,6 @@ import logging
 import os
 from typing import Optional, Sequence
 
-import ml_dtypes
 import numpy as np
 
 from ..core.config import DukeSchema, MatchTunables
@@ -70,19 +69,16 @@ class AnnIndex(DeviceIndex):
         self.dim = dim
         self.initial_top_c = initial_top_c
         self.encoder = E.RecordEncoder(schema, dim)
+        # rides in the snapshot fingerprint: a pre-bf16 (f32) snapshot must
+        # be rejected at load, or the first append would silently pin the
+        # corpus to the old dtype and forfeit the HBM/bandwidth win
+        self.emb_storage = str(np.dtype(E.STORAGE_DTYPE))
 
     def _extract(self, records: Sequence[Record], plan=None):
         feats = super()._extract(records, plan)
-        # the corpus embedding matrix is stored bf16: retrieval casts to
-        # bf16 for the MXU matmul anyway (ops.encoder.retrieval_scan), so
-        # f32 storage bought nothing while doubling the dominant HBM/row
-        # term and the retrieval scan's memory traffic.  Ranking is
-        # approximate blocking; the retrieved candidates are rescored with
-        # the exact kernels either way.
+        # E.STORAGE_DTYPE (bf16) — see ops.encoder for the rationale
         feats[E.ANN_PROP] = {
-            E.ANN_TENSOR: self.encoder.encode_batch(records).astype(
-                ml_dtypes.bfloat16
-            )
+            E.ANN_TENSOR: self.encoder.encode_corpus(records)
         }
         return feats
 

@@ -517,19 +517,30 @@ class DeviceIndex(CandidateIndex):
             os.environ.get("DEVICE_MAX_CHARS", ""),
             os.environ.get("DEVICE_MAX_GRAMS", ""),
             os.environ.get("DEVICE_MAX_TOKENS", ""),
-            getattr(self, "dim", None),   # ANN embedding width
+            getattr(self, "dim", None),          # ANN embedding width
+            getattr(self, "emb_storage", None),  # ANN embedding dtype
         ))
         return hashlib.sha256(spec.encode()).hexdigest()
 
     def snapshot_save(self, path: str) -> None:
+        import ml_dtypes
+
         corpus = self.corpus
         if corpus.size == 0:
             return
-        flat = {
-            f"feat\x1f{prop}\x1f{name}": arr[: corpus.size]
-            for prop, tensors in corpus.feats.items()
-            for name, arr in tensors.items()
-        }
+        # np.savez cannot round-trip ml_dtypes (bf16 loads back as raw
+        # void); such tensors are saved as uint16 bit views and listed in
+        # __bf16_keys so load can view them back
+        flat = {}
+        bf16_keys = []
+        for prop, tensors in corpus.feats.items():
+            for name, arr in tensors.items():
+                key = f"feat\x1f{prop}\x1f{name}"
+                a = arr[: corpus.size]
+                if a.dtype == ml_dtypes.bfloat16:
+                    bf16_keys.append(key)
+                    a = a.view(np.uint16)
+                flat[key] = a
         # write-then-rename: a SIGKILL mid-save must never leave a truncated
         # snapshot (np.load would fail and silently force a full replay)
         tmp = f"{path}.tmp.{os.getpid()}"
@@ -538,6 +549,7 @@ class DeviceIndex(CandidateIndex):
                 tmp,
                 __fingerprint=np.array(self._snapshot_fingerprint()),
                 __content=np.array(_records_content_hash(self.records)),
+                __bf16_keys=np.array(bf16_keys, dtype=str),
                 __value_slots=np.array(
                     [s.v for s in self.plan.device_props], dtype=np.int64
                 ),
@@ -604,12 +616,21 @@ class DeviceIndex(CandidateIndex):
                 }
                 if live != set(records_by_id):
                     return False
+                bf16_keys = (
+                    {str(k) for k in data["__bf16_keys"]}
+                    if "__bf16_keys" in data.files else set()
+                )
                 feats: Dict[str, Dict[str, np.ndarray]] = {}
                 for key in data.files:
                     if not key.startswith("feat\x1f"):
                         continue
                     _, prop, name = key.split("\x1f", 2)
-                    feats.setdefault(prop, {})[name] = data[key]
+                    arr = data[key]
+                    if key in bf16_keys:
+                        import ml_dtypes
+
+                        arr = arr.view(ml_dtypes.bfloat16)
+                    feats.setdefault(prop, {})[name] = arr
         except Exception:
             logger.exception("snapshot load failed; replaying from store")
             return False

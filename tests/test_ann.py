@@ -177,3 +177,57 @@ class TestAnnMutation:
         cands = index.find_candidate_matches(records[0])
         ids = {r.record_id for r in cands}
         assert "b" in ids and "a" not in ids
+
+
+class TestAnnSnapshot:
+    def test_bf16_embedding_snapshot_roundtrip(self, tmp_path):
+        """np.savez cannot represent bf16 natively; the snapshot stores a
+        uint16 bit view and must come back as bf16 — a corrupted dtype
+        would crash the first post-restart ingest instead of replaying."""
+        schema = dedup_schema()
+        records = random_records(20, seed=3)
+        ann, index, proc = run_ann(schema, [records])
+        assert index.corpus.feats[E.ANN_PROP][E.ANN_TENSOR].dtype == \
+            np.dtype(E.STORAGE_DTYPE)
+        path = str(tmp_path / "snap.npz")
+        index.snapshot_save(path)
+
+        index2 = AnnIndex(schema, tunables=MatchTunables())
+        ok = index2.snapshot_load(
+            path, {r.record_id: r for r in records}
+        )
+        assert ok, "snapshot must load"
+        emb = index2.corpus.feats[E.ANN_PROP][E.ANN_TENSOR]
+        assert emb.dtype == np.dtype(E.STORAGE_DTYPE)
+        np.testing.assert_array_equal(
+            emb[: index2.corpus.size].view(np.uint16),
+            index.corpus.feats[E.ANN_PROP][E.ANN_TENSOR][
+                : index.corpus.size].view(np.uint16),
+        )
+        # and the restored corpus still scores: a near-duplicate probe
+        # matches records through the loaded embedding matrix
+        proc2 = AnnProcessor(schema, index2)
+        log = EventLog()
+        proc2.add_match_listener(log)
+        probe = make_record("probe", name=records[0].get_value("name"),
+                            city=records[0].get_value("city"),
+                            amount=records[0].get_value("amount"))
+        proc2.deduplicate([probe])
+        assert ("match", "probe", "r0") in {e[:3] for e in log.match_set()}
+
+    def test_stale_dtype_snapshot_rejected(self, tmp_path, monkeypatch):
+        """A snapshot written under a different embedding storage dtype
+        (e.g. a pre-bf16 f32 deployment) must be rejected — accepting it
+        would silently pin the corpus to the old dtype."""
+        schema = dedup_schema()
+        records = random_records(10, seed=4)
+        ann, index, proc = run_ann(schema, [records])
+        # forge the old deployment: fingerprint computed with f32 storage
+        monkeypatch.setattr(index, "emb_storage", "float32")
+        path = str(tmp_path / "snap.npz")
+        index.snapshot_save(path)
+
+        index2 = AnnIndex(schema, tunables=MatchTunables())
+        assert index2.snapshot_load(
+            path, {r.record_id: r for r in records}
+        ) is False

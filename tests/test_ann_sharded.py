@@ -7,7 +7,6 @@ sharded result with an identical exact logit; counts/self-exclusion/group
 filtering must carry over.
 """
 
-import ml_dtypes
 import numpy as np
 import pytest
 
@@ -43,7 +42,7 @@ def build_inputs(n_corpus, n_queries, seed=17):
     records = random_records(n_corpus, seed=seed)
     queries = records[:n_queries]
     feats = F.extract_batch(plan, records)
-    feats[E.ANN_PROP] = {E.ANN_TENSOR: enc.encode_batch(records).astype(ml_dtypes.bfloat16)}
+    feats[E.ANN_PROP] = {E.ANN_TENSOR: enc.encode_corpus(records)}
     valid = np.ones((n_corpus,), dtype=bool)
     valid[n_corpus // 3] = False          # one tombstone
     deleted = np.zeros((n_corpus,), dtype=bool)
@@ -176,7 +175,7 @@ class TestShardedAnnScorer:
             for i in range(n)
         ]
         feats = F.extract_batch(plan, records)
-        feats[E.ANN_PROP] = {E.ANN_TENSOR: enc.encode_batch(records).astype(ml_dtypes.bfloat16)}
+        feats[E.ANN_PROP] = {E.ANN_TENSOR: enc.encode_corpus(records)}
         valid = np.ones((n,), dtype=bool)
         deleted = np.zeros((n,), dtype=bool)
         group = np.full((n,), -1, dtype=np.int32)

@@ -82,6 +82,37 @@ def test_dice_and_jaccard_goldens(goldens):
             case["expected"], abs=1e-12), case
 
 
+def test_weighted_levenshtein_goldens(goldens):
+    cmp = C.WeightedLevenshtein()
+    for case in goldens["weighted_levenshtein"]:
+        got = cmp.compare(case["v1"], case["v2"])
+        assert got == pytest.approx(case["expected"], abs=1e-12), case
+
+
+def test_jaro_winkler_tokenized_goldens(goldens):
+    cmp = C.JaroWinklerTokenized()
+    for case in goldens["jaro_winkler_tokenized"]:
+        got = cmp.compare(case["v1"], case["v2"])
+        assert got == pytest.approx(case["expected"], abs=1e-12), case
+
+
+def test_soundex_goldens(goldens):
+    cmp = C.Soundex()
+    for case in goldens["soundex"]:
+        got = cmp.compare(case["v1"], case["v2"])
+        assert got == pytest.approx(case["expected"], abs=1e-12), case
+
+
+def test_person_name_goldens(goldens):
+    """Pins the registry's documented PersonName semantics (Duke-shaped,
+    not a byte-level Duke port): reorder plateau, initial matching,
+    sqrt token-count discount."""
+    cmp = C.PersonName()
+    for case in goldens["person_name"]:
+        got = cmp.compare(case["v1"], case["v2"])
+        assert got == pytest.approx(case["expected"], abs=1e-12), case
+
+
 def test_bayes_combination_goldens(goldens):
     """Probability map + naive-Bayes combination under the demo-config
     weights (NAME .09/.93, AREA .04/.73, CAPITAL .12/.61)."""
