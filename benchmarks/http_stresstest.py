@@ -1,0 +1,203 @@
+"""HTTP-level stresstest: the Sesam-node pipe flow against a real server.
+
+The reference is system-tested by an external Sesam node pumping seeded
+fake entities through the REST surface and polling links back
+(sesam_node_deduplication_stresstest_config.conf.json:19-36,86-106 — two
+sources of 10,000 entities, seed 1234, area in [1,10], ids in [1,1e6]).
+The in-process F1 harness (f1_stresstest.py) measures matching quality at
+the engine layer; THIS driver is the reference's actual test shape: an
+in-process Sesam stand-in that POSTs JSON batches over real HTTP (so the
+service layer — lock discipline, ingest microbatching, datasource
+conversion, link feed — is inside the measurement) and polls ``?since=``
+incrementally like a ``supports_since`` source pipe.
+
+Usage::
+
+    python benchmarks/http_stresstest.py [--backend host|device|ann]
+        [--entities 10000] [--batch 500] [--concurrency 4]
+        [--workload dedup|linkage]
+
+Prints one JSON line: {"backend", "workload", "entities", "wall_s",
+"post_rows_per_sec", "links", "poll_batches", "f1" (vs seeded truth)}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import urllib.request
+
+from f1_stresstest import generate, generate_linkage, truth_links, truth_pairs
+
+CONFIG_TEMPLATE = """
+<DukeMicroService>
+  <Deduplication name="stress" link-database-type="in-memory">
+    <duke>
+      <schema>
+        <threshold>0.8</threshold>
+        <property><name>NAME</name><comparator>levenshtein</comparator><low>0.25</low><high>0.85</high></property>
+        <property><name>CITY</name><comparator>exact</comparator><low>0.45</low><high>0.65</high></property>
+        <property><name>SSN</name><comparator>qgram</comparator><low>0.2</low><high>0.9</high></property>
+      </schema>
+      <data-source class="io.sesam.dukemicroservice.IncrementalDeduplicationDataSource">
+        <param name="dataset-id" value="src"/>
+        <column name="name" property="NAME"/>
+        <column name="city" property="CITY"/>
+        <column name="ssn" property="SSN"/>
+      </data-source>
+    </duke>
+  </Deduplication>
+  <RecordLinkage name="stress" link-mode="one-to-one" link-database-type="in-memory">
+    <duke>
+      <schema>
+        <threshold>0.8</threshold>
+        <property><name>NAME</name><comparator>levenshtein</comparator><low>0.25</low><high>0.85</high></property>
+        <property><name>CITY</name><comparator>exact</comparator><low>0.45</low><high>0.65</high></property>
+        <property><name>SSN</name><comparator>qgram</comparator><low>0.2</low><high>0.9</high></property>
+      </schema>
+      <group>
+        <data-source class="io.sesam.dukemicroservice.IncrementalRecordLinkageDataSource">
+          <param name="dataset-id" value="g1"/>
+          <column name="name" property="NAME"/>
+          <column name="city" property="CITY"/>
+          <column name="ssn" property="SSN"/>
+        </data-source>
+      </group>
+      <group>
+        <data-source class="io.sesam.dukemicroservice.IncrementalRecordLinkageDataSource">
+          <param name="dataset-id" value="g2"/>
+          <column name="name" property="NAME"/>
+          <column name="city" property="CITY"/>
+          <column name="ssn" property="SSN"/>
+        </data-source>
+      </group>
+    </duke>
+  </RecordLinkage>
+</DukeMicroService>
+"""
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=600) as resp:
+        body = resp.read()
+        assert resp.status == 200, (resp.status, body[:200])
+        return json.loads(body)
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=600) as resp:
+        return json.loads(resp.read())
+
+
+def run(backend: str, entities: int, batch: int, concurrency: int,
+        workload: str):
+    os.environ.setdefault("MIN_RELEVANCE", "0.05")
+    from sesam_duke_microservice_tpu.core.config import parse_config
+    from sesam_duke_microservice_tpu.service.app import DukeApp, serve
+    from sesam_duke_microservice_tpu.utils.jit_cache import (
+        enable_persistent_cache,
+    )
+
+    if backend in ("device", "ann"):
+        enable_persistent_cache()
+    app = DukeApp(parse_config(CONFIG_TEMPLATE), backend=backend,
+                  persistent=False)
+    server = serve(app, port=0, host="127.0.0.1")
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+
+    if workload == "linkage":
+        g1, g2, t1, t2 = generate_linkage(entities // 2, 0.3, 1234)
+        posts = (
+            [(f"{base}/recordlinkage/stress/g1", g1[s:s + batch])
+             for s in range(0, len(g1), batch)]
+            + [(f"{base}/recordlinkage/stress/g2", g2[s:s + batch])
+               for s in range(0, len(g2), batch)]
+        )
+        expected = truth_links(t1, t2)
+        feed = f"{base}/recordlinkage/stress"
+    else:
+        rows, truth = generate(entities, 0.3, 1234)
+        posts = [
+            (f"{base}/deduplication/stress/src", rows[s:s + batch])
+            for s in range(0, len(rows), batch)
+        ]
+        expected = truth_pairs(truth)
+        feed = f"{base}/deduplication/stress"
+
+    t0 = time.perf_counter()
+    # the Sesam node runs several pipes concurrently — concurrency > 1
+    # exercises the service's ingest microbatching
+    with concurrent.futures.ThreadPoolExecutor(concurrency) as pool:
+        list(pool.map(lambda p: _post(*p), posts))
+    wall = time.perf_counter() - t0
+
+    # incremental polling, supports_since-style: advance the cursor batch
+    # by batch until the feed drains
+    since = 0
+    links = {}
+    poll_batches = 0
+    while True:
+        rows_ = _get(f"{feed}?since={since}")
+        if not rows_:
+            break
+        poll_batches += 1
+        for row in rows_:
+            key = tuple(sorted((row["entity1"], row["entity2"])))
+            if row["_deleted"]:
+                links.pop(key, None)
+            else:
+                links[key] = row["confidence"]
+            since = max(since, row["_updated"])
+
+    emitted = set(links)
+    tp = len(emitted & expected)
+    precision = tp / len(emitted) if emitted else 0.0
+    recall = tp / len(expected) if expected else 1.0
+    f1 = (2 * precision * recall / (precision + recall)
+          if precision + recall else 0.0)
+
+    server.shutdown()
+    return {
+        "backend": backend,
+        "workload": workload,
+        "entities": entities,
+        "wall_s": round(wall, 2),
+        "post_rows_per_sec": round(entities / wall, 1),
+        "links": len(links),
+        "poll_batches": poll_batches,
+        "f1": round(f1, 4),
+        "precision": round(precision, 4),
+        "recall": round(recall, 4),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", default="host",
+                    choices=["host", "device", "ann"])
+    ap.add_argument("--entities", type=int, default=10000)
+    ap.add_argument("--batch", type=int, default=500)
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--workload", default="dedup",
+                    choices=["dedup", "linkage"])
+    args = ap.parse_args()
+    print(json.dumps(run(args.backend, args.entities, args.batch,
+                         args.concurrency, args.workload)))
+
+
+if __name__ == "__main__":
+    main()

@@ -72,6 +72,12 @@ _CHUNK = int(os.environ.get("DEVICE_CHUNK", "8192"))
 # a steady-state commit of a few hundred rows must not pay a chunk-sized
 # (8192-row) transfer.
 _UPDATE_SLICE = int(os.environ.get("DEVICE_UPDATE_SLICE", "512"))
+# Pre-sized corpus capacity (rows) for deployments that know their corpus
+# scale: capacity-doubling growth transiently needs old + new tensors
+# resident, so a corpus near half of HBM cannot double its way up (e.g.
+# 10M rows would try to allocate a 16.8M-row copy).  Pre-sizing allocates
+# once at the target and never grows through the danger zone.
+_INITIAL_CAPACITY = int(os.environ.get("DEVICE_INITIAL_CAPACITY", "0"))
 _INITIAL_TOP_K = int(os.environ.get("DEVICE_TOP_K", "64"))
 # Value-slot auto-growth cap: pair scoring is O(V^2) combos per property, so
 # the per-property value axis stops doubling here; records with more values
@@ -115,6 +121,9 @@ class DeviceCorpus:
 
     def _grow(self, needed: int) -> None:
         cap = max(self.capacity, _CHUNK)
+        if _INITIAL_CAPACITY > 0:
+            presized = -(-_INITIAL_CAPACITY // _CHUNK) * _CHUNK
+            cap = max(cap, presized)
         while cap < needed:
             cap *= 2
         if cap == self.capacity:
@@ -582,6 +591,8 @@ class DeviceIndex(CandidateIndex):
         ``records_by_id`` is the durable store's live view; the snapshot is
         rejected unless its live rows are exactly the store's record set.
         """
+        import ml_dtypes
+
         if self.corpus.size != 0 or not os.path.exists(path):
             return False
         try:
@@ -627,8 +638,6 @@ class DeviceIndex(CandidateIndex):
                     _, prop, name = key.split("\x1f", 2)
                     arr = data[key]
                     if key in bf16_keys:
-                        import ml_dtypes
-
                         arr = arr.view(ml_dtypes.bfloat16)
                     feats.setdefault(prop, {})[name] = arr
         except Exception:

@@ -432,6 +432,26 @@ class TestDeviceCorpus:
         assert log.match_set() == log2.match_set()
 
 
+    def test_initial_capacity_presizing(self, monkeypatch):
+        """DEVICE_INITIAL_CAPACITY pre-allocates the corpus at the target
+        (rounded to the chunk) so near-HBM-scale corpora never pay the
+        doubling transient; appends below the pre-size never grow."""
+        from sesam_duke_microservice_tpu.engine import device_matcher as dm
+
+        # above _CHUNK regardless of the env so the assertion can only be
+        # satisfied by the pre-sizing path, never by the default minimum
+        presize = 3 * dm._CHUNK - 1
+        monkeypatch.setattr(dm, "_INITIAL_CAPACITY", presize)
+        schema = dedup_schema()
+        index = DeviceIndex(schema)
+        proc = DeviceProcessor(schema, index)
+        proc.add_match_listener(EventLog())
+        proc.deduplicate(random_records(10, seed=1))
+        assert index.corpus.capacity == 3 * dm._CHUNK
+        proc.deduplicate(random_records(60, seed=2))
+        assert index.corpus.capacity == 3 * dm._CHUNK  # no growth below it
+
+
 class TestSnapshot:
     def test_snapshot_roundtrip(self, tmp_path):
         schema = dedup_schema()
