@@ -42,16 +42,11 @@ V5E_HBM_BYTES = 16 * (1 << 30)
 
 
 def _reexec_on_virtual_mesh(n_devices: int) -> None:
-    env = dict(os.environ)
-    env["_LS_SHARDED_INNER"] = "1"
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = " ".join(
-        f for f in env.get("XLA_FLAGS", "").split()
-        if "xla_force_host_platform_device_count" not in f
+    from sesam_duke_microservice_tpu.utils.virtual_mesh import (
+        virtual_mesh_env,
     )
-    env["XLA_FLAGS"] = (
-        f"{flags} --xla_force_host_platform_device_count={n_devices}".strip()
-    )
+
+    env = virtual_mesh_env(n_devices, "_LS_SHARDED_INNER")
     proc = subprocess.run([sys.executable] + sys.argv, env=env)
     sys.exit(proc.returncode)
 
@@ -60,10 +55,11 @@ def run_sharded(args) -> None:
     import jax
 
     if os.environ.get("_LS_SHARDED_INNER") == "1":
-        # the axon sitecustomize hook imports jax at interpreter startup and
-        # pins the platform, so the child's JAX_PLATFORMS env alone is not
-        # enough — force the config before any computation (conftest recipe)
-        jax.config.update("jax_platforms", "cpu")
+        from sesam_duke_microservice_tpu.utils.virtual_mesh import (
+            force_cpu_platform,
+        )
+
+        force_cpu_platform()
     if (len(jax.devices()) < args.devices
             and os.environ.get("_LS_SHARDED_INNER") != "1"):
         _reexec_on_virtual_mesh(args.devices)

@@ -327,6 +327,10 @@ class DeviceIndex(CandidateIndex):
             )
         self.corpus = DeviceCorpus(self.plan, v)
         self.records: Dict[str, Record] = {}     # id -> live record
+        # O(1) live count (non-dukeDeleted records) for /stats — counting
+        # by iterating ``records`` would need the workload lock for the
+        # whole scan (seconds at 10M rows)
+        self.live_records = 0
         self.id_to_row: Dict[str, int] = {}
         self.indexing_disabled = False
         self._pending: List[Record] = []
@@ -423,6 +427,11 @@ class DeviceIndex(CandidateIndex):
         ids = [r.record_id for r in records]
         rows = self.corpus.append(feats, deleted, group, ids)
         for r, row in zip(records, rows):
+            old = self.records.get(r.record_id)
+            self.live_records += (
+                (0 if r.is_deleted() else 1)
+                - (0 if old is None or old.is_deleted() else 1)
+            )
             self.id_to_row[r.record_id] = int(row)
             self.records[r.record_id] = r
 
@@ -464,6 +473,7 @@ class DeviceIndex(CandidateIndex):
             )
             self.id_to_row = {}
             self.records = {}
+            self.live_records = 0
             if old_records:
                 logger.info(
                     "value-slot growth: rebuilding corpus tensors for %d "
@@ -497,7 +507,9 @@ class DeviceIndex(CandidateIndex):
             row = self.id_to_row.pop(record.record_id, None)
             if row is not None:
                 self.corpus.tombstone(row)
-            self.records.pop(record.record_id, None)
+            old = self.records.pop(record.record_id, None)
+            if old is not None and not old.is_deleted():
+                self.live_records -= 1
 
     def set_indexing_disabled(self, disabled: bool) -> None:
         self.indexing_disabled = disabled
@@ -661,6 +673,9 @@ class DeviceIndex(CandidateIndex):
             if ok:
                 self.id_to_row[str(rid)] = int(row)
                 self.records[str(rid)] = records_by_id[str(rid)]
+        self.live_records = sum(
+            1 for r in self.records.values() if not r.is_deleted()
+        )
         logger.info("corpus snapshot restored: %d rows from %s", n, path)
         return True
 

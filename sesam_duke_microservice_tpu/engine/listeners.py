@@ -243,8 +243,15 @@ class ServiceMatchListener(MatchListener):
         # never become assertable link material in a later real batch
         if self.link_database_updates_disabled:
             return
+        pair = tuple(sorted((r1.record_id, r2.record_id)))
         for rid in (r1.record_id, r2.record_id):
             alts = self._alternatives.setdefault(rid, [])
+            # one slot per pair: a repeatedly-suppressed pair must not
+            # fill the cap with copies and evict distinct runner-ups
+            alts[:] = [
+                t for t in alts
+                if tuple(sorted((t[1].record_id, t[2].record_id))) != pair
+            ]
             alts.append((confidence, r1, r2))
             self._alt_batch[rid] = self._batch_no
             if len(alts) > self._ALTERNATIVE_CAP:
@@ -254,12 +261,14 @@ class ServiceMatchListener(MatchListener):
 
     def _replay_live(self, r1: Record, r2: Record) -> bool:
         """Both endpoints of a remembered pair still resolve to live
-        records (when the workload wired a resolver)."""
+        records WITH the remembered content (when the workload wired a
+        resolver).  A re-indexed record invalidates its remembered pairs —
+        their confidences were computed from the old values."""
         if self._record_resolver is None:
             return True
         for rec in (r1, r2):
             live = self._record_resolver(rec.record_id)
-            if live is None or live.is_deleted():
+            if live is None or live.is_deleted() or live != rec:
                 return False
         return True
 

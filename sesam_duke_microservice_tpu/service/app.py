@@ -256,30 +256,18 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
         ):
             for name, wl in registry.items():
                 stats = getattr(wl.processor, "stats", None)
-                # device/ann: the live id->record map (corpus.size would
-                # count tombstoned/superseded rows); dukeDeleted records
-                # stay resolvable by design but are not "indexed" for
-                # matching, so they are excluded from the count; host:
-                # index length.  Counting iterates the index's dicts, so
-                # it needs the workload lock against concurrent ingest
-                # (a resize mid-iteration raises); skip the count rather
-                # than block behind a long-running batch.
-                if wl.lock.acquire(timeout=READ_LOCK_TIMEOUT_SECONDS):
-                    try:
-                        live = getattr(wl.index, "records", None)
-                        indexed = (
-                            sum(1 for r in live.values()
-                                if not r.is_deleted())
-                            if live is not None else len(wl.index)
-                        )
-                    finally:
-                        wl.lock.release()
-                else:
-                    indexed = None
+                # live (non-dukeDeleted) indexed records, via the O(1)
+                # counters the backends maintain (device/ann:
+                # live_records; host: len(index)) — lock-free, so a
+                # long-running ingest batch never stalls /stats and
+                # /stats never stalls ingest
+                live = getattr(wl.index, "live_records", None)
                 row = {
                     "kind": kind,
                     "name": name,
-                    "records_indexed": indexed,
+                    "records_indexed": (
+                        live if live is not None else len(wl.index)
+                    ),
                 }
                 if stats is not None:
                     row.update(

@@ -1,0 +1,49 @@
+"""Virtual CPU mesh provisioning for hosts without enough chips.
+
+THE one copy of the re-exec recipe (`JAX_PLATFORMS=cpu` +
+``--xla_force_host_platform_device_count=N`` + an inner-guard env var)
+that `tests/conftest.py` pioneered: the driver's multichip dryrun
+(`__graft_entry__.py`) and the sharded benchmark
+(`benchmarks/large_scale.py`) both validate N-way `shard_map` programs on
+single-chip hosts by re-exec'ing themselves in a child with these
+settings.  The env must be set before the child's interpreter starts
+(jax reads it at init), and on axon hosts the sitecustomize hook pins the
+platform even earlier — so children must ALSO call
+``force_cpu_platform()`` before any computation.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+
+def virtual_mesh_env(n_devices: int, inner_flag: str) -> Dict[str, str]:
+    """Child-process env for an ``n_devices`` virtual CPU mesh.
+
+    ``inner_flag`` is the guard the child checks to know it has been
+    re-exec'd (so it provisions instead of re-exec'ing again).
+    """
+    env = dict(os.environ)
+    env[inner_flag] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    env["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={n_devices}".strip()
+    )
+    return env
+
+
+def force_cpu_platform() -> None:
+    """Pin jax to CPU from inside a re-exec'd child.
+
+    The env var alone is not enough when a sitecustomize hook (axon)
+    imports jax at interpreter startup; forcing the config still works as
+    long as no computation has run.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
