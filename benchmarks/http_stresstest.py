@@ -102,8 +102,10 @@ def _get(url):
 
 
 def run(backend: str, entities: int, batch: int, concurrency: int,
-        workload: str):
+        workload: str, one_to_one: bool = False):
     os.environ.setdefault("MIN_RELEVANCE", "0.05")
+    if one_to_one:
+        os.environ["ONE_TO_ONE"] = "1"
     from sesam_duke_microservice_tpu.core.config import parse_config
     from sesam_duke_microservice_tpu.service.app import DukeApp, serve
     from sesam_duke_microservice_tpu.utils.jit_cache import (
@@ -194,9 +196,12 @@ def main():
     ap.add_argument("--concurrency", type=int, default=4)
     ap.add_argument("--workload", default="dedup",
                     choices=["dedup", "linkage"])
+    ap.add_argument("--one-to-one", action="store_true",
+                    help="activate the real ONE_TO_ONE listener policy")
     args = ap.parse_args()
     print(json.dumps(run(args.backend, args.entities, args.batch,
-                         args.concurrency, args.workload)))
+                         args.concurrency, args.workload,
+                         one_to_one=args.one_to_one)))
 
 
 if __name__ == "__main__":

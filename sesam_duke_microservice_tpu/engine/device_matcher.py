@@ -426,14 +426,18 @@ class DeviceIndex(CandidateIndex):
         )
         ids = [r.record_id for r in records]
         rows = self.corpus.append(feats, deleted, group, ids)
+        delta = 0
         for r, row in zip(records, rows):
             old = self.records.get(r.record_id)
-            self.live_records += (
+            delta += (
                 (0 if r.is_deleted() else 1)
                 - (0 if old is None or old.is_deleted() else 1)
             )
             self.id_to_row[r.record_id] = int(row)
             self.records[r.record_id] = r
+        # one publication per batch: lock-free /stats readers must never
+        # observe a mid-append partial count
+        self.live_records += delta
 
     # -- value-slot auto-sizing ----------------------------------------------
 
@@ -473,7 +477,14 @@ class DeviceIndex(CandidateIndex):
             )
             self.id_to_row = {}
             self.records = {}
-            self.live_records = 0
+            # live_records is deliberately NOT zeroed before the re-append:
+            # lock-free /stats readers must never observe a transient
+            # near-zero count for a populated corpus.  The re-append of the
+            # same record set double-counts (every record looks new against
+            # the cleared map), so the pre-rebuild count is subtracted once
+            # at the end — readers transiently see between 1x and 2x, never
+            # a collapse.
+            prev_live = self.live_records
             if old_records:
                 logger.info(
                     "value-slot growth: rebuilding corpus tensors for %d "
@@ -481,6 +492,7 @@ class DeviceIndex(CandidateIndex):
                     {s.name: s.v for s in self.plan.device_props},
                 )
                 self._append_records(list(old_records.values()))
+            self.live_records -= prev_live
 
     def find_record_by_id(self, record_id: str) -> Optional[Record]:
         return self.records.get(record_id)
