@@ -2,6 +2,7 @@
 
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -90,10 +91,10 @@ class _NoRedirect(urllib.request.HTTPRedirectHandler):
 _opener = urllib.request.build_opener(_NoRedirect)
 
 
-def request(url, method="GET", body=None, headers=None):
+def request(url, method="GET", body=None, headers=None, timeout=None):
     req = urllib.request.Request(url, data=body, method=method, headers=headers or {})
     try:
-        with _opener.open(req) as resp:
+        with _opener.open(req, timeout=timeout) as resp:
             return resp.status, dict(resp.headers), resp.read()
     except urllib.error.HTTPError as e:
         return e.code, dict(e.headers), e.read()
@@ -371,6 +372,56 @@ def test_concurrent_posts_microbatch_and_all_succeed(server_url):
     for t in threads:
         t.join()
     assert sorted(statuses) == [200, 200, 200, 500]
+
+
+def test_stats_lock_free_under_concurrent_ingest(server_url):
+    """/stats must neither stall behind nor crash against concurrent
+    ingest (it reads O(1) live counters lock-free; the old implementation
+    iterated the record map and could hit a mid-resize RuntimeError or
+    block for the duration of a batch)."""
+    stop = threading.Event()
+    errors = []
+
+    def poster():
+        i = 0
+        while not stop.is_set():
+            status, _, _ = post_json(
+                f"{server_url}/deduplication/people/web",
+                [{"_id": f"st{i}-{j}", "name": f"stats load {i} {j}",
+                  "email": f"s{i}{j}@x"} for j in range(20)],
+            )
+            if status != 200:
+                errors.append(("post", status))
+            i += 1
+
+    def poller():
+        while not stop.is_set():
+            # the timeout is the stall detector: a /stats that blocks
+            # behind an ingest batch (the old behavior) fails here
+            try:
+                status, _, body = request(f"{server_url}/stats", timeout=10)
+            except Exception as e:
+                errors.append(("stats-stall", repr(e)))
+                continue
+            if status != 200:
+                errors.append(("stats", status))
+                continue
+            payload = json.loads(body)
+            for row in payload["workloads"]:
+                if not isinstance(row["records_indexed"], int):
+                    errors.append(("null-count", row))
+
+    threads = [threading.Thread(target=poster) for _ in range(2)] + [
+        threading.Thread(target=poller) for _ in range(2)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(2.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads), "worker thread hung"
+    assert not errors, errors[:5]
 
 
 def test_device_reload_uses_corpus_snapshot(tmp_path, monkeypatch):
