@@ -1,0 +1,32 @@
+"""Smoke test for the HTTP-level stresstest driver (benchmarks/).
+
+The driver is the reference's system-test shape (Sesam-node stand-in:
+concurrent POSTs + incremental since-polling); this guards it from rot
+with a tiny corpus on the host backend.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks",
+))
+
+
+def test_http_stresstest_driver_smoke():
+    import http_stresstest
+
+    out = http_stresstest.run(
+        "host", entities=200, batch=50, concurrency=2, workload="dedup"
+    )
+    assert out["entities"] == 200
+    assert out["links"] > 0
+    assert out["f1"] > 0.8, out
+
+    out = http_stresstest.run(
+        "host", entities=200, batch=50, concurrency=2, workload="linkage",
+        one_to_one=True,
+    )
+    assert out["links"] > 0
+    assert out["precision"] > 0.8, out
