@@ -103,9 +103,6 @@ def _get(url):
 
 def run(backend: str, entities: int, batch: int, concurrency: int,
         workload: str, one_to_one: bool = False):
-    os.environ.setdefault("MIN_RELEVANCE", "0.05")
-    if one_to_one:
-        os.environ["ONE_TO_ONE"] = "1"
     from sesam_duke_microservice_tpu.core.config import parse_config
     from sesam_duke_microservice_tpu.service.app import DukeApp, serve
     from sesam_duke_microservice_tpu.utils.jit_cache import (
@@ -114,8 +111,22 @@ def run(backend: str, entities: int, batch: int, concurrency: int,
 
     if backend in ("device", "ann"):
         enable_persistent_cache()
-    app = DukeApp(parse_config(CONFIG_TEMPLATE), backend=backend,
-                  persistent=False)
+    # config env flags apply only to this run's config parse — mutate and
+    # restore so in-process callers (the smoke test) don't leak mode
+    # changes into the rest of their process
+    saved = {k: os.environ.get(k) for k in ("MIN_RELEVANCE", "ONE_TO_ONE")}
+    os.environ.setdefault("MIN_RELEVANCE", "0.05")
+    if one_to_one:
+        os.environ["ONE_TO_ONE"] = "1"
+    try:
+        app = DukeApp(parse_config(CONFIG_TEMPLATE), backend=backend,
+                      persistent=False)
+    finally:
+        for key, value in saved.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
     server = serve(app, port=0, host="127.0.0.1")
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()

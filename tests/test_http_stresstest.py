@@ -5,17 +5,34 @@ concurrent POSTs + incremental since-polling); this guards it from rot
 with a tiny corpus on the host backend.
 """
 
+import importlib.util
 import os
 import sys
 
-sys.path.insert(0, os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    "benchmarks",
-))
+
+def _load_driver():
+    bench_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks",
+    )
+    spec = importlib.util.spec_from_file_location(
+        "_http_stresstest", os.path.join(bench_dir, "http_stresstest.py")
+    )
+    module = importlib.util.module_from_spec(spec)
+    # the driver imports its sibling f1_stresstest; scope the path
+    # mutation to the exec instead of leaving benchmarks/ importable (and
+    # shadow-capable) for the rest of the session
+    sys.path.insert(0, bench_dir)
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        sys.path.remove(bench_dir)
+    return module
 
 
 def test_http_stresstest_driver_smoke():
-    import http_stresstest
+    env_before = dict(os.environ)
+    http_stresstest = _load_driver()
 
     out = http_stresstest.run(
         "host", entities=200, batch=50, concurrency=2, workload="dedup"
@@ -30,3 +47,8 @@ def test_http_stresstest_driver_smoke():
     )
     assert out["links"] > 0
     assert out["precision"] > 0.8, out
+
+    # the driver must not leak config env flags into this process (later
+    # tests parse configs against os.environ)
+    assert {k: os.environ.get(k) for k in ("ONE_TO_ONE", "MIN_RELEVANCE")} \
+        == {k: env_before.get(k) for k in ("ONE_TO_ONE", "MIN_RELEVANCE")}

@@ -385,11 +385,18 @@ def test_stats_lock_free_under_concurrent_ingest(server_url):
     def poster():
         i = 0
         while not stop.is_set():
-            status, _, _ = post_json(
-                f"{server_url}/deduplication/people/web",
-                [{"_id": f"st{i}-{j}", "name": f"stats load {i} {j}",
-                  "email": f"s{i}{j}@x"} for j in range(20)],
-            )
+            # any transport failure must be recorded, not silently kill
+            # the thread (a dead poster would leave /stats unexercised
+            # under load and the test vacuously green)
+            try:
+                status, _, _ = post_json(
+                    f"{server_url}/deduplication/people/web",
+                    [{"_id": f"st{i}-{j}", "name": f"stats load {i} {j}",
+                      "email": f"s{i}{j}@x"} for j in range(20)],
+                )
+            except Exception as e:
+                errors.append(("post-error", repr(e)))
+                break
             if status != 200:
                 errors.append(("post", status))
             i += 1
