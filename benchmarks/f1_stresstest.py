@@ -290,6 +290,9 @@ def run(backend: str, n_entities: int, dup_rate: float, batch: int,
             "bench", linkdb,
             kind="recordlinkage" if workload == "linkage" else "deduplication",
             one_to_one=True,
+            # displacement replay fails closed without a resolver; wire the
+            # index lookup exactly as build_workload does
+            record_resolver=proc.database.find_record_by_id,
         )
         proc.add_match_listener(listener)
     else:

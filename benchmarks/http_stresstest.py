@@ -55,7 +55,7 @@ CONFIG_TEMPLATE = """
       </data-source>
     </duke>
   </Deduplication>
-  <RecordLinkage name="stress" link-mode="one-to-one" link-database-type="in-memory">
+  <RecordLinkage name="stress" link-mode="{link_mode}" link-database-type="in-memory">
     <duke>
       <schema>
         <threshold>0.8</threshold>
@@ -116,10 +116,15 @@ def run(backend: str, entities: int, batch: int, concurrency: int,
     # changes into the rest of their process
     saved = {k: os.environ.get(k) for k in ("MIN_RELEVANCE", "ONE_TO_ONE")}
     os.environ.setdefault("MIN_RELEVANCE", "0.05")
-    if one_to_one:
-        os.environ["ONE_TO_ONE"] = "1"
+    # the mode rides the per-workload XML attribute (round 3: link-mode is
+    # honored per <RecordLinkage> element); clear any ambient ONE_TO_ONE so
+    # the env override cannot silently flip the CLI flag's intent
+    os.environ.pop("ONE_TO_ONE", None)
+    config = CONFIG_TEMPLATE.format(
+        link_mode="one-to-one" if one_to_one else "many-to-many"
+    )
     try:
-        app = DukeApp(parse_config(CONFIG_TEMPLATE), backend=backend,
+        app = DukeApp(parse_config(config), backend=backend,
                       persistent=False)
     finally:
         for key, value in saved.items():

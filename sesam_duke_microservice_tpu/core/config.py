@@ -120,12 +120,26 @@ class WorkloadConfig:
     kind: str                       # "deduplication" | "recordlinkage"
     duke: DukeSchema
     link_database_type: str         # "h2" | "in-memory"
-    link_mode: Optional[str] = None  # linkage only; always "one-to-one"
+    # linkage only: "one-to-one" (enforced per workload) or "many-to-many"
+    # (accepted extension value — every above-threshold pair links, the
+    # reference's de-facto behavior since its flag is vestigial, quirk Q5)
+    link_mode: Optional[str] = None
     data_folder: Optional[str] = None
 
     @property
     def is_record_linkage(self) -> bool:
         return self.kind == "recordlinkage"
+
+    @property
+    def enforce_one_to_one(self) -> bool:
+        """Whether THIS workload's XML asks for one-to-one enforcement.
+
+        The reference parses link-mode="one-to-one" per <RecordLinkage>
+        element (App.java:113-120) but never reads the flag (quirk Q5);
+        here the attribute is the thing that controls behavior, so two
+        linkage workloads in one config can run different modes.  The
+        ONE_TO_ONE env flag overrides globally (see ServiceConfig)."""
+        return self.is_record_linkage and self.link_mode == "one-to-one"
 
 
 @dataclass
@@ -137,10 +151,12 @@ class ServiceConfig:
     threads: int = 1
     profile: bool = False
     tunables: MatchTunables = field(default_factory=MatchTunables)
-    # opt-in one-to-one enforcement for record linkage (ONE_TO_ONE=1).
-    # The reference parses link-mode="one-to-one" but never reads it
-    # (App.java:113-120, SURVEY.md quirk Q5); default preserves that.
-    one_to_one: bool = False
+    # Global one-to-one override: None (default) defers to each linkage
+    # workload's link-mode attribute (WorkloadConfig.enforce_one_to_one);
+    # ONE_TO_ONE=1 forces enforcement on for every linkage workload,
+    # ONE_TO_ONE=0 forces it off (restoring the reference's vestigial-flag
+    # behavior, quirk Q5).
+    one_to_one: Optional[bool] = None
 
 
 def _parse_number(text: str, what: str, label: str) -> float:
@@ -402,7 +418,9 @@ def parse_config(config_string: str, env=os.environ) -> ServiceConfig:
     if threads_env and re.fullmatch(r"\d+", threads_env):
         threads = int(threads_env)
     profile = env.get("PROFILE") == "1"
-    one_to_one = env.get("ONE_TO_ONE") == "1"
+    oto_env = (env.get("ONE_TO_ONE") or "").strip().lower()
+    one_to_one = (True if oto_env in ("1", "true")
+                  else False if oto_env in ("0", "false") else None)
     tunables = MatchTunables.from_env(env)
 
     deduplications: Dict[str, WorkloadConfig] = {}
@@ -431,9 +449,13 @@ def parse_config(config_string: str, env=os.environ) -> ServiceConfig:
             link_mode = child.get("link-mode")
             if link_mode is None:
                 raise ConfigError(
-                    f"The {label} has no link-mode attribute (must be 'one-to-one')"
+                    f"The {label} has no link-mode attribute (must be "
+                    f"'one-to-one' or 'many-to-many')"
                 )
-            if link_mode != "one-to-one":
+            if link_mode not in ("one-to-one", "many-to-many"):
+                # documented divergence: the reference accepts only
+                # "one-to-one" (App.java:113-120); "many-to-many" is the
+                # extension value naming its actual (unenforced) behavior
                 raise ConfigError(
                     f"Invalid link-mode '{link_mode}' specified for the '{name}' recordlinkage."
                 )

@@ -805,9 +805,22 @@ class _ScorerCache:
         ml = jax.ShapeDtypeStruct((), np.float32)
         return cfeats, (mb, mb, mi, qg, qr, ml)
 
+    def _probe_shapes(self):
+        """Per-row feature shapes of a typical http-transform probe (not in
+        the corpus, so extracted under the query plan — value width sized to
+        the probe, which for the common single-valued case is 1)."""
+        from ..core.records import ID_PROPERTY_NAME
+
+        dummy = Record()
+        dummy.add_value(ID_PROPERTY_NAME, "__prewarm__")
+        return self.index._extract(
+            [dummy], plan=self.index._query_plan([dummy])
+        )
+
     def _lower_one(self, row_feats, cap: int, bucket: int,
-                   group_filtering: bool):
-        from ..ops import scoring as S
+                   group_filtering: bool, *, from_rows: bool = True,
+                   probe_feats=None):
+        import jax
 
         cfeats, (mb, mb2, mi, qg, qr, ml) = self._lower_args(
             row_feats, cap, bucket
@@ -818,12 +831,25 @@ class _ScorerCache:
         # state; _build is the single builder both paths share, so the HLO
         # is identical and the XLA compile lands in the persistent cache
         # the live scorer reads
-        scorer = self._build(k, group_filtering, True)
-        scorer.lower({}, cfeats, mb, mb2, mi, qg, qr, ml).compile()
+        scorer = self._build(k, group_filtering, from_rows)
+        if from_rows:
+            qfeats = {}
+        else:
+            qfeats = {
+                prop: {
+                    name: jax.ShapeDtypeStruct(
+                        (bucket,) + arr.shape[1:], arr.dtype
+                    )
+                    for name, arr in tensors.items()
+                }
+                for prop, tensors in probe_feats.items()
+            }
+        scorer.lower(qfeats, cfeats, mb, mb2, mi, qg, qr, ml).compile()
 
     def _prewarm(self, group_filtering: bool, key) -> None:
         try:
             row_feats = self._row_shapes()
+            probe_feats = self._probe_shapes()
             cap = key[0]
             for cap_i in (cap, cap * 2):
                 for bucket in _QUERY_BUCKETS:
@@ -831,6 +857,16 @@ class _ScorerCache:
                         return  # superseded / interpreter exiting
                     self._lower_one(row_feats, cap_i, bucket,
                                     group_filtering)
+                    self._warm_compiled += 1
+                    # http-transform probes score through the
+                    # from_rows=False variant (bucket-shaped qfeats);
+                    # without this they stall on first-contact compiles
+                    # despite the warm thread having run
+                    if self._warmed != key or _WARM_SHUTDOWN.is_set():
+                        return
+                    self._lower_one(row_feats, cap_i, bucket,
+                                    group_filtering, from_rows=False,
+                                    probe_feats=probe_feats)
                     self._warm_compiled += 1
         except Exception:  # pragma: no cover - warm failures are harmless
             logger.exception("scorer pre-warm failed (scoring unaffected)")

@@ -261,11 +261,15 @@ class ServiceMatchListener(MatchListener):
 
     def _replay_live(self, r1: Record, r2: Record) -> bool:
         """Both endpoints of a remembered pair still resolve to live
-        records WITH the remembered content (when the workload wired a
-        resolver).  A re-indexed record invalidates its remembered pairs —
-        their confidences were computed from the old values."""
+        records WITH the remembered content.  A re-indexed record
+        invalidates its remembered pairs — their confidences were computed
+        from the old values.  Fail closed when no resolver is wired: a
+        listener constructed without one (any embedder bypassing
+        build_workload) must not re-assert links from batch-old remembered
+        confidences for records that may have been re-indexed or deleted
+        since (displacement repair degrades gracefully; correctness wins)."""
         if self._record_resolver is None:
-            return True
+            return False
         for rec in (r1, r2):
             live = self._record_resolver(rec.record_id)
             if live is None or live.is_deleted() or live != rec:

@@ -139,41 +139,80 @@ class Workload:
         return []
 
     def _run_merged(self, work: List[_BatchRequest]) -> None:
-        """Process queued requests as one batch (call with self.lock held)."""
-        all_live: List[Record] = []
-        any_deleted = False
-        ok: List[_BatchRequest] = []
-        for req in work:
+        """Process queued requests as one batch (call with self.lock held).
+
+        Serializability: merging applies every request's deletes before one
+        shared scoring pass, so a merged group whose requests delete and
+        upsert the SAME record id with opposite polarity (req A deletes X /
+        adds Y merged with req B deletes Y / adds X) would end in a state
+        matching no serial order.  Such conflicts split the queue: the
+        merged group flushes (deletes + one scoring pass) before the
+        conflicting request starts a new group, making the outcome equal to
+        executing the groups — and therefore the requests — in queue order.
+        Same-polarity overlap needs no split: repeated deletes retract
+        idempotently and repeated upserts index in queue order inside one
+        scoring pass (later content wins), exactly as serial execution.
+        """
+        group: List[_BatchRequest] = []
+        group_records: List[List[Record]] = []
+        deleted_ids: set = set()
+        live_ids: set = set()
+
+        def flush():
+            nonlocal group, group_records, deleted_ids, live_ids
+            all_live: List[Record] = []
+            any_deleted = False
+            ok: List[_BatchRequest] = []
+            for req, records in zip(group, group_records):
+                try:
+                    if self.record_store is not None:
+                        self.record_store.put_many(records)
+                    deleted = [r for r in records if r.is_deleted()]
+                    for record in deleted:
+                        self.index.index(record)
+                        for link in self.link_database.get_all_links_for(
+                                record.record_id):
+                            link.retract()
+                            self.link_database.assert_link(link)
+                except Exception as e:  # store errors stay per-request
+                    req.error = e
+                    req.event.set()
+                    continue
+                any_deleted = any_deleted or bool(deleted)
+                all_live.extend(r for r in records if not r.is_deleted())
+                ok.append(req)
             try:
+                if any_deleted:
+                    self.index.commit()
+                if all_live:
+                    self.processor.deduplicate(all_live)
+            except Exception as e:
+                for req in ok:
+                    req.error = e
+            finally:
+                for req in ok:
+                    req.event.set()
+            group, group_records = [], []
+            deleted_ids, live_ids = set(), set()
+
+        for req in work:
+            try:  # conversion errors stay per-request
                 datasource = self.datasources[req.dataset_id]
                 records = datasource.records_for_batch(req.entities)
-                if self.record_store is not None:
-                    self.record_store.put_many(records)
-                deleted = [r for r in records if r.is_deleted()]
-                for record in deleted:
-                    self.index.index(record)
-                    for link in self.link_database.get_all_links_for(
-                            record.record_id):
-                        link.retract()
-                        self.link_database.assert_link(link)
-            except Exception as e:  # conversion/store errors stay per-request
+            except Exception as e:
                 req.error = e
                 req.event.set()
                 continue
-            any_deleted = any_deleted or bool(deleted)
-            all_live.extend(r for r in records if not r.is_deleted())
-            ok.append(req)
-        try:
-            if any_deleted:
-                self.index.commit()
-            if all_live:
-                self.processor.deduplicate(all_live)
-        except Exception as e:
-            for req in ok:
-                req.error = e
-        finally:
-            for req in ok:
-                req.event.set()
+            req_deleted = {r.record_id for r in records if r.is_deleted()}
+            req_live = {r.record_id for r in records if not r.is_deleted()}
+            if (req_deleted & live_ids) or (req_live & deleted_ids):
+                flush()
+            group.append(req)
+            group_records.append(records)
+            deleted_ids |= req_deleted
+            live_ids |= req_live
+        if group:
+            flush()
 
     def process_batch(self, dataset_id: str, entities: Sequence[dict],
                       http_transform: bool = False) -> List[dict]:
@@ -320,9 +359,13 @@ def build_workload(wc: WorkloadConfig, sc: ServiceConfig, *,
             wc.data_folder if persistent else None,
             is_record_linkage=wc.is_record_linkage,
         )
+        # per-workload link-mode from the XML; ONE_TO_ONE env overrides
+        # globally (None = defer to each workload's attribute)
+        one_to_one = (wc.enforce_one_to_one if sc.one_to_one is None
+                      else sc.one_to_one and wc.is_record_linkage)
         listener = ServiceMatchListener(
             wc.name, link_database, kind=wc.kind,
-            one_to_one=sc.one_to_one and wc.is_record_linkage,
+            one_to_one=one_to_one,
             record_resolver=index.find_record_by_id,
         )
         processor.add_match_listener(listener)

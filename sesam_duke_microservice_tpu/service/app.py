@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import re
 import threading
 from email.parser import BytesParser
@@ -50,6 +51,30 @@ _BUSY_TEMPLATE = (
     "The {kind} is being written to, so reading is not currently possible. "
     "Please wait a bit and try again later."
 )
+
+# Request-body ceiling (bytes).  The reference gets effective limits for
+# free from its Jetty bootstrap (App.java:649); the stdlib server would
+# otherwise read Content-Length bytes unconditionally into memory.  64 MiB
+# comfortably fits the stresstest batch shapes (500-row batches are ~100 KB)
+# while bounding a hostile/misconfigured POST; override via env.
+DEFAULT_MAX_REQUEST_BYTES = 64 * 1024 * 1024
+
+
+def _max_request_bytes() -> int:
+    raw = os.environ.get("MAX_REQUEST_BYTES")
+    if not raw:
+        return DEFAULT_MAX_REQUEST_BYTES
+    try:
+        limit = int(raw)
+    except ValueError:
+        logger.warning(
+            "Unparseable MAX_REQUEST_BYTES=%r; using the %d default",
+            raw, DEFAULT_MAX_REQUEST_BYTES,
+        )
+        return DEFAULT_MAX_REQUEST_BYTES
+    # <= 0 means unlimited (the common convention; a literal 0 limit would
+    # silently write-disable the service)
+    return limit if limit > 0 else (1 << 62)
 
 
 class DukeApp:
@@ -194,7 +219,22 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
         self._reply(status, message.encode("utf-8"), "text/plain")
 
     def _read_body(self) -> bytes:
-        length = int(self.headers.get("Content-Length") or 0)
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            # unread body bytes would desync the next keep-alive request
+            self.close_connection = True
+            raise _HttpError(400, "Invalid Content-Length header")
+        limit = _max_request_bytes()
+        if length > limit:
+            # the unread body would be parsed as the next keep-alive
+            # request, so the connection closes with the 413
+            self.close_connection = True
+            raise _HttpError(
+                413,
+                f"Request body of {length} bytes exceeds the "
+                f"{limit}-byte limit (MAX_REQUEST_BYTES)",
+            )
         return self.rfile.read(length) if length else b""
 
     # -- routing ------------------------------------------------------------

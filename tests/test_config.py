@@ -256,3 +256,83 @@ def test_empty_dataset_id_rejected():
     with pytest.raises(Exception) as err:
         parse_config(bad)
     assert "dataset" in str(err.value).lower()
+
+
+def test_link_mode_controls_one_to_one_per_workload():
+    """Round 3: link-mode on the <RecordLinkage> element is honored per
+    workload (the reference parses but never reads it — quirk Q5); the
+    ONE_TO_ONE env flag is a global override in either direction."""
+    two_modes = """
+    <DukeMicroService>
+      <RecordLinkage name="strict" link-mode="one-to-one">
+        <duke>
+          <schema><threshold>0.7</threshold>
+            <property><name>N</name><comparator>exact</comparator>
+              <low>0.1</low><high>0.9</high></property>
+          </schema>
+          <group>
+            <data-source class="io.sesam.dukemicroservice.IncrementalRecordLinkageDataSource">
+              <param name="dataset-id" value="a"/><column name="n" property="N"/>
+            </data-source>
+          </group>
+          <group>
+            <data-source class="io.sesam.dukemicroservice.IncrementalRecordLinkageDataSource">
+              <param name="dataset-id" value="b"/><column name="n" property="N"/>
+            </data-source>
+          </group>
+        </duke>
+      </RecordLinkage>
+      <RecordLinkage name="loose" link-mode="many-to-many">
+        <duke>
+          <schema><threshold>0.7</threshold>
+            <property><name>N</name><comparator>exact</comparator>
+              <low>0.1</low><high>0.9</high></property>
+          </schema>
+          <group>
+            <data-source class="io.sesam.dukemicroservice.IncrementalRecordLinkageDataSource">
+              <param name="dataset-id" value="c"/><column name="n" property="N"/>
+            </data-source>
+          </group>
+          <group>
+            <data-source class="io.sesam.dukemicroservice.IncrementalRecordLinkageDataSource">
+              <param name="dataset-id" value="d"/><column name="n" property="N"/>
+            </data-source>
+          </group>
+        </duke>
+      </RecordLinkage>
+    </DukeMicroService>
+    """
+    sc = cfg.parse_config(two_modes, env={})
+    assert sc.one_to_one is None
+    assert sc.record_linkages["strict"].enforce_one_to_one
+    assert not sc.record_linkages["loose"].enforce_one_to_one
+
+    # env override wins in both directions
+    assert cfg.parse_config(two_modes, env={"ONE_TO_ONE": "1"}).one_to_one is True
+    assert cfg.parse_config(two_modes, env={"ONE_TO_ONE": "0"}).one_to_one is False
+
+    # the two workloads behave independently end-to-end: same ambiguous
+    # batch (one 'b'/'d' record matching two 'a'/'c' records exactly)
+    from sesam_duke_microservice_tpu.engine.workload import build_workload
+
+    sc = cfg.parse_config(two_modes, env={"MIN_RELEVANCE": "0.05"})
+    strict = build_workload(sc.record_linkages["strict"], sc, persistent=False)
+    loose = build_workload(sc.record_linkages["loose"], sc, persistent=False)
+    try:
+        with strict.lock:
+            strict.process_batch("a", [{"_id": "a1", "n": "X"},
+                                       {"_id": "a2", "n": "X"}])
+            strict.process_batch("b", [{"_id": "b1", "n": "X"}])
+            n_strict = len([r for r in strict.links_since(0)
+                            if not r["_deleted"]])
+        with loose.lock:
+            loose.process_batch("c", [{"_id": "c1", "n": "X"},
+                                      {"_id": "c2", "n": "X"}])
+            loose.process_batch("d", [{"_id": "d1", "n": "X"}])
+            n_loose = len([r for r in loose.links_since(0)
+                           if not r["_deleted"]])
+    finally:
+        strict.close()
+        loose.close()
+    assert n_strict == 1   # one-to-one: b1 claims exactly one of a1/a2
+    assert n_loose == 2    # many-to-many: both above-threshold pairs link

@@ -43,7 +43,7 @@ DEDUP_XML = """
 
 LINKAGE_XML = """
 <DukeMicroService>
-  <RecordLinkage name="people" link-mode="one-to-one" link-database-type="in-memory">
+  <RecordLinkage name="people" link-mode="many-to-many" link-database-type="in-memory">
     <duke>
       <schema>
         <threshold>0.7</threshold>
@@ -374,8 +374,12 @@ def test_one_to_one_displacement_reassigns_runner_up():
 
     a1, a2, b1, b2 = rec("a1"), rec("a2"), rec("b1"), rec("b2")
     linkdb = InMemoryLinkDatabase()
+    # replay requires a resolver (the listener fails closed without one);
+    # here every record stays live with its original content
+    live_records = {r.record_id: r for r in (a1, a2, b1, b2)}
     lis = ServiceMatchListener("t", linkdb, kind="recordlinkage",
-                               one_to_one=True)
+                               one_to_one=True,
+                               record_resolver=live_records.get)
     # batch 1: a1-b1 wins at 0.9; a1's runner-up a1-b2 (0.85) is remembered
     lis.batch_ready(1)
     lis.matches(a1, b1, 0.9)

@@ -459,3 +459,68 @@ def test_device_reload_uses_corpus_snapshot(tmp_path, monkeypatch):
     assert wl2 is not wl
     assert wl2.index.corpus.size == 10
     app.close()
+
+
+def test_merged_conflicting_delete_upsert_is_serializable():
+    """Round-2 advisor finding: req A (delete X, add Y) merged with req B
+    (delete Y, add X) must end in a state matching a serial execution of
+    the merged requests.  The merge splits at the delete/upsert conflict,
+    so the outcome equals queue order A;B: X re-added live, Y deleted."""
+    import os
+
+    from sesam_duke_microservice_tpu.engine.workload import (
+        _BatchRequest,
+        build_workload,
+    )
+
+    saved = os.environ.get("MIN_RELEVANCE")
+    os.environ["MIN_RELEVANCE"] = "0.05"
+    try:
+        sc = parse_config(CONFIG_XML)
+    finally:
+        if saved is None:
+            os.environ.pop("MIN_RELEVANCE", None)
+        else:
+            os.environ["MIN_RELEVANCE"] = saved
+    wl = build_workload(sc.deduplications["people"], sc, backend="host",
+                        persistent=False)
+    try:
+        with wl.lock:
+            wl.process_batch("crm", [
+                {"_id": "x", "name": "xavier", "email": "x@a.no"},
+                {"_id": "y", "name": "yvonne", "email": "y@a.no"},
+            ])
+        req_a = _BatchRequest("crm", [
+            {"_id": "x", "_deleted": True},
+            {"_id": "y", "name": "yvonne2", "email": "y@a.no"},
+        ])
+        req_b = _BatchRequest("crm", [
+            {"_id": "y", "_deleted": True},
+            {"_id": "x", "name": "xavier2", "email": "x@a.no"},
+        ])
+        with wl.lock:
+            wl._run_merged([req_a, req_b])
+        assert req_a.error is None and req_b.error is None
+        assert req_a.event.is_set() and req_b.event.is_set()
+        rx = wl.index.find_record_by_id("crm__x")
+        ry = wl.index.find_record_by_id("crm__y")
+        assert rx is not None and not rx.is_deleted()
+        assert ry is not None and ry.is_deleted()
+    finally:
+        wl.close()
+
+
+def test_oversized_post_answers_413(server_url, monkeypatch):
+    """Bodies over MAX_REQUEST_BYTES are refused before being read into
+    memory (the reference rides Jetty's request limits — App.java:649; the
+    stdlib server needs an explicit cap)."""
+    monkeypatch.setenv("MAX_REQUEST_BYTES", "1024")
+    big = json.dumps([{"_id": "big", "name": "x" * 4096}]).encode()
+    status, _, body = request(server_url + "/deduplication/people/crm", "POST",
+                              big, {"Content-Type": "application/json"})
+    assert status == 413 and b"MAX_REQUEST_BYTES" in body
+    # under the limit still works
+    ok = json.dumps([{"_id": "ok", "name": "fits"}]).encode()
+    status, _, _ = request(server_url + "/deduplication/people/crm", "POST",
+                           ok, {"Content-Type": "application/json"})
+    assert status == 200
