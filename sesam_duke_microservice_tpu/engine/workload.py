@@ -264,24 +264,41 @@ class Workload:
 
     # -- incremental feed (call with self.lock held) ------------------------
 
+    def _link_row(self, link) -> dict:
+        """One feed row (wire format per App.java:744-770)."""
+        r1 = self.index.find_record_by_id(link.id1)
+        r2 = self.index.find_record_by_id(link.id2)
+        return {
+            "_id": f"{link.id1}_{link.id2}".replace(":", "_"),
+            "_updated": link.timestamp,
+            "_deleted": link.status == LinkStatus.RETRACTED,
+            "entity1": r1.get_value(ORIGINAL_ENTITY_ID_PROPERTY_NAME) if r1 else None,
+            "entity2": r2.get_value(ORIGINAL_ENTITY_ID_PROPERTY_NAME) if r2 else None,
+            "dataset1": r1.get_value(DATASET_ID_PROPERTY_NAME) if r1 else None,
+            "dataset2": r2.get_value(DATASET_ID_PROPERTY_NAME) if r2 else None,
+            "confidence": link.confidence,
+        }
+
     def links_since(self, since: int = 0) -> List[dict]:
-        rows = []
-        for link in self.link_database.get_changes_since(since):
-            r1 = self.index.find_record_by_id(link.id1)
-            r2 = self.index.find_record_by_id(link.id2)
-            rows.append(
-                {
-                    "_id": f"{link.id1}_{link.id2}".replace(":", "_"),
-                    "_updated": link.timestamp,
-                    "_deleted": link.status == LinkStatus.RETRACTED,
-                    "entity1": r1.get_value(ORIGINAL_ENTITY_ID_PROPERTY_NAME) if r1 else None,
-                    "entity2": r2.get_value(ORIGINAL_ENTITY_ID_PROPERTY_NAME) if r2 else None,
-                    "dataset1": r1.get_value(DATASET_ID_PROPERTY_NAME) if r1 else None,
-                    "dataset2": r2.get_value(DATASET_ID_PROPERTY_NAME) if r2 else None,
-                    "confidence": link.confidence,
-                }
-            )
-        return rows
+        return [
+            self._link_row(link)
+            for link in self.link_database.get_changes_since(since)
+        ]
+
+    def links_page(self, since: int, limit: int):
+        """One bounded feed page: (rows, next_cursor).
+
+        The HTTP layer streams a large ``?since=`` poll as a sequence of
+        these pages, re-taking the workload lock per page so a
+        multi-million-link backlog never holds the lock for the whole
+        response (the reference holds its lock across the entire row loop,
+        App.java:827-874).  ``next_cursor`` is the last row's timestamp
+        (strictly-greater-than feed semantics); an empty ``rows`` means the
+        feed is drained."""
+        links = self.link_database.get_changes_page(since, limit)
+        if not links:
+            return [], since
+        return [self._link_row(l) for l in links], links[-1].timestamp
 
     def save_corpus_snapshot(self) -> None:
         """Persist the device-corpus snapshot (no-op for host backends).

@@ -119,6 +119,25 @@ class LinkDatabase:
     def get_changes_since(self, since: int) -> List[Link]:
         raise NotImplementedError
 
+    def get_changes_page(self, since: int, limit: int) -> List[Link]:
+        """First ``limit`` changes after ``since`` in (timestamp, id1, id2)
+        order — EXTENDED to include every further link sharing the page's
+        final timestamp, so a caller paging with ``since = page[-1]
+        .timestamp`` never skips a tied row.  Timestamps are unique for
+        links written by this process (links.base.now_millis is strictly
+        monotonic), so the extension only triggers on data imported from
+        elsewhere.  Backends override with a bounded query; this default
+        keeps tiny custom backends working (it materializes the full
+        tail)."""
+        changes = self.get_changes_since(since)
+        if limit <= 0 or len(changes) <= limit:
+            return changes
+        cut = limit
+        last_ts = changes[limit - 1].timestamp
+        while cut < len(changes) and changes[cut].timestamp == last_ts:
+            cut += 1
+        return changes[:cut]
+
     def commit(self) -> None:
         pass
 

@@ -7,7 +7,8 @@ the timestamp, so pollers don't see spurious changes.
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+import bisect
+from typing import Dict, List, Optional, Tuple
 
 from .base import Link, LinkDatabase, is_same_assertion
 
@@ -15,12 +16,22 @@ from .base import Link, LinkDatabase, is_same_assertion
 class InMemoryLinkDatabase(LinkDatabase):
     def __init__(self):
         self._links: Dict[Tuple[str, str], Link] = {}
+        # timestamp-ordered view, built lazily and invalidated on writes so
+        # paging a large feed costs one sort total, not one per page
+        self._sorted: Optional[List[Link]] = None
 
     def assert_link(self, link: Link) -> None:
         old = self._links.get(link.key())
+        if old is link:
+            # caller mutated the stored object in place (retract() then
+            # re-assert, the workload's deletion flow) — the ordered view
+            # is stale even though the dict entry is unchanged
+            self._sorted = None
+            return
         if old is not None and is_same_assertion(old, link):
             return
         self._links[link.key()] = link
+        self._sorted = None
 
     def get_all_links_for(self, record_id: str) -> List[Link]:
         return [
@@ -38,10 +49,28 @@ class InMemoryLinkDatabase(LinkDatabase):
     def get_all_links(self) -> List[Link]:
         return list(self._links.values())
 
+    def _ordered(self) -> List[Link]:
+        if self._sorted is None:
+            self._sorted = sorted(
+                self._links.values(),
+                key=lambda l: (l.timestamp, l.id1, l.id2),
+            )
+        return self._sorted
+
     def get_changes_since(self, since: int) -> List[Link]:
-        # linear timestamp scan (SinceAwareInMemoryLinkDatabase.java:33-41),
+        # timestamp order (SinceAwareInMemoryLinkDatabase.java:33-41),
         # strictly-greater-than semantics
-        return sorted(
-            (l for l in self._links.values() if l.timestamp > since),
-            key=lambda l: (l.timestamp, l.id1, l.id2),
-        )
+        ordered = self._ordered()
+        start = bisect.bisect_right(ordered, since, key=lambda l: l.timestamp)
+        return ordered[start:]
+
+    def get_changes_page(self, since: int, limit: int) -> List[Link]:
+        ordered = self._ordered()
+        start = bisect.bisect_right(ordered, since, key=lambda l: l.timestamp)
+        if limit <= 0 or start + limit >= len(ordered):
+            return ordered[start:]
+        cut = start + limit
+        last_ts = ordered[cut - 1].timestamp
+        while cut < len(ordered) and ordered[cut].timestamp == last_ts:
+            cut += 1
+        return ordered[start:cut]

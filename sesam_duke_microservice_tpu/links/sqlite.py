@@ -107,5 +107,29 @@ class SqliteLinkDatabase(LinkDatabase):
         )
         return [self._row_to_link(r) for r in cur.fetchall()]
 
+    def get_changes_page(self, since: int, limit: int) -> List[Link]:
+        if limit <= 0:
+            return self.get_changes_since(since)
+        conn = self._conn()
+        cur = conn.execute(
+            "SELECT id1, id2, status, kind, confidence, timestamp FROM links "
+            "WHERE timestamp > ? ORDER BY timestamp, id1, id2 LIMIT ?",
+            (since, limit),
+        )
+        page = [self._row_to_link(r) for r in cur.fetchall()]
+        if len(page) == limit:
+            # extend over timestamp ties at the page edge (see base): the
+            # next page's strictly-greater cursor must not skip tied rows
+            last = page[-1]
+            cur = conn.execute(
+                "SELECT id1, id2, status, kind, confidence, timestamp "
+                "FROM links WHERE timestamp = ? ORDER BY id1, id2",
+                (last.timestamp,),
+            )
+            for r in cur.fetchall():
+                if (r[0], r[1]) > (last.id1, last.id2):
+                    page.append(self._row_to_link(r))
+        return page
+
     def close(self) -> None:
         self._pool.close()

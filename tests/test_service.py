@@ -524,3 +524,135 @@ def test_oversized_post_answers_413(server_url, monkeypatch):
     status, _, _ = request(server_url + "/deduplication/people/crm", "POST",
                            ok, {"Content-Type": "application/json"})
     assert status == 200
+
+
+def test_feed_streams_in_pages_with_bounded_lock_hold():
+    """VERDICT r2 #2: a ?since=0 poll over a million-link backlog must
+    stream in pages, never holding the workload lock longer than ~100 ms
+    and never materializing every row at once."""
+    import os
+
+    from sesam_duke_microservice_tpu.engine.workload import build_workload
+    from sesam_duke_microservice_tpu.links.base import (
+        Link,
+        LinkKind,
+        LinkStatus,
+    )
+
+    saved = os.environ.get("MIN_RELEVANCE")
+    os.environ["MIN_RELEVANCE"] = "0.05"
+    try:
+        sc = parse_config(CONFIG_XML)
+    finally:
+        if saved is None:
+            os.environ.pop("MIN_RELEVANCE", None)
+        else:
+            os.environ["MIN_RELEVANCE"] = saved
+    app = DukeApp(sc, persistent=False)
+    wl = app.deduplications["people"]
+    # seed 1M links straight into the link DB (the feed path under test
+    # is link fetch + row resolution, not matching)
+    n_links = 1_000_000
+    linkdb = wl.link_database
+    base_ts = 1_700_000_000_000
+    for i in range(n_links):
+        linkdb.assert_link(Link(f"crm__a{i}", f"web__b{i}",
+                                LinkStatus.INFERRED, LinkKind.DUPLICATE,
+                                0.9, timestamp=base_ts + i))
+
+    # instrument the workload lock to record hold durations
+    real_lock = wl.lock
+    holds = []
+
+    class TimedLock:
+        def acquire(self, timeout=None):
+            ok = (real_lock.acquire(timeout=timeout)
+                  if timeout is not None else real_lock.acquire())
+            if ok:
+                self._t0 = time.monotonic()
+            return ok
+
+        def release(self):
+            holds.append(time.monotonic() - self._t0)
+            real_lock.release()
+
+        def __enter__(self):
+            self.acquire()
+            return self
+
+        def __exit__(self, *exc):
+            self.release()
+
+    wl.lock = TimedLock()
+    server = serve(app, port=0, host="127.0.0.1")
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        # stream-read the response; count rows without building one string
+        rows = 0
+        last = b""
+        tail = b""   # marker can straddle a read boundary
+        marker = b'"_id"'
+        with urllib.request.urlopen(url + "/deduplication/people?since=0",
+                                    timeout=600) as resp:
+            assert resp.headers.get("Content-Length") is None  # chunked
+            while True:
+                chunk = resp.read(1 << 20)
+                if not chunk:
+                    break
+                window = tail + chunk
+                rows += window.count(marker) - tail.count(marker)
+                tail = window[-(len(marker) - 1):]
+                last = chunk[-2:] if len(chunk) >= 2 else last + chunk
+        assert rows == n_links
+        assert last.endswith(b"]")
+        assert len(holds) >= n_links // 5000  # actually paged
+        # a full materialization would hold the lock for many seconds at
+        # 1M links; generous bound so scheduler noise on shared CI can't
+        # flake a single page over it
+        assert max(holds) < 2.0, f"lock held {max(holds):.3f}s"
+        # the VERDICT target: pages hold the lock <100ms (median is robust
+        # to isolated preemption stalls)
+        import statistics
+        assert statistics.median(holds) < 0.1
+    finally:
+        server.shutdown()
+        app.close()
+
+
+def test_feed_pages_do_not_skip_or_duplicate_ties(server_url):
+    """Paging cursor is strictly-greater-than on timestamp; rows created
+    with colliding timestamps (imported data) must neither drop nor
+    duplicate across a page boundary."""
+    import os
+
+    from sesam_duke_microservice_tpu.links.base import (
+        Link,
+        LinkKind,
+        LinkStatus,
+    )
+    from sesam_duke_microservice_tpu.links.memory import InMemoryLinkDatabase
+    from sesam_duke_microservice_tpu.links.sqlite import SqliteLinkDatabase
+    import tempfile
+
+    ts = 1_600_000_000_000
+    mem = InMemoryLinkDatabase()
+    with tempfile.TemporaryDirectory() as tmp:
+        dbs = [mem, SqliteLinkDatabase(os.path.join(tmp, "l.sqlite"))]
+        for db in dbs:
+            # 7 links share one timestamp; page size 3 forces tie extension
+            for i in range(7):
+                db.assert_link(Link(f"x{i}", f"y{i}", LinkStatus.INFERRED,
+                                    LinkKind.DUPLICATE, 0.9, timestamp=ts))
+            db.assert_link(Link("x9", "y9", LinkStatus.INFERRED,
+                                LinkKind.DUPLICATE, 0.9, timestamp=ts + 5))
+            seen = []
+            cursor = 0
+            while True:
+                page = db.get_changes_page(cursor, 3)
+                if not page:
+                    break
+                seen.extend((l.id1, l.id2) for l in page)
+                cursor = page[-1].timestamp
+            assert len(seen) == len(set(seen)) == 8
