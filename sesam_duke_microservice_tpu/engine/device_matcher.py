@@ -104,6 +104,10 @@ class DeviceCorpus:
     def __init__(self, plan, values_per_record: int):
         self.plan = plan
         self.v = values_per_record
+        # capacity growth granule: scan-chunk multiples; the sharded
+        # corpus raises this to mesh.size * chunk so every shard always
+        # holds whole chunks
+        self.granule = _CHUNK
         self.capacity = 0
         self.size = 0
         self.feats: Dict[str, Dict[str, np.ndarray]] = {}
@@ -119,13 +123,19 @@ class DeviceCorpus:
 
     # -- growth --------------------------------------------------------------
 
-    def _grow(self, needed: int) -> None:
-        cap = max(self.capacity, _CHUNK)
+    def _target_capacity(self, needed: int) -> int:
+        """Doubling growth in ``self.granule`` multiples (one copy of the
+        growth policy for the single-device and sharded corpora)."""
+        g = self.granule
+        cap = max(self.capacity, g)
         if _INITIAL_CAPACITY > 0:
-            presized = -(-_INITIAL_CAPACITY // _CHUNK) * _CHUNK
-            cap = max(cap, presized)
+            cap = max(cap, -(-_INITIAL_CAPACITY // g) * g)
         while cap < needed:
             cap *= 2
+        return cap
+
+    def _grow(self, needed: int) -> None:
+        cap = self._target_capacity(needed)
         if cap == self.capacity:
             return
         self.row_valid = _grow_1d(self.row_valid, cap, False)
@@ -182,6 +192,18 @@ class DeviceCorpus:
 
     # -- device mirror -------------------------------------------------------
 
+    def _place(self, arr: np.ndarray):
+        """Host array -> device array; the sharded corpus overrides with
+        record-axis-sharded placement over its mesh."""
+        import jax.numpy as jnp
+
+        return jnp.asarray(arr)
+
+    def _updater(self):
+        """The jitted whole-tree incremental updater to use; the sharded
+        corpus overrides with a sharding-constrained variant."""
+        return _tree_updater()
+
     def device_arrays(self):
         """(feats, valid, deleted, group) as device arrays.
 
@@ -192,11 +214,9 @@ class DeviceCorpus:
         always refreshed wholesale — tombstones touch arbitrary rows and
         the arrays are tiny next to the feature tensors.
         """
-        import jax.numpy as jnp
-
         if self._device is None or self._dirty_full:
             self._device = {
-                prop: {name: jnp.asarray(arr) for name, arr in tensors.items()}
+                prop: {name: self._place(arr) for name, arr in tensors.items()}
                 for prop, tensors in self.feats.items()
             }
             self._pending_update = None
@@ -219,15 +239,15 @@ class DeviceCorpus:
                 }
                 for prop, tensors in self.feats.items()
             }
-            self._device = _tree_updater()(
+            self._device = self._updater()(
                 self._device, upd, np.int32(start)
             )
             self._pending_update = None
         if self._mask_device is None or self._dirty_masks:
             self._mask_device = (
-                jnp.asarray(self.row_valid),
-                jnp.asarray(self.row_deleted),
-                jnp.asarray(self.row_group),
+                self._place(self.row_valid),
+                self._place(self.row_deleted),
+                self._place(self.row_group),
             )
             self._dirty_masks = False
         valid, deleted, group = self._mask_device
@@ -325,7 +345,7 @@ class DeviceIndex(CandidateIndex):
                 "with a device kernel (all configured comparators are "
                 "host-only); use the host backend for this schema"
             )
-        self.corpus = DeviceCorpus(self.plan, v)
+        self.corpus = self._make_corpus(self.plan, v)
         self.records: Dict[str, Record] = {}     # id -> live record
         # O(1) live count (non-dukeDeleted records) for /stats — counting
         # by iterating ``records`` would need the workload lock for the
@@ -337,6 +357,11 @@ class DeviceIndex(CandidateIndex):
         self._lock = threading.Lock()
         self._scorer_cache: Optional["_ScorerCache"] = None
         self._cap_warned: set = set()
+
+    def _make_corpus(self, plan, values_per_record: int) -> DeviceCorpus:
+        """Corpus factory (used at construction AND value-slot rebuild);
+        the sharded index overrides with its mesh-placed corpus."""
+        return DeviceCorpus(plan, values_per_record)
 
     @property
     def scorer_cache(self) -> "_ScorerCache":
@@ -472,7 +497,7 @@ class DeviceIndex(CandidateIndex):
         """
         with self._lock:
             old_records = self.records
-            self.corpus = DeviceCorpus(
+            self.corpus = self._make_corpus(
                 self.plan, max((s.v for s in self.plan.device_props), default=1)
             )
             self.id_to_row = {}
@@ -739,6 +764,12 @@ class _ScorerCache:
     """Builds/caches jitted scorers per (top_k, group_filtering) and runs the
     exact K-escalation loop."""
 
+    # Indexed-query batches normally gather their features on device from
+    # the corpus rows (only the row-index array crosses the host->device
+    # link).  The sharded cache disables this: a cross-shard gather inside
+    # shard_map would need collectives, so sharded queries upload replicated.
+    queries_from_rows = True
+
     def __init__(self, index: DeviceIndex):
         self.index = index
         self._scorers: Dict[Tuple[int, bool], object] = {}
@@ -916,7 +947,7 @@ class _ScorerCache:
         bucket = _bucket_for(len(records))
         # (a block larger than the biggest bucket is split by the caller)
         rows = [index.id_to_row.get(r.record_id, -1) for r in records]
-        from_rows = all(row >= 0 for row in rows)
+        from_rows = self.queries_from_rows and all(row >= 0 for row in rows)
         if from_rows:
             # normal dedup/linkage path: the batch was just indexed, so its
             # features already sit on device in the corpus tensors — the

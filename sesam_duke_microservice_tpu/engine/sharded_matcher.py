@@ -1,0 +1,251 @@
+"""Mesh-sharded serving backends: the REST service over a device mesh.
+
+Round 2 left the mesh machinery (``parallel/sharded.py``,
+``parallel/ann_sharded.py``) as a library with no consumer in the service:
+``build_workload`` could only construct single-device backends, so a v5e-8
+deployment could not serve HTTP from a sharded corpus.  This module closes
+that gap — the reference wires its matcher straight into the request
+handlers (App.java:343-345,1005); here the same wiring scales to a
+``jax.sharding.Mesh``:
+
+  * ``ShardedDeviceCorpus`` keeps the exact append/tombstone/incremental-
+    update model of ``DeviceCorpus`` (host numpy mirror as rebuildable
+    truth) but places every device tensor record-axis sharded over the
+    mesh, with capacity aligned to ``mesh.size * chunk`` granules so each
+    shard holds whole scan chunks;
+  * ``ShardedAnnIndex`` / ``ShardedDeviceIndex`` are the ANN and exact
+    brute-force blocking backends over that corpus — snapshots, value-slot
+    growth, delete/tombstone and the ``CandidateIndex`` interface are all
+    inherited unchanged;
+  * the scorer caches swap the single-device programs for the shard_map
+    ones: per-shard retrieval/scan with global row offsets, local exact
+    rescoring, and an ``all_gather`` top-K merge over ICI — communication
+    is O(Q * K * D) while compute scales 1/D (SURVEY.md section 5.7).
+
+Queries are replicated (uploaded per block, never gathered cross-shard),
+escalation loops (K for brute force, C for ANN recall) run unchanged
+through ``_PendingBlock``/``resolve_block``, and host finalization is the
+same double-precision path — so emitted probabilities are bit-identical to
+the single-chip backends (differential-tested in
+``tests/test_sharded_service.py`` on the virtual 8-device mesh).
+
+Deployment: single-host this shards over local devices; multi-host, call
+``parallel.multihost.initialize()`` first (build_workload does) and the
+record axis spans every chip in the job, with the merge collective riding
+ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..core.config import DukeSchema, MatchTunables
+from ..ops import encoder as E
+from .ann_matcher import AnnIndex, AnnProcessor, _AnnScorerCache
+from .device_matcher import (
+    DeviceCorpus,
+    DeviceIndex,
+    DeviceProcessor,
+    _CHUNK,
+    _ScorerCache,
+)
+
+logger = logging.getLogger("sharded-matcher")
+
+_MESH_LOCK = threading.Lock()
+_MESH = None
+
+
+def serving_mesh():
+    """The process-wide 1-D corpus mesh the sharded backends serve from.
+
+    Joins the multi-host job first when one is configured (no-op
+    otherwise), then builds the mesh over every global device — one mesh
+    for all workloads, so hot config reloads don't re-initialize
+    distributed state.
+    """
+    global _MESH
+    with _MESH_LOCK:
+        if _MESH is None:
+            from ..parallel import multihost
+
+            multihost.initialize()
+            _MESH = multihost.global_corpus_mesh()
+            logger.info(
+                "serving mesh: %d device(s), axis %r",
+                _MESH.size, _MESH.axis_names,
+            )
+        return _MESH
+
+
+class ShardedDeviceCorpus(DeviceCorpus):
+    """``DeviceCorpus`` whose device mirror is record-axis sharded.
+
+    Capacity grows in ``mesh.size * chunk`` granules (each shard always
+    holds whole scan chunks — required by the shard_map scorers' local
+    ``row_offset`` arithmetic); placement and the incremental tree updater
+    carry explicit shardings so the arrays never silently collapse to a
+    single device.
+    """
+
+    def __init__(self, plan, values_per_record: int, mesh):
+        from ..parallel.sharded import LeadingAxisPlacer
+
+        super().__init__(plan, values_per_record)
+        self.mesh = mesh
+        # ONE copy of the sharding/granule conventions: the same placer
+        # machinery parallel/sharded.py and parallel/ring.py use
+        self._placer = LeadingAxisPlacer(mesh, mesh.size * _CHUNK)
+        self.granule = self._placer.granule
+        self._updater_fn = None
+
+    def _sharding(self, ndim: int):
+        return self._placer._sharding(ndim)
+
+    def _place(self, arr):
+        import jax
+
+        return jax.device_put(arr, self._sharding(arr.ndim))
+
+    def _updater(self):
+        """Sharding-constrained incremental updater: the global-row update
+        slice lands on whichever shard owns those rows, and the outputs are
+        pinned back to the record sharding so a commit can never migrate
+        the corpus off the mesh."""
+        if self._updater_fn is None:
+            import jax
+            from jax import lax
+
+            def update_tree(dev, upd, start):
+                out = jax.tree_util.tree_map(
+                    lambda d, u: lax.dynamic_update_slice_in_dim(
+                        d, u, start, axis=0
+                    ),
+                    dev, upd,
+                )
+                return jax.tree_util.tree_map(
+                    lambda a: lax.with_sharding_constraint(
+                        a, self._sharding(a.ndim)
+                    ),
+                    out,
+                )
+
+            self._updater_fn = jax.jit(update_tree, donate_argnums=(0,))
+        return self._updater_fn
+
+
+class _ShardedScorerCache(_ScorerCache):
+    """Brute-force scorer cache over the mesh (parallel.sharded program)."""
+
+    queries_from_rows = False
+
+    def _build(self, top_k: int, group_filtering: bool, from_rows: bool):
+        from ..parallel.sharded import build_sharded_scorer
+
+        # signature matches the single-device from_rows=False scorer:
+        # fn(qfeats, cfeats, valid, deleted, group, qgroup, qrow, min_logit)
+        return build_sharded_scorer(
+            self.index.plan, self.index.mesh, chunk=_CHUNK, top_k=top_k,
+            group_filtering=group_filtering,
+        )
+
+    def prewarm_async(self, group_filtering: bool) -> None:
+        # the shard_map programs need mesh-aware lowering shapes; until a
+        # sharded prewarm ladder exists, first-contact compiles (cached in
+        # the persistent XLA cache) are the cost of this backend
+        return
+
+
+class _ShardedAnnScorerCache(_AnnScorerCache):
+    """ANN scorer cache over the mesh (parallel.ann_sharded program)."""
+
+    queries_from_rows = False
+
+    def _build(self, top_c: int, group_filtering: bool, from_rows: bool):
+        from ..parallel.ann_sharded import build_sharded_ann_scorer
+
+        base = build_sharded_ann_scorer(
+            self.index.plan, self.index.mesh, chunk=_CHUNK, top_c=top_c,
+            group_filtering=group_filtering,
+        )
+
+        # adapt to the single-device ANN call convention (embedding matrix
+        # carried separately): reassemble the corpus feature tree the
+        # sharded program expects (embedding riding as a pseudo-property)
+        def call(q_emb, qfeats, corpus_emb, corpus_feats, cvalid, cdeleted,
+                 cgroup, query_group, query_row, min_logit):
+            cfeats = dict(corpus_feats)
+            cfeats[E.ANN_PROP] = {E.ANN_TENSOR: corpus_emb}
+            return base(q_emb, qfeats, cfeats, cvalid, cdeleted, cgroup,
+                        query_group, query_row, min_logit)
+
+        return call
+
+    def prewarm_async(self, group_filtering: bool) -> None:
+        return  # see _ShardedScorerCache.prewarm_async
+
+
+class ShardedDeviceIndex(DeviceIndex):
+    """Exact brute-force blocking over a record-axis-sharded corpus."""
+
+    def __init__(self, schema: DukeSchema, *,
+                 tunables: Optional[MatchTunables] = None,
+                 values_per_record: Optional[int] = None,
+                 mesh=None):
+        # the corpus factory runs inside super().__init__
+        self.mesh = mesh if mesh is not None else serving_mesh()
+        super().__init__(
+            schema, tunables=tunables, values_per_record=values_per_record
+        )
+
+    def _make_corpus(self, plan, values_per_record: int):
+        return ShardedDeviceCorpus(plan, values_per_record, self.mesh)
+
+    @property
+    def scorer_cache(self) -> _ShardedScorerCache:
+        if self._scorer_cache is None:
+            self._scorer_cache = _ShardedScorerCache(self)
+        return self._scorer_cache
+
+
+class ShardedAnnIndex(AnnIndex):
+    """Embedding-ANN blocking over a record-axis-sharded corpus.
+
+    The flagship scale configuration (BASELINE.json configs[4]): corpus
+    embeddings and feature tensors shard over the mesh, per-shard cosine
+    top-C + local exact rescoring, all_gather merge.  Everything else —
+    encoder, snapshots, recall escalation semantics — is ``AnnIndex``.
+    """
+
+    def __init__(self, schema: DukeSchema, *,
+                 tunables: Optional[MatchTunables] = None,
+                 values_per_record: Optional[int] = None,
+                 mesh=None, **kwargs):
+        self.mesh = mesh if mesh is not None else serving_mesh()
+        super().__init__(
+            schema, tunables=tunables, values_per_record=values_per_record,
+            **kwargs,
+        )
+
+    def _make_corpus(self, plan, values_per_record: int):
+        return ShardedDeviceCorpus(plan, values_per_record, self.mesh)
+
+    @property
+    def scorer_cache(self) -> _ShardedAnnScorerCache:
+        if self._scorer_cache is None:
+            self._scorer_cache = _ShardedAnnScorerCache(self)
+        return self._scorer_cache
+
+
+class ShardedDeviceProcessor(DeviceProcessor):
+    """DeviceProcessor over a ShardedDeviceIndex (exhaustive stats)."""
+
+    exhaustive = True
+
+
+class ShardedAnnProcessor(AnnProcessor):
+    """AnnProcessor over a ShardedAnnIndex (rescored-candidate stats)."""
+
+    exhaustive = False

@@ -339,9 +339,12 @@ def build_workload(wc: WorkloadConfig, sc: ServiceConfig, *,
 
     ``backend``: 'host' (inverted index + scalar scoring — the conformance/
     baseline path), 'device' (TPU-resident corpus + batched kernels, exact
-    brute-force blocking, see engine.device_matcher), or 'ann' (embedding
+    brute-force blocking, see engine.device_matcher), 'ann' (embedding
     cosine blocking + exact rescoring, see engine.ann_matcher — for corpora
-    where brute force stops being free).
+    where brute force stops being free), 'sharded' (the ANN backend over a
+    jax.sharding.Mesh — record-axis-sharded corpus, all_gather top-K merge;
+    the v5e-8 / multi-host serving configuration, engine.sharded_matcher),
+    or 'sharded-brute' (exact brute force over the same mesh).
     """
     group_filtering = wc.is_record_linkage
     if backend == "device":
@@ -356,6 +359,23 @@ def build_workload(wc: WorkloadConfig, sc: ServiceConfig, *,
 
         index = AnnIndex(wc.duke, tunables=sc.tunables)
         processor = AnnProcessor(
+            wc.duke, index, group_filtering=group_filtering, profile=sc.profile
+        )
+    elif backend == "sharded":
+        from .sharded_matcher import ShardedAnnIndex, ShardedAnnProcessor
+
+        index = ShardedAnnIndex(wc.duke, tunables=sc.tunables)
+        processor = ShardedAnnProcessor(
+            wc.duke, index, group_filtering=group_filtering, profile=sc.profile
+        )
+    elif backend == "sharded-brute":
+        from .sharded_matcher import (
+            ShardedDeviceIndex,
+            ShardedDeviceProcessor,
+        )
+
+        index = ShardedDeviceIndex(wc.duke, tunables=sc.tunables)
+        processor = ShardedDeviceProcessor(
             wc.duke, index, group_filtering=group_filtering, profile=sc.profile
         )
     else:

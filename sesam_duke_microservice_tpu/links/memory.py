@@ -14,24 +14,62 @@ from .base import Link, LinkDatabase, is_same_assertion
 
 
 class InMemoryLinkDatabase(LinkDatabase):
+    _SORT_KEY = staticmethod(lambda l: (l.timestamp, l.id1, l.id2))
+
     def __init__(self):
         self._links: Dict[Tuple[str, str], Link] = {}
-        # timestamp-ordered view, built lazily and invalidated on writes so
-        # paging a large feed costs one sort total, not one per page
+        # timestamp-ordered view, built lazily and maintained INCREMENTALLY
+        # on writes: new links carry a fresh (strictly monotonic) timestamp
+        # so they append at the tail, replaced/mutated links are removed
+        # first.  Keeping the view live matters for the streaming feed —
+        # invalidating on every write would make each page of a paged
+        # GET ?since= re-sort the whole set under the workload lock
+        # whenever ingest interleaves with paging.
         self._sorted: Optional[List[Link]] = None
+
+    def _append_sorted(self, link: Link) -> None:
+        s = self._sorted
+        key = self._SORT_KEY
+        if s and key(s[-1]) > key(link):
+            # out-of-order write (explicit historical timestamp, e.g.
+            # imported data): insert at the right position
+            bisect.insort(s, link, key=key)
+        else:
+            s.append(link)
+
+    def _remove_sorted(self, old: Link) -> None:
+        s = self._sorted
+        # fast path: locate by sort key (valid while the object is
+        # unmutated) and confirm identity
+        i = bisect.bisect_left(s, self._SORT_KEY(old), key=self._SORT_KEY)
+        if i < len(s) and s[i] is old:
+            del s[i]
+            return
+        # mutated in place (retract() bumped the timestamp before this
+        # call): C-speed identity scan — Link defines no __eq__
+        try:
+            s.remove(old)
+        except ValueError:
+            self._sorted = None  # unseen object; rebuild lazily
 
     def assert_link(self, link: Link) -> None:
         old = self._links.get(link.key())
         if old is link:
             # caller mutated the stored object in place (retract() then
-            # re-assert, the workload's deletion flow) — the ordered view
-            # is stale even though the dict entry is unchanged
-            self._sorted = None
+            # re-assert, the workload's deletion flow): re-position it
+            if self._sorted is not None:
+                self._remove_sorted(link)
+                if self._sorted is not None:
+                    self._append_sorted(link)
             return
         if old is not None and is_same_assertion(old, link):
             return
         self._links[link.key()] = link
-        self._sorted = None
+        if self._sorted is not None:
+            if old is not None:
+                self._remove_sorted(old)
+            if self._sorted is not None:
+                self._append_sorted(link)
 
     def get_all_links_for(self, record_id: str) -> List[Link]:
         return [

@@ -68,6 +68,25 @@ def initialize(coordinator_address: Optional[str] = None,
                     jax.process_index(), jax.process_count(),
                 )
                 return True
+            except (RuntimeError, ValueError) as e:
+                if ("before any JAX calls" in str(e)
+                        or "coordinator_address" in str(e)):
+                    # the backend is already up (tests, embedding apps) or
+                    # the TPU metadata carries no coordinator (single-host
+                    # axon) — normal single-process situations, not errors
+                    logger.info(
+                        "distributed auto-detect skipped (%s); continuing "
+                        "single-process", e,
+                    )
+                else:
+                    # coordinator unreachable / barrier timeout etc. also
+                    # surface as RuntimeError — on a real multi-host job a
+                    # silent local-only mesh would serve partial-corpus
+                    # results, so keep the loud path
+                    logger.exception(
+                        "distributed auto-detect failed; continuing "
+                        "single-process"
+                    )
             except Exception:
                 logger.exception(
                     "distributed auto-detect failed; continuing single-process"

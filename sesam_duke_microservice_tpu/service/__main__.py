@@ -17,7 +17,9 @@ def main() -> None:
     parser.add_argument("--port", type=int,
                         default=int(os.environ.get("PORT", DEFAULT_PORT)))
     parser.add_argument("--host", default="0.0.0.0")
-    parser.add_argument("--backend", choices=["host", "device", "ann"],
+    parser.add_argument("--backend",
+                        choices=["host", "device", "ann", "sharded",
+                                 "sharded-brute"],
                         default=os.environ.get("DUKE_TPU_BACKEND", "host"))
     parser.add_argument("--ephemeral", action="store_true",
                         help="keep all state in memory (no data folder writes)")
@@ -27,7 +29,7 @@ def main() -> None:
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
     )
-    if args.backend in ("device", "ann"):
+    if args.backend in ("device", "ann", "sharded", "sharded-brute"):
         from ..utils.jit_cache import enable_persistent_cache
 
         enable_persistent_cache()

@@ -443,7 +443,16 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                 workload = self._workloads(kind).get(name)
                 if workload is None:
                     if started:
-                        break  # config reload removed the workload mid-stream
+                        # config reload removed the workload mid-stream: a
+                        # clean ']' would make the truncated feed look
+                        # complete — kill the chunked framing instead so
+                        # the client sees a protocol error
+                        logger.warning(
+                            "Aborting %s feed stream: workload removed "
+                            "by config reload mid-stream", name,
+                        )
+                        self.close_connection = True
+                        return
                     raise _HttpError(
                         400,
                         f"Unknown {label} '{name}'! (All {label}s must be "

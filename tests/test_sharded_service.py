@@ -1,0 +1,295 @@
+"""Mesh-sharded serving backends on the virtual 8-device mesh.
+
+VERDICT r2 #1: the sharded scorers must be reachable from the REST
+service, with results equal to the single-chip backends.  These tests
+build real workloads with ``backend="sharded"`` / ``"sharded-brute"``
+(engine.sharded_matcher) and drive them through the same paths the HTTP
+handlers use — plus one end-to-end HTTP server test over the sharded
+backend.
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from sesam_duke_microservice_tpu.core.config import parse_config
+from sesam_duke_microservice_tpu.engine.workload import build_workload
+
+DEDUP_XML = """
+<DukeMicroService>
+  <Deduplication name="people" link-database-type="in-memory">
+    <duke>
+      <schema>
+        <threshold>0.8</threshold>
+        <property><name>NAME</name><comparator>levenshtein</comparator><low>0.1</low><high>0.95</high></property>
+        <property><name>EMAIL</name><comparator>exact</comparator><low>0.2</low><high>0.95</high></property>
+      </schema>
+      <data-source class="io.sesam.dukemicroservice.IncrementalDeduplicationDataSource">
+        <param name="dataset-id" value="crm"/>
+        <column name="name" property="NAME"/>
+        <column name="email" property="EMAIL"/>
+      </data-source>
+    </duke>
+  </Deduplication>
+</DukeMicroService>
+"""
+
+LINKAGE_XML = """
+<DukeMicroService>
+  <RecordLinkage name="pairing" link-mode="many-to-many" link-database-type="in-memory">
+    <duke>
+      <schema>
+        <threshold>0.7</threshold>
+        <property><name>NAME</name><comparator>levenshtein</comparator><low>0.1</low><high>0.95</high></property>
+      </schema>
+      <group>
+        <data-source class="io.sesam.dukemicroservice.IncrementalRecordLinkageDataSource">
+          <param name="dataset-id" value="left"/>
+          <column name="name" property="NAME"/>
+        </data-source>
+      </group>
+      <group>
+        <data-source class="io.sesam.dukemicroservice.IncrementalRecordLinkageDataSource">
+          <param name="dataset-id" value="right"/>
+          <column name="name" property="NAME"/>
+        </data-source>
+      </group>
+    </duke>
+  </RecordLinkage>
+</DukeMicroService>
+"""
+
+
+def _seeded_batch(n, prefix=""):
+    """Deterministic names with a known duplicate structure: every third
+    record repeats the previous name (so i and i-1 match), the rest are
+    distinct."""
+    rows = []
+    for i in range(n):
+        if i % 3 == 2:
+            name = f"person number {i - 1}"
+        else:
+            name = f"person number {i}"
+        rows.append({
+            "_id": f"{prefix}{i}",
+            "name": name,
+            "email": f"{name.replace(' ', '.')}@x.no",
+        })
+    return rows
+
+
+def _live_links(wl):
+    return sorted(
+        (r["entity1"], r["entity2"], round(r["confidence"], 9))
+        for r in wl.links_since(0) if not r["_deleted"]
+    )
+
+
+def _run_dedup(backend, batches, env=None):
+    sc = parse_config(DEDUP_XML, env=env or {"MIN_RELEVANCE": "0.05"})
+    wl = build_workload(sc.deduplications["people"], sc, backend=backend,
+                        persistent=False)
+    try:
+        with wl.lock:
+            for batch in batches:
+                wl.process_batch("crm", batch)
+            return _live_links(wl)
+    finally:
+        wl.close()
+
+
+@pytest.mark.parametrize("sharded,single", [
+    ("sharded", "ann"),
+    ("sharded-brute", "device"),
+])
+def test_sharded_matches_single_chip_dedup(sharded, single):
+    """Same batches through the mesh backend and its single-chip
+    counterpart produce identical links and confidences."""
+    batches = [_seeded_batch(24), _seeded_batch(12, prefix="b")]
+    assert _run_dedup(sharded, batches) == _run_dedup(single, batches)
+    # sanity: the corpus actually produced links
+    assert len(_run_dedup(sharded, batches)) >= 10
+
+
+def test_sharded_linkage_group_exclusion_and_transform():
+    sc = parse_config(LINKAGE_XML, env={"MIN_RELEVANCE": "0.05"})
+    wl = build_workload(sc.record_linkages["pairing"], sc, backend="sharded",
+                        persistent=False)
+    try:
+        with wl.lock:
+            # same name twice in the SAME group: must not link
+            wl.process_batch("left", [
+                {"_id": "a", "name": "Turing"},
+                {"_id": "b", "name": "Turing"},
+            ])
+            assert wl.links_since(0) == []
+            wl.process_batch("right", [{"_id": "c", "name": "Turing"}])
+            keys = {r["_id"] for r in wl.links_since(0)}
+            assert keys == {"1__left__a_2__right__c",
+                            "1__left__b_2__right__c"}
+            # http-transform: side-effect-free probe over the sharded corpus
+            rows = wl.process_batch(
+                "right", [{"_id": "probe", "name": "Turing"}],
+                http_transform=True,
+            )
+            linked = {d["entityId"] for d in rows[0]["duke_links"]}
+            assert linked == {"a", "b"}
+            assert {r["_id"] for r in wl.links_since(0)} == keys
+    finally:
+        wl.close()
+
+
+def test_sharded_delete_retracts_and_tombstones():
+    sc = parse_config(DEDUP_XML, env={"MIN_RELEVANCE": "0.05"})
+    wl = build_workload(sc.deduplications["people"], sc, backend="sharded",
+                        persistent=False)
+    try:
+        with wl.lock:
+            wl.process_batch("crm", [
+                {"_id": "1", "name": "Alan Turing", "email": "a@x.no"},
+                {"_id": "2", "name": "Alan Turing", "email": "a@x.no"},
+            ])
+            assert len(_live_links(wl)) == 1
+            wl.process_batch("crm", [{"_id": "2", "_deleted": True}])
+            assert _live_links(wl) == []
+            # the tombstoned record must stay resolvable for the feed but
+            # never come back as a candidate
+            wl.process_batch("crm", [
+                {"_id": "3", "name": "Alan Turing", "email": "a@x.no"},
+            ])
+            live = _live_links(wl)
+            assert {(e1, e2) for e1, e2, _ in live} == {("1", "3")}
+    finally:
+        wl.close()
+
+
+def test_sharded_value_slot_growth_rebuilds_on_mesh():
+    """Multi-valued records widen the value axis; the rebuilt corpus must
+    stay sharded and keep scoring correctly."""
+    sc = parse_config(DEDUP_XML, env={"MIN_RELEVANCE": "0.05"})
+    wl = build_workload(sc.deduplications["people"], sc, backend="sharded",
+                        persistent=False)
+    try:
+        with wl.lock:
+            wl.process_batch("crm", [
+                {"_id": "1", "name": "Ada Lovelace", "email": "a@x.no"},
+            ])
+            # second value is the matching one: invisible without growth
+            wl.process_batch("crm", [
+                {"_id": "2", "name": ["Zzz Yyy", "Ada Lovelace"],
+                 "email": "a@x.no"},
+            ])
+            live = _live_links(wl)
+        assert {(e1, e2) for e1, e2, _ in live} == {("1", "2")}
+        from sesam_duke_microservice_tpu.parallel.sharded import SHARD_AXIS
+
+        feats, valid, _, _ = wl.index.corpus.device_arrays()
+        assert SHARD_AXIS in str(valid.sharding.spec)
+    finally:
+        wl.close()
+
+
+def test_sharded_snapshot_restart(tmp_path):
+    """Persistent sharded workload: restart restores the corpus from the
+    snapshot onto the mesh and serves identical results."""
+    xml = DEDUP_XML.replace(
+        "<DukeMicroService>", f'<DukeMicroService dataFolder="{tmp_path}">'
+    ).replace('link-database-type="in-memory"', 'link-database-type="h2"')
+    sc = parse_config(xml, env={"MIN_RELEVANCE": "0.05"})
+    wl = build_workload(sc.deduplications["people"], sc, backend="sharded",
+                        persistent=True)
+    with wl.lock:
+        wl.process_batch("crm", _seeded_batch(18))
+        before = _live_links(wl)
+    wl.close()  # saves the snapshot
+
+    from sesam_duke_microservice_tpu.engine.sharded_matcher import (
+        ShardedAnnIndex,
+    )
+
+    real_extract = ShardedAnnIndex._extract
+    calls = []
+
+    def counting_extract(self, records, plan=None):
+        calls.append(len(records))
+        return real_extract(self, records, plan)
+
+    ShardedAnnIndex._extract = counting_extract
+    try:
+        wl2 = build_workload(sc.deduplications["people"], sc,
+                             backend="sharded", persistent=True)
+    finally:
+        ShardedAnnIndex._extract = real_extract
+    try:
+        # restart must come from the snapshot, not per-record re-extraction
+        assert not calls
+        with wl2.lock:
+            assert _live_links(wl2) == before
+            # and the restored corpus keeps serving new batches
+            wl2.process_batch("crm", [
+                {"_id": "again0", "name": "person number 0",
+                 "email": "person.number.0@x.no"},
+            ])
+            after = _live_links(wl2)
+        assert len(after) > len(before)
+    finally:
+        wl2.close()
+
+
+def test_sharded_http_service_end_to_end():
+    """The full REST surface over the sharded backend: POST, feed,
+    transform, /stats."""
+    import os
+
+    from sesam_duke_microservice_tpu.service.app import DukeApp, serve
+
+    saved = os.environ.get("MIN_RELEVANCE")
+    os.environ["MIN_RELEVANCE"] = "0.05"
+    try:
+        app = DukeApp(parse_config(DEDUP_XML), backend="sharded",
+                      persistent=False)
+    finally:
+        if saved is None:
+            os.environ.pop("MIN_RELEVANCE", None)
+        else:
+            os.environ["MIN_RELEVANCE"] = saved
+    server = serve(app, port=0, host="127.0.0.1")
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(payload).encode(), method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return resp.status, json.loads(resp.read())
+
+    try:
+        status, body = post("/deduplication/people/crm", [
+            {"_id": "1", "name": "Alan Turing", "email": "a@x.no"},
+            {"_id": "2", "name": "Alan Turing", "email": "a@x.no"},
+        ])
+        assert (status, body) == (200, {"success": True})
+        with urllib.request.urlopen(
+                base + "/deduplication/people?since=0", timeout=300) as resp:
+            rows = json.loads(resp.read())
+        assert len(rows) == 1
+        assert {rows[0]["entity1"], rows[0]["entity2"]} == {"1", "2"}
+
+        status, body = post("/deduplication/people/crm/httptransform",
+                            {"_id": "p", "name": "Alan Turing",
+                             "email": "a@x.no"})
+        assert status == 200
+        assert {d["entityId"] for d in body["duke_links"]} == {"1", "2"}
+
+        with urllib.request.urlopen(base + "/stats", timeout=60) as resp:
+            stats = json.loads(resp.read())
+        assert stats["backend"] == "sharded"
+        assert stats["workloads"][0]["records_indexed"] == 2
+    finally:
+        server.shutdown()
+        app.close()
