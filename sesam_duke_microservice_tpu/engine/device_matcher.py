@@ -282,21 +282,18 @@ def _tree_updater():
 
 
 def _records_content_hash(records_by_id: Dict[str, Record]) -> str:
-    """Order-independent digest of record ids AND values (snapshot guard)."""
-    import hashlib
+    """Order-independent digest of record ids AND values (snapshot guard).
 
-    h = hashlib.sha256()
-    for rid in sorted(records_by_id):
-        h.update(rid.encode("utf-8", "surrogatepass"))
-        h.update(b"\x00")
-        record = records_by_id[rid]
-        for prop in sorted(record.properties()):
-            h.update(prop.encode("utf-8", "surrogatepass"))
-            h.update(b"\x01")
-            for value in record.get_values(prop):
-                h.update(value.encode("utf-8", "surrogatepass"))
-                h.update(b"\x02")
-    return h.hexdigest()
+    XOR fold of the canonical per-record digests — the same formula the
+    record store (store.records) and the index maintain INCREMENTALLY, so
+    this full rehash is only the fallback for callers without a running
+    hash (direct snapshot_load calls in tests)."""
+    from ..store.records import EMPTY_CONTENT_HASH, record_digest, xor_fold
+
+    acc = EMPTY_CONTENT_HASH
+    for record in records_by_id.values():
+        acc = xor_fold(acc, record_digest(record))
+    return acc.hex()
 
 
 def _grow_1d(arr: np.ndarray, cap: int, fill) -> np.ndarray:
@@ -347,6 +344,14 @@ class DeviceIndex(CandidateIndex):
             )
         self.corpus = self._make_corpus(self.plan, v)
         self.records: Dict[str, Record] = {}     # id -> live record
+        # incremental content digest of ``records`` (same per-record
+        # formula as the store's running hash): snapshot_save stamps THIS
+        # side and snapshot_load compares the STORE side, so index/store
+        # divergence (a store commit whose scoring pass failed) still
+        # forces a replay — at O(1) instead of rehashing the corpus
+        from ..store.records import EMPTY_CONTENT_HASH
+
+        self._content_hash = EMPTY_CONTENT_HASH
         # O(1) live count (non-dukeDeleted records) for /stats — counting
         # by iterating ``records`` would need the workload lock for the
         # whole scan (seconds at 10M rows)
@@ -451,15 +456,22 @@ class DeviceIndex(CandidateIndex):
         )
         ids = [r.record_id for r in records]
         rows = self.corpus.append(feats, deleted, group, ids)
+        from ..store.records import record_digest, xor_fold
+
         delta = 0
+        acc = self._content_hash
         for r, row in zip(records, rows):
             old = self.records.get(r.record_id)
             delta += (
                 (0 if r.is_deleted() else 1)
                 - (0 if old is None or old.is_deleted() else 1)
             )
+            if old is not None:
+                acc = xor_fold(acc, record_digest(old))
+            acc = xor_fold(acc, record_digest(r))
             self.id_to_row[r.record_id] = int(row)
             self.records[r.record_id] = r
+        self._content_hash = acc
         # one publication per batch: lock-free /stats readers must never
         # observe a mid-append partial count
         self.live_records += delta
@@ -510,6 +522,10 @@ class DeviceIndex(CandidateIndex):
             # at the end — readers transiently see between 1x and 2x, never
             # a collapse.
             prev_live = self.live_records
+            # the record SET is unchanged by a rebuild; re-appending would
+            # fold every digest a second time (XOR: fold twice = remove),
+            # so the running hash is preserved across the re-append
+            prev_hash = self._content_hash
             if old_records:
                 logger.info(
                     "value-slot growth: rebuilding corpus tensors for %d "
@@ -518,6 +534,7 @@ class DeviceIndex(CandidateIndex):
                 )
                 self._append_records(list(old_records.values()))
             self.live_records -= prev_live
+            self._content_hash = prev_hash
 
     def find_record_by_id(self, record_id: str) -> Optional[Record]:
         return self.records.get(record_id)
@@ -545,8 +562,14 @@ class DeviceIndex(CandidateIndex):
             if row is not None:
                 self.corpus.tombstone(row)
             old = self.records.pop(record.record_id, None)
-            if old is not None and not old.is_deleted():
-                self.live_records -= 1
+            if old is not None:
+                from ..store.records import record_digest, xor_fold
+
+                self._content_hash = xor_fold(
+                    self._content_hash, record_digest(old)
+                )
+                if not old.is_deleted():
+                    self.live_records -= 1
 
     def set_indexing_disabled(self, disabled: bool) -> None:
         self.indexing_disabled = disabled
@@ -586,6 +609,11 @@ class DeviceIndex(CandidateIndex):
         corpus = self.corpus
         if corpus.size == 0:
             return
+        # stamp the INDEX side's running digest (not the store's hash): a
+        # store commit whose scoring/index pass failed leaves the two
+        # different, and the restart's compare against the STORE hash must
+        # then reject the snapshot (stale features must never score)
+        content_hash = self._content_hash.hex()
         # np.savez cannot round-trip ml_dtypes (bf16 loads back as raw
         # void); such tensors are saved as uint16 bit views and listed in
         # __bf16_keys so load can view them back
@@ -602,11 +630,17 @@ class DeviceIndex(CandidateIndex):
         # write-then-rename: a SIGKILL mid-save must never leave a truncated
         # snapshot (np.load would fail and silently force a full replay)
         tmp = f"{path}.tmp.{os.getpid()}"
+        # compression trades restart time for disk: zlib over a multi-GB
+        # corpus (10M rows ≈ 9 GB with embeddings) takes minutes, so large
+        # deployments set SNAPSHOT_COMPRESS=0 and pay disk instead
+        savez = (np.savez_compressed
+                 if os.environ.get("SNAPSHOT_COMPRESS", "1") != "0"
+                 else np.savez)
         try:
-            np.savez_compressed(
+            savez(
                 tmp,
                 __fingerprint=np.array(self._snapshot_fingerprint()),
-                __content=np.array(_records_content_hash(self.records)),
+                __content=np.array(content_hash),
                 __bf16_keys=np.array(bf16_keys, dtype=str),
                 __value_slots=np.array(
                     [s.v for s in self.plan.device_props], dtype=np.int64
@@ -634,11 +668,16 @@ class DeviceIndex(CandidateIndex):
             raise
 
     def snapshot_load(self, path: str,
-                      records_by_id: Dict[str, Record]) -> bool:
+                      records_by_id: Dict[str, Record],
+                      content_hash: Optional[str] = None) -> bool:
         """Restore the corpus tensors from a snapshot; False -> replay.
 
         ``records_by_id`` is the durable store's live view; the snapshot is
         rejected unless its live rows are exactly the store's record set.
+        ``content_hash`` is the store's incremental content digest
+        (store.records.RecordStore.content_hash) — when provided the
+        staleness check is an O(1) compare instead of rehashing every
+        record's every value.
         """
         import ml_dtypes
 
@@ -664,9 +703,11 @@ class DeviceIndex(CandidateIndex):
                 # would accept a snapshot predating an in-place record
                 # update that only the store persisted (crash before the
                 # next snapshot save) and score stale features
-                if (str(data["__content"])
-                        != _records_content_hash(records_by_id)):
+                expected = (content_hash if content_hash is not None
+                            else _records_content_hash(records_by_id))
+                if str(data["__content"]) != expected:
                     return False
+                accepted_hash = bytes.fromhex(expected)
                 row_ids = list(data["__row_ids"])
                 row_valid = data["__row_valid"]
                 row_deleted = data["__row_deleted"]
@@ -713,6 +754,9 @@ class DeviceIndex(CandidateIndex):
         self.live_records = sum(
             1 for r in self.records.values() if not r.is_deleted()
         )
+        # adopt the verified digest as the index's running hash (the
+        # restore bypassed _append_records' incremental fold)
+        self._content_hash = accepted_hash
         logger.info("corpus snapshot restored: %d rows from %s", n, path)
         return True
 

@@ -427,7 +427,8 @@ def build_workload(wc: WorkloadConfig, sc: ServiceConfig, *,
             loaded = False
             if hasattr(index, "snapshot_load"):
                 loaded = index.snapshot_load(
-                    _snapshot_path(wc.data_folder), records_by_id
+                    _snapshot_path(wc.data_folder), records_by_id,
+                    content_hash=record_store.content_hash(),
                 )
             if not loaded and records_by_id:
                 for record in records_by_id.values():

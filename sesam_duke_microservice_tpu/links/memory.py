@@ -72,15 +72,20 @@ class InMemoryLinkDatabase(LinkDatabase):
                 self._append_sorted(link)
 
     def get_all_links_for(self, record_id: str) -> List[Link]:
+        # COPIES, not the stored objects (matching the sqlite backend's
+        # fresh rows): callers retract-then-reassert these, and an
+        # in-place mutation of a stored link would invalidate its sort key
+        # before assert_link sees it — degrading every retraction to an
+        # O(n) identity scan of the ordered view
         return [
-            l for l in self._links.values()
+            l.copy() for l in self._links.values()
             if l.id1 == record_id or l.id2 == record_id
         ]
 
     def get_links_for_ids(self, record_ids) -> List[Link]:
         ids = set(record_ids)
         return [
-            l for l in self._links.values()
+            l.copy() for l in self._links.values()
             if l.id1 in ids or l.id2 in ids
         ]
 

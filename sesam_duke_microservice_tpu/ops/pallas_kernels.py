@@ -16,10 +16,11 @@ Kernel inventory:
 
   * ``myers_distance_tiles`` — batched Levenshtein distance over all
     query x corpus pairs via Myers/Hyyro bit-parallel DP: one uint32 word
-    per pair for patterns <= 32 codepoints, and a two-word
-    carry-propagated variant for 33..64 (Hyyro's block formulation), so
-    the default ``DEVICE_MAX_CHARS=64`` configs stay on the Pallas path.
-    Differentially tested against ``ops.pairwise`` and the scalar oracle.
+    per pair for patterns <= 32 codepoints, and an N-word
+    carry-propagated variant (Hyyro's block formulation) up to
+    ``MYERS_MAX_CHARS`` = 256, so default 64-char configs AND long-text
+    schemas (128/256 chars) stay on the Pallas path.  Differentially
+    tested against ``ops.pairwise`` and the scalar oracle.
   * ``myers_distance_gathered`` — the same DP in the ANN-rescoring layout:
     candidate c of query q is a specific gathered row, so the candidate
     axis rides the lanes and text chars differ per pair.
@@ -207,36 +208,52 @@ def _myers_tiles_padded(qc, ql2, cct, cl2, *, tile_q, tile_c, interpret):
     )(qc, ql2, cct, cl2)
 
 
+# Longest pattern the tiled Myers kernels cover (uint32 words unroll
+# statically; beyond this the scan-DP fallback takes over).  8 words =
+# 256 chars comfortably covers long text properties (addresses, titles).
+MYERS_MAX_CHARS = 256
+
+
 def myers_distance_tiles(qchars, qlen, cchars, clen, *, interpret=None):
     """All-pairs Levenshtein distance d(query_i, corpus_j) -> (Q, C) int32.
 
-    qchars: (Q, L) int32 codepoints (0-padded), L <= 64; qlen: (Q,) int32
-    cchars: (C, L) int32; clen: (C,) int32
+    qchars: (Q, L) int32 codepoints (0-padded), L <= MYERS_MAX_CHARS;
+    qlen: (Q,) int32; cchars: (C, L) int32; clen: (C,) int32
 
-    L <= 32 runs the one-word kernel; 32 < L <= 64 the two-word Hyyro
-    variant (explicit carry propagation) — so the default 64-char configs
-    (``DEVICE_MAX_CHARS=64``) stay on the Pallas path instead of the slow
-    scan-DP fallback.  Pads Q up to a sublane multiple and C up to a lane
-    multiple; padded rows compute garbage distances that callers mask via
-    their validity bits.
+    L <= 32 runs the one-word kernel; longer patterns the N-word Hyyro
+    variant (explicit carry propagation, N = ceil(L/32) <= 8) — so 64-char
+    default configs AND long-text schemas (128/256 chars) stay on the
+    Pallas path instead of the ~600x slower scan-DP fallback.  Pads Q up
+    to a sublane multiple and C up to a lane multiple; padded rows compute
+    garbage distances that callers mask via their validity bits.
     """
     q = qchars.shape[0]
     c = cchars.shape[0]
-    if qchars.shape[1] > 64:
+    l = qchars.shape[1]
+    if l > MYERS_MAX_CHARS:
         raise ValueError(
-            f"Myers pallas kernels need L <= 64, got {qchars.shape[1]}"
+            f"Myers pallas kernels need L <= {MYERS_MAX_CHARS}, got {l}"
         )
     if interpret is None:
         interpret = _interpret()
-    two_word = qchars.shape[1] > 32
+    words = -(-l // 32)
+    # lane tiles shrink as the per-pair DP state (O(W) uint32 words) grows,
+    # keeping the live VMEM footprint roughly constant
+    tile_c_cap = 512 if words == 1 else (256 if words <= 4 else 128)
     qc, ql2, cct, cl2, tile_q, tile_c = _stage_pair_operands(
         qchars, qlen, cchars, clen,
-        tile_q_cap=128, tile_c_cap=256 if two_word else 512,
+        tile_q_cap=128, tile_c_cap=tile_c_cap,
     )
-    call = _myers2_tiles_padded if two_word else _myers_tiles_padded
-    out = call(
-        qc, ql2, cct, cl2, tile_q=tile_q, tile_c=tile_c, interpret=interpret
-    )
+    if words == 1:
+        out = _myers_tiles_padded(
+            qc, ql2, cct, cl2, tile_q=tile_q, tile_c=tile_c,
+            interpret=interpret,
+        )
+    else:
+        out = _myersN_tiles_padded(
+            qc, ql2, cct, cl2, tile_q=tile_q, tile_c=tile_c,
+            interpret=interpret, words=words,
+        )
     return out[:q, :c]
 
 
@@ -249,12 +266,16 @@ def _carry_out(a: jnp.ndarray, b: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
     return ((a & b) | ((a ^ b) & ~s)) >> jnp.uint32(31)
 
 
-def _myers2_tile_kernel(qc_ref, ql_ref, cct_ref, cl_ref, out_ref, *, L: int):
-    """Two-word Myers/Hyyro tile: pattern lengths 33..64 (2x uint32 words).
+def _myersN_tile_kernel(qc_ref, ql_ref, cct_ref, cl_ref, out_ref, *,
+                        L: int, W: int):
+    """N-word Myers/Hyyro tile: pattern lengths up to ``32 * W`` chars.
 
     Same layout contract as ``_myers_tile_kernel``; the bit-parallel DP
-    state (Pv/Mv) spans two 32-bit words with explicit carry propagation
-    through the add and the horizontal shifts (Hyyro's block formulation).
+    state (Pv/Mv) spans ``W`` 32-bit words with explicit carry propagation
+    through the add chain and the horizontal shifts (Hyyro's block
+    formulation generalized from the round-2 two-word kernel).  The word
+    lists unroll statically, so the Mosaic program grows O(W) per text
+    step while all O(TQ * TC * W) bit-parallel work stays on the VPU.
     """
     tq = qc_ref.shape[0]
     tc = cct_ref.shape[1]
@@ -270,80 +291,88 @@ def _myers2_tile_kernel(qc_ref, ql_ref, cct_ref, cl_ref, out_ref, *, L: int):
         return jnp.where(nn >= 32, full,
                          (one << nn.astype(jnp.uint32)) - one)
 
-    pv0_init = bits_below(ql)                       # (TQ, 1)
-    pv1_init = bits_below(ql - 32)
-    hi_word1 = ql > 32                              # (TQ, 1)
+    pv = [
+        jnp.broadcast_to(bits_below(ql - 32 * w), (tq, tc)) for w in range(W)
+    ]
+    mv = [jnp.zeros((tq, tc), jnp.uint32) for _ in range(W)]
+    # the score bit rides in the pattern's last word/bit, per query
+    hi_word = (jnp.maximum(ql, 1) - 1) // 32        # (TQ, 1)
     hibit = one << ((jnp.maximum(ql, 1) - 1) % 32).astype(jnp.uint32)
-
-    pv0 = jnp.broadcast_to(pv0_init, (tq, tc))
-    pv1 = jnp.broadcast_to(pv1_init, (tq, tc))
-    mv0 = jnp.zeros((tq, tc), jnp.uint32)
-    mv1 = jnp.zeros((tq, tc), jnp.uint32)
     score = jnp.broadcast_to(ql.astype(jnp.int32), (tq, tc))
 
     def step(i, carry):
-        pv0, pv1, mv0, mv1, score = carry
+        pv = list(carry[0:W])
+        mv = list(carry[W:2 * W])
+        score = carry[2 * W]
         t = cct_ref[pl.ds(i, 1), :]                       # (1, TC)
-        eq0 = jnp.zeros((tq, tc), jnp.uint32)
-        eq1 = jnp.zeros((tq, tc), jnp.uint32)
-        for j in range(min(L, 32)):
-            eq0 = eq0 | jnp.where(
-                qc[:, j : j + 1] == t, jnp.uint32(1 << j), 0
-            )
-        for j in range(32, L):
-            eq1 = eq1 | jnp.where(
-                qc[:, j : j + 1] == t, jnp.uint32(1 << (j - 32)), 0
-            )
-        xv0 = eq0 | mv0
-        xv1 = eq1 | mv1
-        # xh = (((eq & pv) + pv) ^ pv) | eq with carry across words
-        a0 = eq0 & pv0
-        s0 = a0 + pv0
-        c0 = _carry_out(a0, pv0, s0)
-        a1 = eq1 & pv1
-        s1 = a1 + c0 + pv1
-        # (the carry OUT of word 1 falls off the 64-bit pattern window)
-        xh0 = (s0 ^ pv0) | eq0
-        xh1 = (s1 ^ pv1) | eq1
-        ph0 = mv0 | ~(xh0 | pv0)
-        mh0 = pv0 & xh0
-        ph1 = mv1 | ~(xh1 | pv1)
-        mh1 = pv1 & xh1
+        eq = []
+        for w in range(W):
+            e = jnp.zeros((tq, tc), jnp.uint32)
+            for j in range(32 * w, min(32 * (w + 1), L)):
+                e = e | jnp.where(
+                    qc[:, j : j + 1] == t, jnp.uint32(1 << (j - 32 * w)), 0
+                )
+            eq.append(e)
+        xv = [eq[w] | mv[w] for w in range(W)]
+        # xh = (((eq & pv) + pv) ^ pv) | eq with a carry chain across the
+        # words (the carry out of the last word falls off the pattern
+        # window)
+        xh = []
+        c = None
+        for w in range(W):
+            a = eq[w] & pv[w]
+            s = a + pv[w]
+            cout = _carry_out(a, pv[w], s)
+            if c is not None:
+                s2 = s + c
+                cout = cout | _carry_out(s, c, s2)
+                s = s2
+            xh.append((s ^ pv[w]) | eq[w])
+            c = cout
+        ph = [mv[w] | ~(xh[w] | pv[w]) for w in range(W)]
+        mh = [pv[w] & xh[w] for w in range(W)]
 
         active = i < cl                                   # (1, TC)
-        ph_hi = jnp.where(hi_word1, ph1, ph0)
-        mh_hi = jnp.where(hi_word1, mh1, mh0)
+        ph_hi, mh_hi = ph[0], mh[0]
+        for w in range(1, W):
+            sel = hi_word == w
+            ph_hi = jnp.where(sel, ph[w], ph_hi)
+            mh_hi = jnp.where(sel, mh[w], mh_hi)
         score = score + jnp.where(active & ((ph_hi & hibit) != 0), 1, 0)
         score = score - jnp.where(active & ((mh_hi & hibit) != 0), 1, 0)
 
-        ph_c = ph0 >> jnp.uint32(31)
-        mh_c = mh0 >> jnp.uint32(31)
-        ph0 = (ph0 << one) | one
-        ph1 = (ph1 << one) | ph_c
-        mh1 = (mh1 << one) | mh_c
-        mh0 = mh0 << one
-        pv0 = jnp.where(active, mh0 | ~(xv0 | ph0), pv0)
-        pv1 = jnp.where(active, mh1 | ~(xv1 | ph1), pv1)
-        mv0 = jnp.where(active, ph0 & xv0, mv0)
-        mv1 = jnp.where(active, ph1 & xv1, mv1)
-        return (pv0, pv1, mv0, mv1, score)
+        # horizontal shifts with cross-word carries
+        ph_c = [p >> jnp.uint32(31) for p in ph]
+        mh_c = [m >> jnp.uint32(31) for m in mh]
+        nph = [(ph[0] << one) | one] + [
+            (ph[w] << one) | ph_c[w - 1] for w in range(1, W)
+        ]
+        nmh = [mh[0] << one] + [
+            (mh[w] << one) | mh_c[w - 1] for w in range(1, W)
+        ]
+        pv = [
+            jnp.where(active, nmh[w] | ~(xv[w] | nph[w]), pv[w])
+            for w in range(W)
+        ]
+        mv = [jnp.where(active, nph[w] & xv[w], mv[w]) for w in range(W)]
+        return (*pv, *mv, score)
 
-    pv0, pv1, mv0, mv1, score = lax.fori_loop(
-        0, L, step, (pv0, pv1, mv0, mv1, score)
-    )
+    out = lax.fori_loop(0, L, step, (*pv, *mv, score))
+    score = out[2 * W]
     out_ref[...] = jnp.where(
         ql == 0, jnp.broadcast_to(cl.astype(jnp.int32), (tq, tc)), score
     )
 
 
 @functools.partial(
-    jax.jit, static_argnames=("tile_q", "tile_c", "interpret")
+    jax.jit, static_argnames=("tile_q", "tile_c", "interpret", "words")
 )
-def _myers2_tiles_padded(qc, ql2, cct, cl2, *, tile_q, tile_c, interpret):
+def _myersN_tiles_padded(qc, ql2, cct, cl2, *, tile_q, tile_c, interpret,
+                         words):
     qp, l = qc.shape
     cp = cct.shape[1]
     grid = (qp // tile_q, cp // tile_c)
-    kernel = functools.partial(_myers2_tile_kernel, L=l)
+    kernel = functools.partial(_myersN_tile_kernel, L=l, W=words)
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((qp, cp), jnp.int32),

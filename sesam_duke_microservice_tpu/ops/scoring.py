@@ -147,10 +147,10 @@ def _property_sim(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict,
         and
         pallas_ok
         and kind == F.CHARS
-        # Levenshtein rides the 1-/2-word Myers kernels up to 64 chars;
-        # the Jaro-Winkler tile kernel is single-word bitmask only
+        # Levenshtein rides the N-word Myers kernels up to MYERS_MAX_CHARS
+        # (256); the Jaro-Winkler tile kernel is single-word bitmask only
         and qf["chars"].shape[2]
-        <= (32 if isinstance(cmp, C.JaroWinkler) else 64)
+        <= (32 if isinstance(cmp, C.JaroWinkler) else pk.MYERS_MAX_CHARS)
         and pk.pallas_enabled()
     ):
         # Pallas tiled path: (TQ, TC) similarity tiles computed in VMEM

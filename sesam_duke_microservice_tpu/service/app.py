@@ -204,6 +204,7 @@ _ENTITY_PATH = re.compile(
     r"^/(deduplication|recordlinkage)/([^/]*)/([^/]*?)(/httptransform)?$"
 )
 _FEED_PATH = re.compile(r"^/(deduplication|recordlinkage)/([^/]*)$")
+_REMATCH_PATH = re.compile(r"^/(deduplication|recordlinkage)/([^/]+)/rematch$")
 
 
 class DukeRequestHandler(BaseHTTPRequestHandler):
@@ -294,6 +295,8 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
             path = urlparse(self.path).path
             if path == "/config":
                 self._handle_config_upload(body)
+            elif m := _REMATCH_PATH.match(path):
+                self._handle_rematch(m, body)
             elif m := _ENTITY_PATH.match(path):
                 self._handle_post_batch(m, body)
             else:
@@ -542,6 +545,40 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                 workload.lock.release()
         body = "[" + ",\n".join(json.dumps(r) for r in rows) + "]"
         self._reply(200, body.encode("utf-8"))
+
+    def _handle_rematch(self, m, body: bytes) -> None:
+        """Admin extension: bulk corpus-vs-corpus re-match through the
+        ring layout (engine.rematch) — link-DB backfill / re-population.
+        The reference has no bulk operations; a dataset literally named
+        'rematch' still wins the route (ingest takes precedence, with the
+        posted batch intact)."""
+        kind, name = m.group(1), m.group(2)
+        workload = self._workloads(kind).get(name)
+        if workload is not None and "rematch" in workload.datasources:
+            self._handle_post_batch(
+                _ENTITY_PATH.match(f"/{kind}/{name}/rematch"), body
+            )
+            return
+        label = "deduplication" if kind == "deduplication" else "recordLinkage"
+        if workload is None:
+            raise _HttpError(
+                404,
+                f"Unknown {label} '{name}'! (All {label}s must be specified "
+                f"in the configuration)",
+            )
+        from ..engine.rematch import ring_rematch
+
+        with workload.lock:
+            if workload.closed:
+                raise _HttpError(503, _BUSY_TEMPLATE.format(kind=label))
+            try:
+                stats = ring_rematch(workload)
+            except ValueError as e:
+                raise _HttpError(400, str(e))
+            except Exception as e:
+                logger.exception("ring re-match failed")
+                raise _HttpError(500, f"Re-match failed: {e}")
+        self._reply(200, json.dumps(stats).encode("utf-8"))
 
     def _handle_config_upload(self, body: bytes) -> None:
         content_type = self.headers.get("Content-Type", "")

@@ -1,0 +1,157 @@
+"""Ring bulk re-match (engine.rematch) on the virtual 8-device mesh."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from sesam_duke_microservice_tpu.core.config import parse_config
+from sesam_duke_microservice_tpu.engine.rematch import ring_rematch
+from sesam_duke_microservice_tpu.engine.workload import build_workload
+
+XML = """
+<DukeMicroService>
+  <Deduplication name="people" link-database-type="in-memory">
+    <duke>
+      <schema>
+        <threshold>0.8</threshold>
+        <property><name>NAME</name><comparator>levenshtein</comparator><low>0.1</low><high>0.95</high></property>
+        <property><name>EMAIL</name><comparator>exact</comparator><low>0.2</low><high>0.95</high></property>
+      </schema>
+      <data-source class="io.sesam.dukemicroservice.IncrementalDeduplicationDataSource">
+        <param name="dataset-id" value="crm"/>
+        <column name="name" property="NAME"/>
+        <column name="email" property="EMAIL"/>
+      </data-source>
+    </duke>
+  </Deduplication>
+</DukeMicroService>
+"""
+
+
+def _batch(n):
+    rows = []
+    for i in range(n):
+        name = f"person number {i - 1 if i % 3 == 2 else i}"
+        rows.append({"_id": str(i), "name": name,
+                     "email": f"{name.replace(' ', '.')}@x.no"})
+    return rows
+
+
+def _live_links(wl):
+    return sorted(
+        (r["entity1"], r["entity2"], round(r["confidence"], 9))
+        for r in wl.links_since(0) if not r["_deleted"]
+    )
+
+
+def _bulk_import(wl, entities):
+    """Index + persist records WITHOUT scoring (the backfill scenario:
+    records exist, links don't)."""
+    records = wl.datasources["crm"].records_for_batch(entities)
+    if wl.record_store is not None:
+        wl.record_store.put_many(records)
+    for r in records:
+        wl.index.index(r)
+    wl.index.commit()
+
+
+@pytest.mark.parametrize("backend", ["device", "sharded-brute"])
+def test_ring_rematch_backfills_links_equal_to_scoring(backend):
+    env = {"MIN_RELEVANCE": "0.05"}
+    sc = parse_config(XML, env=env)
+    wc = sc.deduplications["people"]
+
+    # reference: same batch through the normal scoring path
+    ref = build_workload(wc, sc, backend="device", persistent=False)
+    entities = _batch(30)
+    try:
+        with ref.lock:
+            ref.process_batch("crm", entities)
+            want = _live_links(ref)
+    finally:
+        ref.close()
+    assert len(want) >= 8
+
+    # backfill: records imported without scoring, then ring re-match
+    wl = build_workload(wc, sc, backend=backend, persistent=False)
+    try:
+        with wl.lock:
+            _bulk_import(wl, entities)
+            assert wl.links_since(0) == []
+            stats = ring_rematch(wl)
+            got = _live_links(wl)
+            assert got == want
+            assert stats["queries"] == 30
+            assert stats["events"] == len(want)
+            # idempotence: a second pass asserts nothing new (timestamps
+            # unchanged for pollers)
+            before = [r["_updated"] for r in wl.links_since(0)]
+            ring_rematch(wl)
+            assert [r["_updated"] for r in wl.links_since(0)] == before
+    finally:
+        wl.close()
+
+
+def test_ring_rematch_respects_tombstones():
+    sc = parse_config(XML, env={"MIN_RELEVANCE": "0.05"})
+    wl = build_workload(sc.deduplications["people"], sc, backend="device",
+                        persistent=False)
+    try:
+        with wl.lock:
+            _bulk_import(wl, [
+                {"_id": "1", "name": "Alan Turing", "email": "a@x.no"},
+                {"_id": "2", "name": "Alan Turing", "email": "a@x.no"},
+                {"_id": "3", "name": "Alan Turing", "email": "a@x.no",
+                 "_deleted": True},
+            ])
+            ring_rematch(wl)
+            pairs = {(e1, e2) for e1, e2, _ in _live_links(wl)}
+        assert pairs == {("1", "2")}
+    finally:
+        wl.close()
+
+
+def test_rematch_http_endpoint():
+    import os
+
+    from sesam_duke_microservice_tpu.service.app import DukeApp, serve
+
+    saved = os.environ.get("MIN_RELEVANCE")
+    os.environ["MIN_RELEVANCE"] = "0.05"
+    try:
+        app = DukeApp(parse_config(XML), backend="device", persistent=False)
+    finally:
+        if saved is None:
+            os.environ.pop("MIN_RELEVANCE", None)
+        else:
+            os.environ["MIN_RELEVANCE"] = saved
+    server = serve(app, port=0, host="127.0.0.1")
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        wl = app.deduplications["people"]
+        with wl.lock:
+            _bulk_import(wl, _batch(12))
+        req = urllib.request.Request(
+            base + "/deduplication/people/rematch", data=b"", method="POST"
+        )
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            stats = json.loads(resp.read())
+        assert stats["queries"] == 12 and stats["events"] >= 4
+        with urllib.request.urlopen(
+                base + "/deduplication/people?since=0", timeout=60) as resp:
+            assert len(json.loads(resp.read())) == stats["events"]
+        # unknown workload -> 404
+        req = urllib.request.Request(
+            base + "/deduplication/nope/rematch", data=b"", method="POST"
+        )
+        try:
+            urllib.request.urlopen(req, timeout=60)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        server.shutdown()
+        app.close()

@@ -207,3 +207,68 @@ def test_workload_restart_uses_corpus_snapshot(tmp_path, monkeypatch):
     assert len(wl2.index.records) == 12
     monkeypatch.undo()
     wl2.close()
+
+
+def test_content_hash_incremental_equals_rebuild(tmp_path):
+    """The running XOR hash after arbitrary put/replace sequences equals
+    the hash a fresh store computes from the same final rows (the
+    migration path folds every row from scratch)."""
+    import sqlite3
+
+    from sesam_duke_microservice_tpu.store.records import SqliteRecordStore
+
+    path = str(tmp_path / "r.sqlite")
+    store = SqliteRecordStore(path)
+    empty = store.content_hash()
+    store.put_many([_record(f"id{i}", name=f"n{i}") for i in range(20)])
+    store.put_many([_record("id3", name="replaced")])     # replace
+    store.put_many([_record("id3", name="replaced")])     # idempotent re-put
+    store.put_many([_record("id5", name="a"), _record("id5", name="b")])
+    incremental = store.content_hash()
+    assert incremental != empty
+    store.close()
+
+    # drop the meta row: reopening must rebuild the same hash from rows
+    conn = sqlite3.connect(path)
+    conn.execute("DELETE FROM meta WHERE key='content_hash'")
+    conn.commit()
+    conn.close()
+    store2 = SqliteRecordStore(path)
+    assert store2.content_hash() == incremental
+    store2.close()
+
+
+def test_snapshot_rejected_when_store_mutates_after_save(tmp_path,
+                                                         monkeypatch):
+    """O(1)-hash staleness guard: a record updated in the store after the
+    snapshot was saved forces a full replay (stale features must never
+    score)."""
+    monkeypatch.setenv("MIN_RELEVANCE", "0.05")
+    sc = parse_config(DEDUP_XML.format(folder=tmp_path),
+                      env={"MIN_RELEVANCE": "0.05"})
+    wc = sc.deduplications["people"]
+    wl = build_workload(wc, sc, backend="device", persistent=True)
+    with wl.lock:
+        wl.process_batch("crm", [
+            {"_id": str(i), "name": f"name {i}"} for i in range(8)
+        ])
+    wl.close()  # snapshot saved with the store's current hash
+
+    # out-of-band store mutation (simulates a crash after a store write
+    # but before the next snapshot save)
+    from sesam_duke_microservice_tpu.store.records import SqliteRecordStore
+    import os
+
+    store = SqliteRecordStore(
+        os.path.join(wc.data_folder, "records.sqlite")
+    )
+    store.put_many([_record("crm__3", NAME="changed behind the snapshot")])
+    store.close()
+
+    wl2 = build_workload(wc, sc, backend="device", persistent=True)
+    try:
+        # replay (not snapshot) must win: the changed value is served
+        rec = wl2.index.find_record_by_id("crm__3")
+        assert rec.get_value("NAME") == "changed behind the snapshot"
+    finally:
+        wl2.close()
