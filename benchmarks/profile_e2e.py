@@ -1,0 +1,107 @@
+"""Phase breakdown of the end-to-end device bench (VERDICT r2 #7).
+
+Splits one timed `DeviceProcessor.deduplicate` batch into its phases so
+the end-to-end vs raw-scorer gap is attributable:
+
+  ingest_extract   feature extraction + corpus host-mirror append
+  device_update    incremental device mirror update (tree updater call)
+  dispatch         scorer enqueue (async) until resolve starts
+  device_wait      resolve_block: device execution + result fetch
+  finalize         host survivor loop (exact compare + listener events)
+
+Usage: python benchmarks/profile_e2e.py [--corpus 20000] [--queries 1024]
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--corpus", type=int, default=20000)
+    ap.add_argument("--queries", type=int, default=1024)
+    args = ap.parse_args()
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))))
+    from bench import bench_schema, stresstest_records
+    from sesam_duke_microservice_tpu.engine.device_matcher import (
+        DeviceIndex,
+        DeviceProcessor,
+        resolve_block,
+    )
+    from sesam_duke_microservice_tpu.utils.jit_cache import (
+        enable_persistent_cache,
+    )
+
+    enable_persistent_cache()
+    schema = bench_schema()
+    corpus = stresstest_records(args.corpus, seed=1234)
+    queries = stresstest_records(args.queries, seed=5678, dataset="ds2")
+
+    index = DeviceIndex(schema)
+    proc = DeviceProcessor(schema, index)
+    for r in corpus:
+        index.index(r)
+    index.commit()
+    # warm both the scorer and the incremental updater shapes
+    for seed, ds in ((999, "warm"), (998, "warm2")):
+        warm = stresstest_records(args.queries, seed=seed, dataset=ds)
+        proc.deduplicate(warm)
+        for r in warm:
+            index.delete(r)
+
+    out = {"corpus": args.corpus, "queries": args.queries}
+    t0 = time.perf_counter()
+    for r in queries:
+        index.index(r)
+    index.commit()
+    t1 = time.perf_counter()
+    # force the device mirror update now (deduplicate would fold it into
+    # dispatch otherwise)
+    index.corpus.device_arrays()
+    t2 = time.perf_counter()
+    pending = proc._scorers.dispatch_block(queries, group_filtering=False)
+    t3 = time.perf_counter()
+    result = resolve_block(pending)
+    t4 = time.perf_counter()
+    survivors = 0
+    compared = 0.0
+    for qi, record in enumerate(queries):
+        for row, _ in result.survivors(qi):
+            rid = index.corpus.row_ids[row]
+            candidate = index.records.get(rid)
+            if candidate is None or rid == record.record_id:
+                continue
+            survivors += 1
+            compared += proc.compare(record, candidate)
+    t5 = time.perf_counter()
+
+    live = int(index.corpus.row_valid.sum()
+               - index.corpus.row_deleted[index.corpus.row_valid].sum())
+    pairs = args.queries * live
+    out.update(
+        ingest_extract_s=round(t1 - t0, 4),
+        device_update_s=round(t2 - t1, 4),
+        dispatch_s=round(t3 - t2, 4),
+        device_wait_s=round(t4 - t3, 4),
+        finalize_s=round(t5 - t4, 4),
+        survivors=survivors,
+        total_s=round(t5 - t0, 4),
+        pairs=pairs,
+        pairs_per_sec=round(pairs / (t5 - t0)),
+        scoring_only_pairs_per_sec=round(pairs / (t4 - t3)),
+    )
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

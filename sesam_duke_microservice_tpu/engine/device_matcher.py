@@ -348,10 +348,17 @@ class DeviceIndex(CandidateIndex):
         # formula as the store's running hash): snapshot_save stamps THIS
         # side and snapshot_load compares the STORE side, so index/store
         # divergence (a store commit whose scoring pass failed) still
-        # forces a replay — at O(1) instead of rehashing the corpus
+        # forces a replay — at O(1) instead of rehashing the corpus.
+        # With a LAZY record mirror the incremental fold is impossible
+        # (old contents are unobtainable once the store is updated), so
+        # the workload instead stamps the store's hash after each fully
+        # successful batch (mark_store_synced); a batch that failed
+        # between the store write and the index commit leaves the stamp at
+        # the pre-batch value, which no longer matches the store — replay.
         from ..store.records import EMPTY_CONTENT_HASH
 
         self._content_hash = EMPTY_CONTENT_HASH
+        self._store_synced_hash: Optional[str] = None
         # O(1) live count (non-dukeDeleted records) for /stats — counting
         # by iterating ``records`` would need the workload lock for the
         # whole scan (seconds at 10M rows)
@@ -447,7 +454,10 @@ class DeviceIndex(CandidateIndex):
                 self.corpus.tombstone(old)
         self._append_records(records)
 
-    def _append_records(self, records: Sequence[Record]) -> None:
+    def _append_rows_only(self, records: Sequence[Record]) -> np.ndarray:
+        """Extract + corpus append + row mapping — no record-mirror, hash,
+        or live-count updates (the streaming rebuild path, where the
+        record SET is unchanged)."""
         feats = self._extract(records)
         deleted = np.array([r.is_deleted() for r in records], dtype=bool)
         group = np.array(
@@ -456,22 +466,42 @@ class DeviceIndex(CandidateIndex):
         )
         ids = [r.record_id for r in records]
         rows = self.corpus.append(feats, deleted, group, ids)
-        from ..store.records import record_digest, xor_fold
+        for r, row in zip(records, rows):
+            self.id_to_row[r.record_id] = int(row)
+        return rows
 
+    def _append_records(self, records: Sequence[Record]) -> None:
+        from ..store.records import LazyRecordMap, record_digest, xor_fold
+
+        # old-liveness from INDEX state (id_to_row + the old row's deleted
+        # mask), never from a mirror read: a lazy mirror reads through to
+        # the store, which the workload already updated with the NEW
+        # values — counting (or hash-folding) those as "old" silently
+        # corrupts the live count and the content digest
+        old_live = []
+        corpus = self.corpus
+        for r in records:
+            old_row = self.id_to_row.get(r.record_id)
+            old_live.append(
+                old_row is not None and not corpus.row_deleted[old_row]
+            )
+        self._append_rows_only(records)
+        lazy = isinstance(self.records, LazyRecordMap)
         delta = 0
         acc = self._content_hash
-        for r, row in zip(records, rows):
-            old = self.records.get(r.record_id)
-            delta += (
-                (0 if r.is_deleted() else 1)
-                - (0 if old is None or old.is_deleted() else 1)
-            )
-            if old is not None:
-                acc = xor_fold(acc, record_digest(old))
-            acc = xor_fold(acc, record_digest(r))
-            self.id_to_row[r.record_id] = int(row)
+        for r, was_live in zip(records, old_live):
+            delta += (0 if r.is_deleted() else 1) - (1 if was_live else 0)
+            if not lazy:
+                old = self.records.get(r.record_id)
+                if old is not None:
+                    acc = xor_fold(acc, record_digest(old))
+                acc = xor_fold(acc, record_digest(r))
             self.records[r.record_id] = r
-        self._content_hash = acc
+        # in lazy mode the incremental fold is impossible (the true old
+        # content is gone — the store was updated first); snapshot
+        # integrity rides the store-synced stamp instead (mark_store_synced)
+        if not lazy:
+            self._content_hash = acc
         # one publication per batch: lock-free /stats readers must never
         # observe a mid-append partial count
         self.live_records += delta
@@ -507,34 +537,54 @@ class DeviceIndex(CandidateIndex):
         cannot land between the old-state capture and the replacement (its
         tombstone would otherwise be resurrected by the re-append).
         """
+        from ..store.records import LazyRecordMap
+
         with self._lock:
             old_records = self.records
+            lazy = isinstance(old_records, LazyRecordMap)
             self.corpus = self._make_corpus(
                 self.plan, max((s.v for s in self.plan.device_props), default=1)
             )
             self.id_to_row = {}
-            self.records = {}
-            # live_records is deliberately NOT zeroed before the re-append:
-            # lock-free /stats readers must never observe a transient
-            # near-zero count for a populated corpus.  The re-append of the
-            # same record set double-counts (every record looks new against
-            # the cleared map), so the pre-rebuild count is subtracted once
-            # at the end — readers transiently see between 1x and 2x, never
-            # a collapse.
-            prev_live = self.live_records
-            # the record SET is unchanged by a rebuild; re-appending would
-            # fold every digest a second time (XOR: fold twice = remove),
-            # so the running hash is preserved across the re-append
-            prev_hash = self._content_hash
             if old_records:
                 logger.info(
                     "value-slot growth: rebuilding corpus tensors for %d "
-                    "records (slots now %s)", len(old_records),
+                    "records (slots now %s)%s", len(old_records),
                     {s.name: s.v for s in self.plan.device_props},
+                    " — streaming from the store" if lazy else "",
                 )
-                self._append_records(list(old_records.values()))
-            self.live_records -= prev_live
-            self._content_hash = prev_hash
+            if lazy:
+                # stream the store in bounded batches (values() decodes
+                # through the capped LRU): a 10M-row lazy corpus must not
+                # materialize ~60 GB of Records for a rebuild.  The record
+                # set, live count, and content stamp are all unchanged —
+                # only the feature tensors re-extract.
+                batch: List[Record] = []
+                for record in old_records.values():
+                    batch.append(record)
+                    if len(batch) >= 50_000:
+                        self._append_rows_only(batch)
+                        batch = []
+                if batch:
+                    self._append_rows_only(batch)
+            else:
+                self.records = {}
+                # live_records is deliberately NOT zeroed before the
+                # re-append: lock-free /stats readers must never observe a
+                # transient near-zero count for a populated corpus.  The
+                # re-append of the same record set double-counts (every
+                # record looks new against the cleared map), so the
+                # pre-rebuild count is subtracted once at the end — readers
+                # transiently see between 1x and 2x, never a collapse.
+                prev_live = self.live_records
+                # the record SET is unchanged by a rebuild; re-appending
+                # would fold every digest a second time (XOR: fold twice =
+                # remove), so the running hash is preserved
+                prev_hash = self._content_hash
+                if old_records:
+                    self._append_records(list(old_records.values()))
+                self.live_records -= prev_live
+                self._content_hash = prev_hash
 
     def find_record_by_id(self, record_id: str) -> Optional[Record]:
         return self.records.get(record_id)
@@ -557,19 +607,21 @@ class DeviceIndex(CandidateIndex):
         return out
 
     def delete(self, record: Record) -> None:
+        from ..store.records import LazyRecordMap, record_digest, xor_fold
+
         with self._lock:
+            lazy = isinstance(self.records, LazyRecordMap)
             row = self.id_to_row.pop(record.record_id, None)
             if row is not None:
+                # liveness from index state (see _append_records)
+                if not self.corpus.row_deleted[row]:
+                    self.live_records -= 1
                 self.corpus.tombstone(row)
             old = self.records.pop(record.record_id, None)
-            if old is not None:
-                from ..store.records import record_digest, xor_fold
-
+            if old is not None and not lazy:
                 self._content_hash = xor_fold(
                     self._content_hash, record_digest(old)
                 )
-                if not old.is_deleted():
-                    self.live_records -= 1
 
     def set_indexing_disabled(self, disabled: bool) -> None:
         self.indexing_disabled = disabled
@@ -609,11 +661,15 @@ class DeviceIndex(CandidateIndex):
         corpus = self.corpus
         if corpus.size == 0:
             return
-        # stamp the INDEX side's running digest (not the store's hash): a
-        # store commit whose scoring/index pass failed leaves the two
-        # different, and the restart's compare against the STORE hash must
-        # then reject the snapshot (stale features must never score)
-        content_hash = self._content_hash.hex()
+        # stamp the last store-synced digest when the workload maintains
+        # one (the lazy-mirror mode), else the index's own running fold —
+        # either way a store commit whose scoring/index pass failed leaves
+        # the stamp different from the store's current hash, and the
+        # restart's compare must then reject the snapshot (stale features
+        # must never score)
+        content_hash = (self._store_synced_hash
+                        if self._store_synced_hash is not None
+                        else self._content_hash.hex())
         # np.savez cannot round-trip ml_dtypes (bf16 loads back as raw
         # void); such tensors are saved as uint16 bit views and listed in
         # __bf16_keys so load can view them back
@@ -747,18 +803,39 @@ class DeviceIndex(CandidateIndex):
         )
         corpus.row_valid[: n] = row_valid
         corpus._dirty_masks = True
+        from ..store.records import LazyRecordMap
+
+        lazy = isinstance(records_by_id, LazyRecordMap)
         for rid, row, ok in zip(row_ids, rows, row_valid):
             if ok:
                 self.id_to_row[str(rid)] = int(row)
-                self.records[str(rid)] = records_by_id[str(rid)]
-        self.live_records = sum(
-            1 for r in self.records.values() if not r.is_deleted()
+                if not lazy:
+                    self.records[str(rid)] = records_by_id[str(rid)]
+        if lazy:
+            # store-backed on-demand mirror: restart skips materializing
+            # every record (the 10M-row eager decode took ~24 min / 60 GB)
+            self.records = records_by_id
+        # live = valid rows that are not dukeDeleted (identical to counting
+        # non-deleted records, without touching the record payloads)
+        self.live_records = int(
+            (np.asarray(row_valid) & ~np.asarray(row_deleted)).sum()
         )
-        # adopt the verified digest as the index's running hash (the
-        # restore bypassed _append_records' incremental fold)
+        # adopt the verified digest as the index's running hash AND the
+        # store-synced stamp (the restore bypassed the incremental fold)
         self._content_hash = accepted_hash
-        logger.info("corpus snapshot restored: %d rows from %s", n, path)
+        self._store_synced_hash = accepted_hash.hex()
+        logger.info("corpus snapshot restored: %d rows from %s%s", n, path,
+                    " (lazy record mirror)" if lazy else "")
         return True
+
+    def mark_store_synced(self, store_hash: Optional[str]) -> None:
+        """Record that the index has fully applied every store write up to
+        ``store_hash`` (the workload calls this after each successful
+        batch).  snapshot_save stamps this value; a store write without a
+        subsequent successful index commit leaves it stale and the next
+        restart replays."""
+        if store_hash is not None:
+            self._store_synced_hash = store_hash
 
     def close(self) -> None:
         pass

@@ -138,6 +138,18 @@ class Workload:
             raise req.error
         return []
 
+    def _mark_synced(self) -> None:
+        """Stamp the index as fully caught up with the store (consumed by
+        the snapshot staleness guard — engine.device_matcher
+        .mark_store_synced).  Called only after a batch applied end to
+        end; a failure between the store write and the index commit
+        leaves the stamp stale, forcing a replay on the next restart."""
+        if self.record_store is None:
+            return
+        mark = getattr(self.index, "mark_store_synced", None)
+        if mark is not None:
+            mark(self.record_store.content_hash())
+
     def _run_merged(self, work: List[_BatchRequest]) -> None:
         """Process queued requests as one batch (call with self.lock held).
 
@@ -186,6 +198,8 @@ class Workload:
                     self.index.commit()
                 if all_live:
                     self.processor.deduplicate(all_live)
+                if ok:
+                    self._mark_synced()
             except Exception as e:
                 for req in ok:
                     req.error = e
@@ -247,6 +261,7 @@ class Workload:
 
             if http_transform:
                 return self._transform_response(entities)
+            self._mark_synced()
             return []
         finally:
             self.index.set_indexing_disabled(False)
@@ -419,26 +434,39 @@ def build_workload(wc: WorkloadConfig, sc: ServiceConfig, *,
             # reference resumes by reopening its Lucene dir in APPEND mode —
             # IncrementalLuceneDatabase.java:233-244).  Device backends may
             # shortcut the per-record feature re-extraction through a
-            # corpus snapshot; the store stays the source of truth and any
-            # snapshot mismatch falls back to full replay.
-            records_by_id = {
-                r.record_id: r for r in record_store.all_records()
-            }
+            # corpus snapshot — attempted FIRST with a lazy store-backed
+            # record mirror, so a successful snapshot restart never decodes
+            # the whole store (the 10M-row eager decode took ~24 minutes);
+            # the store stays the source of truth and any snapshot mismatch
+            # falls back to full replay.
             loaded = False
-            if hasattr(index, "snapshot_load"):
+            snap = _snapshot_path(wc.data_folder)
+            if hasattr(index, "snapshot_load") and os.path.exists(snap):
+                from ..store.records import LazyRecordMap
+
                 loaded = index.snapshot_load(
-                    _snapshot_path(wc.data_folder), records_by_id,
+                    snap,
+                    LazyRecordMap(record_store),
                     content_hash=record_store.content_hash(),
                 )
-            if not loaded and records_by_id:
-                for record in records_by_id.values():
-                    index.index(record)
-                index.commit()
+            restored = loaded
+            if not loaded:
+                records_by_id = {
+                    r.record_id: r for r in record_store.all_records()
+                }
+                if records_by_id:
+                    restored = True
+                    for record in records_by_id.values():
+                        index.index(record)
+                    index.commit()
+                mark = getattr(index, "mark_store_synced", None)
+                if mark is not None:
+                    mark(record_store.content_hash())
             # the restored corpus' capacity/value-slot fingerprint differs
             # from the empty-corpus warm the processor ctor kicked; re-warm
             # so the first real batch doesn't stall on scorer compiles
             cache = getattr(index, "scorer_cache", None)
-            if records_by_id and cache is not None:
+            if restored and cache is not None:
                 cache.prewarm_async(group_filtering)
     except BaseException:
         # a half-built workload never reaches the caller; release whatever

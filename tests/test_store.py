@@ -272,3 +272,93 @@ def test_snapshot_rejected_when_store_mutates_after_save(tmp_path,
         assert rec.get_value("NAME") == "changed behind the snapshot"
     finally:
         wl2.close()
+
+
+def test_lazy_restart_updates_keep_snapshot_valid(tmp_path):
+    """r3 review regression: after a lazy snapshot restore, updating a
+    PRE-EXISTING record must keep the sync stamp coherent — the next
+    restart still rides the snapshot and serves the new value; and a
+    store write whose scoring pass failed must force a replay."""
+    from sesam_duke_microservice_tpu.store.records import LazyRecordMap
+
+    sc = parse_config(DEDUP_XML.format(folder=tmp_path),
+                      env={"MIN_RELEVANCE": "0.05"})
+    wc = sc.deduplications["people"]
+    wl = build_workload(wc, sc, backend="device", persistent=True)
+    with wl.lock:
+        wl.process_batch("crm", [
+            {"_id": str(i), "name": f"name {i}"} for i in range(8)
+        ])
+    wl.close()
+
+    # restart #1: lazy restore, then update record 3 end-to-end
+    wl2 = build_workload(wc, sc, backend="device", persistent=True)
+    assert isinstance(wl2.index.records, LazyRecordMap)
+    with wl2.lock:
+        wl2.process_batch("crm", [{"_id": "3", "name": "updated three"}])
+        assert wl2.index.find_record_by_id(
+            "crm__3").get_value("NAME") == "updated three"
+    wl2.close()
+
+    # restart #2: the snapshot (saved with the post-update stamp) must be
+    # ACCEPTED — no silent permanent replay — and serve the updated value
+    from sesam_duke_microservice_tpu.engine.device_matcher import DeviceIndex
+
+    real_extract = DeviceIndex._extract
+    calls = []
+
+    def counting(self, records, plan=None):
+        calls.append(len(records))
+        return real_extract(self, records, plan)
+
+    DeviceIndex._extract = counting
+    try:
+        wl3 = build_workload(wc, sc, backend="device", persistent=True)
+    finally:
+        DeviceIndex._extract = real_extract
+    with wl3.lock:
+        assert not calls, "snapshot rejected after a post-restore update"
+        assert wl3.index.find_record_by_id(
+            "crm__3").get_value("NAME") == "updated three"
+        assert wl3.index.live_records == 8
+
+        # divergence: store write whose index pass fails -> next restart
+        # must replay (stale features must never score)
+        wl3.record_store.put_many(
+            wl3.datasources["crm"].records_for_batch(
+                [{"_id": "5", "name": "written behind the index"}]
+            )
+        )
+    wl3.close()
+    wl4 = build_workload(wc, sc, backend="device", persistent=True)
+    with wl4.lock:
+        # replay (not snapshot) served the out-of-band value
+        assert wl4.index.find_record_by_id(
+            "crm__5").get_value("NAME") == "written behind the index"
+        assert not isinstance(wl4.index.records, LazyRecordMap)
+    wl4.close()
+
+
+def test_lazy_tombstone_keeps_live_count_exact(tmp_path):
+    """Deleting a pre-restore record through the lazy mirror must
+    decrement live_records exactly once (liveness from index state, not
+    store read-through)."""
+    sc = parse_config(DEDUP_XML.format(folder=tmp_path),
+                      env={"MIN_RELEVANCE": "0.05"})
+    wc = sc.deduplications["people"]
+    wl = build_workload(wc, sc, backend="device", persistent=True)
+    with wl.lock:
+        wl.process_batch("crm", [
+            {"_id": str(i), "name": f"name {i}"} for i in range(6)
+        ])
+    wl.close()
+
+    wl2 = build_workload(wc, sc, backend="device", persistent=True)
+    with wl2.lock:
+        assert wl2.index.live_records == 6
+        wl2.process_batch("crm", [{"_id": "2", "_deleted": True}])
+        assert wl2.index.live_records == 5
+        # re-delete is idempotent for the count
+        wl2.process_batch("crm", [{"_id": "2", "_deleted": True}])
+        assert wl2.index.live_records == 5
+    wl2.close()
