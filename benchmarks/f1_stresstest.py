@@ -67,16 +67,25 @@ _SYL = ["ba", "be", "bo", "da", "de", "di", "ga", "go", "ha", "he", "jo",
         "ve", "vi"]
 
 
-def _surname(rng: random.Random) -> str:
+def _surname(rng: random.Random, min_syllables: int = 2,
+             max_syllables: int = 4) -> str:
     # syllable-generated surnames: enough entropy that coincidental
     # full-name collisions between DIFFERENT identities stay rare at 10k+
     # scale (the fixed LAST pool saturates and poisons precision with
-    # generator artifacts rather than matcher errors)
-    return "".join(rng.choice(_SYL) for _ in range(rng.randint(2, 4))) + \
+    # generator artifacts rather than matcher errors).  At 10^6 scale the
+    # default 2-4 syllable space ITSELF saturates — ~1/3 of surnames draw
+    # from only ~7k forms, so hundreds of thousands of distinct identities
+    # genuinely collide within 0-2 edits and every engine (reference
+    # included) scores them above threshold; pass min_syllables=3,
+    # max_syllables=5 (--name-syllables 3-5) so precision at 1M measures
+    # the matcher, not the name pool.
+    n = rng.randint(min_syllables, max_syllables)
+    return "".join(rng.choice(_SYL) for _ in range(n)) + \
         rng.choice(["sen", "berg", "vik", "dal", "nes", "stad"])
 
 
-def generate(n_entities: int, dup_rate: float, seed: int = 1234):
+def generate(n_entities: int, dup_rate: float, seed: int = 1234,
+             name_syllables=(2, 4)):
     """Seeded corpus: ``n_entities`` records over ~n*(1-dup_rate) identities.
 
     Returns (records_as_dicts, truth) where truth maps record _id -> true
@@ -86,10 +95,11 @@ def generate(n_entities: int, dup_rate: float, seed: int = 1234):
     """
     rng = random.Random(seed)
     n_identities = max(1, int(n_entities * (1.0 - dup_rate)))
+    lo, hi = name_syllables
     identities = {}
     for ident in range(n_identities):
         identities[ident] = {
-            "name": f"{rng.choice(FIRST)} {_surname(rng)}",
+            "name": f"{rng.choice(FIRST)} {_surname(rng, lo, hi)}",
             "city": rng.choice(CITIES),
             "ssn": str(rng.randint(10_000_000, 99_999_999)),
         }
@@ -251,13 +261,14 @@ def truth_links(t1, t2):
 
 def run(backend: str, n_entities: int, dup_rate: float, batch: int,
         seed: int = 1234, workload: str = "dedup",
-        one_to_one: bool = False):
+        one_to_one: bool = False, name_syllables=(2, 4)):
     from sesam_duke_microservice_tpu.core.records import (
         GROUP_NO_PROPERTY_NAME,
     )
 
     if workload == "linkage":
         g1, g2, t1, t2 = generate_linkage(n_entities // 2, dup_rate, seed)
+        del name_syllables  # linkage harness keeps the default pool
         r1, r2 = to_records(g1), to_records(g2)
         for r in r1:
             r.add_value(GROUP_NO_PROPERTY_NAME, "1")
@@ -266,7 +277,8 @@ def run(backend: str, n_entities: int, dup_rate: float, batch: int,
         records = r1 + r2
         expected_links = truth_links(t1, t2)
     else:
-        rows, truth = generate(n_entities, dup_rate, seed)
+        rows, truth = generate(n_entities, dup_rate, seed,
+                               name_syllables=name_syllables)
         records = to_records(rows)
         expected_links = None
 
@@ -299,6 +311,11 @@ def run(backend: str, n_entities: int, dup_rate: float, batch: int,
         collector = PairCollector()
         proc.add_match_listener(collector)
 
+    escalations_start = 0
+    if backend in ("device", "ann"):
+        from sesam_duke_microservice_tpu.engine import device_matcher as DM
+
+        escalations_start = DM.ESCALATIONS
     t0 = time.perf_counter()
     for start in range(0, len(records), batch):
         proc.deduplicate(records[start:start + batch])
@@ -373,6 +390,12 @@ def run(backend: str, n_entities: int, dup_rate: float, batch: int,
         out["retrieval_s"] = round(stats.retrieval_seconds, 2)
         out["compare_s"] = round(stats.compare_seconds, 2)
         out["pairs_compared"] = stats.pairs_compared
+    if backend in ("device", "ann"):
+        from sesam_duke_microservice_tpu.engine import device_matcher as DM
+
+        # delta vs run start so repeated in-process runs don't attribute
+        # earlier configurations' escalations to this one
+        out["escalations"] = DM.ESCALATIONS - escalations_start
     return out
 
 
@@ -388,10 +411,15 @@ def main():
                     choices=["dedup", "linkage"])
     ap.add_argument("--one-to-one", action="store_true",
                     help="greedy best-match assignment (ONE_TO_ONE policy)")
+    ap.add_argument("--name-syllables", default="2-4",
+                    help="surname syllable range lo-hi (use 3-5 at 10^6 "
+                         "scale so the name pool doesn't saturate)")
     args = ap.parse_args()
+    lo, hi = (int(x) for x in args.name_syllables.split("-"))
     print(json.dumps(
         run(args.backend, args.entities, args.dup_rate, args.batch,
-            args.seed, workload=args.workload, one_to_one=args.one_to_one)
+            args.seed, workload=args.workload, one_to_one=args.one_to_one,
+            name_syllables=(lo, hi))
     ))
 
 

@@ -102,9 +102,13 @@ class _AnnScorerCache(_ScorerCache):
         )
 
     def _lower_one(self, row_feats, cap: int, bucket: int,
-                   group_filtering: bool):
+                   group_filtering: bool, *, from_rows: bool = True,
+                   probe_feats=None):
         """ANN pre-warm: the scorer signature carries the embedding matrix
-        separately from the feature tree (see dispatch_block)."""
+        separately from the feature tree (see dispatch_block).  Covers both
+        variants — from_rows=True (indexed batches gather on device) and
+        from_rows=False (http-transform probes upload bucket-shaped
+        qfeats + a (bucket, dim) query embedding)."""
         import jax
 
         row_feats = dict(row_feats)
@@ -113,13 +117,30 @@ class _AnnScorerCache(_ScorerCache):
             row_feats, cap, bucket
         )
         corpus_emb = jax.ShapeDtypeStruct((cap,) + emb.shape[1:], emb.dtype)
-        q_emb = jax.ShapeDtypeStruct((), np.float32)
         c = min(self.index.initial_top_c, cap)
         # private jit instance via the shared builder — see
         # _ScorerCache._lower_one
-        scorer = self._build(c, group_filtering, True)
+        scorer = self._build(c, group_filtering, from_rows)
+        if from_rows:
+            q_emb = jax.ShapeDtypeStruct((), np.float32)
+            qfeats = {}
+        else:
+            pf = dict(probe_feats)
+            pemb = pf.pop(E.ANN_PROP)[E.ANN_TENSOR]
+            q_emb = jax.ShapeDtypeStruct(
+                (bucket,) + pemb.shape[1:], pemb.dtype
+            )
+            qfeats = {
+                prop: {
+                    name: jax.ShapeDtypeStruct(
+                        (bucket,) + arr.shape[1:], arr.dtype
+                    )
+                    for name, arr in tensors.items()
+                }
+                for prop, tensors in pf.items()
+            }
         scorer.lower(
-            q_emb, {}, corpus_emb, cfeats, mb, mb2, mi, qg, qr, ml
+            q_emb, qfeats, corpus_emb, cfeats, mb, mb2, mi, qg, qr, ml
         ).compile()
 
     def dispatch_block(self, records: Sequence[Record], *,

@@ -447,12 +447,17 @@ class DeviceIndex(CandidateIndex):
         for r in pending:
             by_id[r.record_id] = r
         records = list(by_id.values())
+        # capture pre-batch liveness BEFORE any value-slot rebuild: a lazy
+        # rebuild streams record state from the STORE, which the workload
+        # already updated with this batch — rows rebuilt from it reflect
+        # the new state, so liveness read after the rebuild would be wrong
+        old_live = self._old_liveness(records)
         self._maybe_grow_value_slots(records)
         for r in records:
             old = self.id_to_row.get(r.record_id)
             if old is not None:
                 self.corpus.tombstone(old)
-        self._append_records(records)
+        self._append_records(records, old_live=old_live)
 
     def _append_rows_only(self, records: Sequence[Record]) -> np.ndarray:
         """Extract + corpus append + row mapping — no record-mirror, hash,
@@ -470,21 +475,27 @@ class DeviceIndex(CandidateIndex):
             self.id_to_row[r.record_id] = int(row)
         return rows
 
-    def _append_records(self, records: Sequence[Record]) -> None:
-        from ..store.records import LazyRecordMap, record_digest, xor_fold
-
-        # old-liveness from INDEX state (id_to_row + the old row's deleted
-        # mask), never from a mirror read: a lazy mirror reads through to
-        # the store, which the workload already updated with the NEW
-        # values — counting (or hash-folding) those as "old" silently
-        # corrupts the live count and the content digest
-        old_live = []
+    def _old_liveness(self, records: Sequence[Record]) -> List[bool]:
+        """Pre-batch liveness per record, from INDEX state (id_to_row +
+        the old row's deleted mask) — never from a mirror read: a lazy
+        mirror reads through to the store, which the workload already
+        updated with the NEW values, and counting (or hash-folding) those
+        as "old" silently corrupts the live count and the content digest."""
         corpus = self.corpus
+        out = []
         for r in records:
             old_row = self.id_to_row.get(r.record_id)
-            old_live.append(
+            out.append(
                 old_row is not None and not corpus.row_deleted[old_row]
             )
+        return out
+
+    def _append_records(self, records: Sequence[Record],
+                        old_live: Optional[List[bool]] = None) -> None:
+        from ..store.records import LazyRecordMap, record_digest, xor_fold
+
+        if old_live is None:
+            old_live = self._old_liveness(records)
         self._append_rows_only(records)
         lazy = isinstance(self.records, LazyRecordMap)
         delta = 0
@@ -613,15 +624,20 @@ class DeviceIndex(CandidateIndex):
             lazy = isinstance(self.records, LazyRecordMap)
             row = self.id_to_row.pop(record.record_id, None)
             if row is not None:
-                # liveness from index state (see _append_records)
+                # liveness from index state (see _old_liveness)
                 if not self.corpus.row_deleted[row]:
                     self.live_records -= 1
                 self.corpus.tombstone(row)
-            old = self.records.pop(record.record_id, None)
-            if old is not None and not lazy:
-                self._content_hash = xor_fold(
-                    self._content_hash, record_digest(old)
-                )
+            if lazy:
+                # no decode: the removed value is unused in lazy mode
+                # (the content fold rides the store-synced stamp)
+                self.records.discard(record.record_id)
+            else:
+                old = self.records.pop(record.record_id, None)
+                if old is not None:
+                    self._content_hash = xor_fold(
+                        self._content_hash, record_digest(old)
+                    )
 
     def set_indexing_disabled(self, disabled: bool) -> None:
         self.indexing_disabled = disabled
@@ -1174,6 +1190,19 @@ class _PendingBlock:
         self.count = count
 
 
+# process-wide escalation count (observability: the F1-at-scale harness
+# reports how often K/C-escalation actually fired at a given corpus size).
+# Guarded: resolve_block runs on multiple workload threads in service mode.
+ESCALATIONS = 0
+_ESCALATIONS_LOCK = threading.Lock()
+
+
+def _count_escalation() -> None:
+    global ESCALATIONS
+    with _ESCALATIONS_LOCK:
+        ESCALATIONS += 1
+
+
 def resolve_block(pending) -> _BlockResult:
     """Wait for a dispatched block; re-run with doubled width if the
     backend's saturation predicate fires (exactness / recall contract)."""
@@ -1192,6 +1221,7 @@ def resolve_block(pending) -> _BlockResult:
                 pending.min_logit,
             )
         k = min(k * 2, pending.capacity)
+        _count_escalation()
         logger.info(
             "escalation: %d candidates at the bound, retrying with "
             "width=%d", cmax, k,

@@ -29,10 +29,17 @@ same double-precision path — so emitted probabilities are bit-identical to
 the single-chip backends (differential-tested in
 ``tests/test_sharded_service.py`` on the virtual 8-device mesh).
 
-Deployment: single-host this shards over local devices; multi-host, call
-``parallel.multihost.initialize()`` first (build_workload does) and the
-record axis spans every chip in the job, with the merge collective riding
-ICI within a slice and DCN across slices.
+Deployment: single-host this shards over every local device — the
+flagship v5e-8 configuration (BASELINE configs[4]) runs one process
+driving all 8 chips, full REST surface included.  Multi-host meshes
+(``parallel.multihost.initialize()``) are supported by the scoring
+programs themselves (the collectives ride ICI within a slice and DCN
+across — exercised by tests/test_multihost.py), but the HTTP frontend is
+a single-controller: in a multi-process job the follower processes must
+run the same jitted programs in lockstep, which needs a follower dispatch
+loop (frontend broadcasts each batch's shapes over DCN) that is not built
+yet — multi-host serving is the one remaining step between "collective
+stack works multi-host" and "service scales past one host".
 """
 
 from __future__ import annotations

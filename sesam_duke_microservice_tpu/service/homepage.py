@@ -48,6 +48,18 @@ def render_homepage(app) -> str:
             )
         rows.append("</ul>")
 
+    rows.append("<h2>Operations</h2><ul>")
+    rows.append(f"<li>GET {link('/health')} &mdash; liveness</li>")
+    rows.append(
+        f"<li>GET {link('/stats')} &mdash; per-workload counters "
+        "(records, batches, pairs, timings)</li>"
+    )
+    rows.append(
+        "<li>POST /{deduplication|recordlinkage}/:name/rematch &mdash; "
+        "ring bulk re-match / link-DB backfill (device backends)</li>"
+    )
+    rows.append("</ul>")
+
     body = "\n".join(rows)
     return f"""<!DOCTYPE html>
 <html>

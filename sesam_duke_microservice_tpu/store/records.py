@@ -162,6 +162,12 @@ class LazyRecordMap:
         self._lru.pop(rid, None)
         return record
 
+    def discard(self, rid: str) -> None:
+        """Remove without decoding (pop pays a store read just to return
+        a value the lazy-mode delete path never uses)."""
+        self._ids.discard(rid)
+        self._lru.pop(rid, None)
+
     def __contains__(self, rid) -> bool:
         return rid in self._ids
 

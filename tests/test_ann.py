@@ -231,3 +231,28 @@ class TestAnnSnapshot:
         assert index2.snapshot_load(
             path, {r.record_id: r for r in records}
         ) is False
+
+
+def test_ann_prewarm_compiles_both_variants(monkeypatch):
+    """r3 regression: the prewarm ladder lowers BOTH scorer variants for
+    the ANN cache (from_rows=True and the http-transform probe shape) —
+    the r3 base-class change added kwargs the ANN override lacked, so the
+    warm thread died with TypeError and the ladder silently stopped."""
+    from sesam_duke_microservice_tpu.engine.ann_matcher import (
+        AnnIndex,
+        AnnProcessor,
+    )
+
+    monkeypatch.setenv("DEVICE_PREWARM", "1")
+    schema = dedup_schema()
+    records = random_records(24, seed=11)
+    index = AnnIndex(schema, tunables=MatchTunables())
+    proc = AnnProcessor(schema, index)
+    proc.deduplicate(records)
+    cache = index.scorer_cache
+    assert cache._warm_thread is not None
+    cache._warm_thread.join(timeout=240)
+    assert not cache._warm_thread.is_alive()
+    # both variants per (capacity, bucket) step -> an odd ladder would
+    # mean one variant failed; >= 2 proves at least one full step of both
+    assert cache._warm_compiled >= 2, cache._warm_compiled
