@@ -26,9 +26,12 @@ import numpy as np
 
 # bench sizes (env-overridable for quick runs).  The default corpus matches
 # the reference stresstest's total size (2 x 10,000 seeded entities,
-# sesam_node_deduplication_stresstest_config.conf.json).
+# sesam_node_deduplication_stresstest_config.conf.json).  The 8192-query
+# batch exercises the multi-block pipeline (double-buffered dispatch over
+# 4096-query buckets) — the steady-state serving regime the microbatch
+# queue produces under load; r2 measured single-block 1024-query batches.
 CORPUS = int(os.environ.get("BENCH_CORPUS", "20000"))
-QUERIES = int(os.environ.get("BENCH_QUERIES", "1024"))
+QUERIES = int(os.environ.get("BENCH_QUERIES", "8192"))
 CPU_SAMPLE_PAIRS = int(os.environ.get("BENCH_CPU_PAIRS", "20000"))
 
 

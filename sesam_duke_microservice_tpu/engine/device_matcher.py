@@ -58,13 +58,16 @@ logger = logging.getLogger("device-matcher")
 # recompile the scorer (static shapes; SURVEY.md section 7 hard part 2).
 # Env-tunable so the CPU test backend can use small shapes; TPU defaults
 # are sized for the MXU/VPU (DEVICE_CHUNK rows of corpus per scan step).
-# Measured on v5e (20k corpus, 1024 queries): chunk 8192 + bucket 1024 runs
-# the scorer at ~38M exact pairs/s vs ~16M at chunk 512 + bucket 256 — the
-# scan-step fixed costs (top-K merge, kernel dispatch) amortize over 16x
-# more rows and 4x more queries per step.
+# Measured on v5e (20k corpus): chunk 8192 + bucket 1024 runs the scorer at
+# ~38M exact pairs/s vs ~16M at chunk 512 + bucket 256 — the scan-step
+# fixed costs (top-K merge, kernel dispatch) amortize over 16x more rows
+# and 4x more queries per step.  r3: the ladder extends to 4096-query
+# blocks — an 8192-query batch runs 86.7M pairs/s end-to-end at bucket
+# 4096 vs 67.8M at 1024 (per-block dispatch/fetch overhead halves twice);
+# intermediate 2048 keeps mid-size batches from over-padding.
 _QUERY_BUCKETS = tuple(
     int(b) for b in os.environ.get(
-        "DEVICE_QUERY_BUCKETS", "16,128,1024"
+        "DEVICE_QUERY_BUCKETS", "16,128,1024,2048,4096"
     ).split(",")
 )
 _CHUNK = int(os.environ.get("DEVICE_CHUNK", "8192"))
