@@ -140,9 +140,9 @@ def stresstest_schema(ssn_exact: bool = False):
 
     ``ssn_exact`` swaps the ssn comparator from QGram(high=0.9) to Exact:
     q-grams over 8-digit strings draw from only 100 possible bigrams, so
-    at 10^6-pair density two UNRELATED ssns routinely share enough grams
-    to score 0.7+, and (with a city match) the Bayes product crosses the
-    threshold — FPs every engine emits identically (host-exact verified),
+    at 10^6-entity (~10^12 candidate-pair) density two UNRELATED ssns
+    routinely share enough grams to score 0.7+, and (with a city match)
+    the Bayes product crosses the threshold — FPs every engine emits identically (host-exact verified),
     i.e. a schema artifact, not a matcher one.  Large-corpus quality runs
     use --ssn-exact so precision measures the matcher.  The default stays
     QGram for continuity with the 10k-scale numbers in BASELINE.md.

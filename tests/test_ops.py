@@ -372,3 +372,57 @@ def test_host_bound_logit():
              Property("b", C.PersonName(), 0.4, 0.5)]
     bound = S.host_bound_logit(props)
     assert bound == pytest.approx(S.probability_to_logit(0.8), abs=1e-9)
+
+
+def test_fnv1a64_batch_matches_scalar():
+    """The vectorized ingest hash is bit-identical to the scalar fold
+    (device/host equality and snapshot compatibility both ride on it)."""
+    import numpy as np
+
+    from sesam_duke_microservice_tpu.ops.features import (
+        fnv1a64,
+        fnv1a64_batch,
+    )
+
+    values = [
+        "", "a", "kitten", "a" * 300, "Åse Strøm", "日本語テキスト",
+        "\udc80lone-surrogate", "mixed 123 !@#", "\x00nul", "🎉emoji",
+        "b" * 4096, "c" * 4097, "d" * 20000,   # bucket edge + scalar fallback
+    ]
+    got = fnv1a64_batch(values)
+    assert got.dtype == np.uint64
+    for v, h in zip(values, got):
+        assert int(h) == fnv1a64(v), repr(v)
+
+
+def test_extract_property_batched_hashing_parity():
+    """extract_property's vectorized path produces the same tensors as
+    direct scalar hashing for every feature kind's hash fields."""
+    import numpy as np
+
+    from sesam_duke_microservice_tpu.core import comparators as C
+    from sesam_duke_microservice_tpu.ops import features as F
+
+    values = [["kitten", "sitting"], [], ["Åse"], ["a b c d", "x"], [""]]
+    values = [[v for v in vs if v] for vs in values]
+    for comparator, kind in [
+        (C.Levenshtein(), F.CHARS),
+        (C.QGram(), F.GRAM_SET),
+        (C.JaccardIndex(), F.TOKEN_SET),
+        (C.Exact(), F.HASH),
+        (C.Soundex(), F.PHONETIC),
+    ]:
+        spec = F.PropertyFeatureSpec(
+            name="p", kind=kind, low=0.3, high=0.9,
+            comparator=comparator, values_per_record=2,
+        )
+        out = F.extract_property(spec, values)
+        for i, vs in enumerate(values):
+            for k, v in enumerate(vs[:2]):
+                hi, lo = F._hash2x32(v)
+                assert out["hash_hi"][i, k] == hi, (kind, v)
+                assert out["hash_lo"][i, k] == lo, (kind, v)
+                assert out["valid"][i, k]
+        if kind == F.CHARS:
+            assert out["chars"][0, 0, :6].tolist() == [ord(c) for c in "kitten"]
+            assert out["length"][2, 0] == 3

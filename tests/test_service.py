@@ -670,3 +670,62 @@ def test_feed_pages_do_not_skip_or_duplicate_ties(server_url):
                 seen.extend((l.id1, l.id2) for l in page)
                 cursor = page[-1].timestamp
             assert len(seen) == len(set(seen)) == 8
+
+
+def test_feed_stream_aborts_on_mid_stream_workload_removal(monkeypatch):
+    """A config reload that removes the workload mid-stream must truncate
+    the chunked framing (protocol error at the client), never close the
+    array cleanly — a clean ']' would make the partial feed look complete."""
+    import http.client
+    import os
+
+    import sesam_duke_microservice_tpu.service.app as app_module
+    from sesam_duke_microservice_tpu.links.base import (
+        Link,
+        LinkKind,
+        LinkStatus,
+    )
+
+    monkeypatch.setenv("MIN_RELEVANCE", "0.05")
+    monkeypatch.setenv("FEED_PAGE_SIZE", "10")
+    sc = parse_config(CONFIG_XML)
+    app = DukeApp(sc, persistent=False)
+    wl = app.deduplications["people"]
+    base_ts = 1_700_000_000_000
+    for i in range(200):
+        wl.link_database.assert_link(
+            Link(f"crm__a{i}", f"web__b{i}", LinkStatus.INFERRED,
+                 LinkKind.DUPLICATE, 0.9, timestamp=base_ts + i)
+        )
+
+    # remove the workload from the registry after the third page
+    real_page = wl.links_page
+    pages = []
+
+    def hooked(since, limit):
+        pages.append(since)
+        if len(pages) == 3:
+            app.deduplications = {}
+        return real_page(since, limit)
+
+    wl.links_page = hooked
+    server = serve(app, port=0, host="127.0.0.1")
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", server.server_address[1], timeout=60
+        )
+        conn.request("GET", "/deduplication/people?since=0")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        with pytest.raises(
+                (http.client.IncompleteRead, http.client.HTTPException,
+                 ConnectionError)):
+            body = resp.read()
+            # some stacks surface truncation as a short read instead of
+            # raising — a clean read must at least NOT be a complete array
+            raise http.client.IncompleteRead(body) if not body.endswith(
+                b"]") else AssertionError(f"clean close: ...{body[-20:]!r}")
+    finally:
+        server.shutdown()
+        app.close()
