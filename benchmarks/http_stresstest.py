@@ -13,7 +13,7 @@ incrementally like a ``supports_since`` source pipe.
 
 Usage::
 
-    python benchmarks/http_stresstest.py [--backend host|device|ann]
+    python benchmarks/http_stresstest.py [--backend host|device|ann|sharded|sharded-brute]
         [--entities 10000] [--batch 500] [--concurrency 4]
         [--workload dedup|linkage]
 
@@ -109,7 +109,7 @@ def run(backend: str, entities: int, batch: int, concurrency: int,
         enable_persistent_cache,
     )
 
-    if backend in ("device", "ann"):
+    if backend in ("device", "ann", "sharded", "sharded-brute"):
         enable_persistent_cache()
     # config env flags apply only to this run's config parse — mutate and
     # restore so in-process callers (the smoke test) don't leak mode
@@ -206,7 +206,8 @@ def run(backend: str, entities: int, batch: int, concurrency: int,
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--backend", default="host",
-                    choices=["host", "device", "ann"])
+                    choices=["host", "device", "ann", "sharded",
+                             "sharded-brute"])
     ap.add_argument("--entities", type=int, default=10000)
     ap.add_argument("--batch", type=int, default=500)
     ap.add_argument("--concurrency", type=int, default=4)

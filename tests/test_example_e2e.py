@@ -114,7 +114,7 @@ def expected_links(threshold):
     return out
 
 
-@pytest.fixture(scope="module", params=["host", "device"])
+@pytest.fixture(scope="module", params=["host", "device", "sharded"])
 def example_server(request):
     os.environ["MIN_RELEVANCE"] = "0.05"  # tiny corpus: don't prune on tf-idf
     try:

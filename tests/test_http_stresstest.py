@@ -52,3 +52,15 @@ def test_http_stresstest_driver_smoke():
     # tests parse configs against os.environ)
     assert {k: os.environ.get(k) for k in ("ONE_TO_ONE", "MIN_RELEVANCE")} \
         == {k: env_before.get(k) for k in ("ONE_TO_ONE", "MIN_RELEVANCE")}
+
+
+def test_http_stresstest_driver_sharded_smoke():
+    """The same Sesam-node pipe shape through the mesh serving backend
+    (concurrent POSTs microbatch onto the sharded scorer)."""
+    http_stresstest = _load_driver()
+    out = http_stresstest.run(
+        "sharded", entities=200, batch=50, concurrency=2, workload="dedup"
+    )
+    assert out["entities"] == 200
+    assert out["links"] > 0
+    assert out["f1"] > 0.8, out
