@@ -295,10 +295,17 @@ class Workload:
         }
 
     def links_since(self, since: int = 0) -> List[dict]:
-        return [
-            self._link_row(link)
-            for link in self.link_database.get_changes_since(since)
-        ]
+        """Full materialized feed (the HTTP layer streams via links_page;
+        this serves the HTTP/1.0 fallback and tests).  Internally paged so
+        lazy record mirrors resolve endpoints through bounded batched
+        prefetches instead of one point SELECT per link."""
+        rows: List[dict] = []
+        cursor = since
+        while True:
+            page, cursor = self.links_page(cursor, 5000)
+            if not page:
+                return rows
+            rows.extend(page)
 
     def links_page(self, since: int, limit: int):
         """One bounded feed page: (rows, next_cursor).
@@ -313,6 +320,15 @@ class Workload:
         links = self.link_database.get_changes_page(since, limit)
         if not links:
             return [], since
+        # lazy record mirrors resolve link endpoints from the store; warm
+        # the page's working set in one batched query instead of 2 x page
+        # point lookups under the lock
+        prefetch = getattr(
+            getattr(self.index, "records", None), "prefetch", None
+        )
+        if prefetch is not None:
+            ids = {l.id1 for l in links} | {l.id2 for l in links}
+            prefetch(ids)
         return [self._link_row(l) for l in links], links[-1].timestamp
 
     def save_corpus_snapshot(self) -> None:

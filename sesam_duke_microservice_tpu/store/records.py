@@ -192,6 +192,27 @@ class LazyRecordMap:
             if record is not None:
                 yield record
 
+    def prefetch(self, rids) -> None:
+        """Warm the LRU with a batch of ids in few store round trips —
+        page-sized feed resolution would otherwise pay one SELECT per
+        link endpoint under the workload lock.  Bounded: at most
+        ``_LRU_CAP`` ids (beyond that, earlier entries would evict before
+        use), fetched in chunks so no single call materializes an
+        unbounded record dict."""
+        want = [
+            rid for rid in rids
+            if rid in self._ids and rid not in self._lru
+        ]
+        if not want:
+            return
+        want = want[: self._LRU_CAP]
+        get_many = getattr(self._store, "get_many", None)
+        if get_many is None:
+            return  # per-id gets will serve (in-memory stores are cheap)
+        for start in range(0, len(want), 10_000):
+            for rid, record in get_many(want[start:start + 10_000]).items():
+                self._cache(rid, record)
+
 
 class InMemoryRecordStore(RecordStore):
     """Non-durable store; the counterpart of Lucene's RAMDirectory fallback
@@ -335,6 +356,21 @@ class SqliteRecordStore(RecordStore):
             "SELECT data FROM records WHERE id = ?", (record_id,)
         ).fetchone()
         return self._decode(row[0]) if row else None
+
+    def get_many(self, record_ids) -> Dict[str, Record]:
+        """Batched lookup (one query per 450-id chunk) — the feed's page
+        resolution touches up to 2 x page_size records at once."""
+        ids = [rid for rid in record_ids]
+        out: Dict[str, Record] = {}
+        conn = self._conn()
+        for start in range(0, len(ids), 450):  # host-parameter cap
+            chunk = ids[start:start + 450]
+            marks = ",".join("?" * len(chunk))
+            for rid, data in conn.execute(
+                f"SELECT id, data FROM records WHERE id IN ({marks})", chunk
+            ):
+                out[rid] = self._decode(data)
+        return out
 
     def all_records(self) -> Iterator[Record]:
         for (data,) in self._conn().execute(

@@ -362,3 +362,46 @@ def test_lazy_tombstone_keeps_live_count_exact(tmp_path):
         wl2.process_batch("crm", [{"_id": "2", "_deleted": True}])
         assert wl2.index.live_records == 5
     wl2.close()
+
+
+def test_lazy_feed_page_prefetch_batches_lookups(tmp_path):
+    """Feed pages over a lazy mirror resolve their link endpoints via one
+    batched store query, and the rows come out identical to eager."""
+    from sesam_duke_microservice_tpu.store.records import (
+        LazyRecordMap,
+        SqliteRecordStore,
+    )
+
+    sc = parse_config(DEDUP_XML.format(folder=tmp_path),
+                      env={"MIN_RELEVANCE": "0.05"})
+    wc = sc.deduplications["people"]
+    wl = build_workload(wc, sc, backend="device", persistent=True)
+    with wl.lock:
+        wl.process_batch("crm", [
+            {"_id": str(i), "name": f"dupname {i // 2}"} for i in range(40)
+        ])
+        eager_rows = wl.links_since(0)
+    assert eager_rows
+    wl.close()
+
+    wl2 = build_workload(wc, sc, backend="device", persistent=True)
+    try:
+        assert isinstance(wl2.index.records, LazyRecordMap)
+        gets = []
+        real_get = SqliteRecordStore.get
+
+        def counting_get(self, rid):
+            gets.append(rid)
+            return real_get(self, rid)
+
+        SqliteRecordStore.get = counting_get
+        try:
+            with wl2.lock:
+                rows, _ = wl2.links_page(0, 1000)
+        finally:
+            SqliteRecordStore.get = real_get
+        assert rows == eager_rows
+        # resolution rode the batched prefetch, not per-id point gets
+        assert not gets, f"{len(gets)} point lookups during page resolution"
+    finally:
+        wl2.close()
