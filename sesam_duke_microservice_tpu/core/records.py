@@ -104,10 +104,14 @@ class Record:
     strings; empty strings are never stored (Duke's RecordBuilder drops them).
     """
 
-    __slots__ = ("_values",)
+    __slots__ = ("_values", "_digest_cache")
 
     def __init__(self, values: Optional[Dict[str, List[str]]] = None):
         self._values: Dict[str, List[str]] = {}
+        # memoized content digest (store.records.record_digest): the
+        # persistent ingest path digests every record twice (store row +
+        # index fold); mutation invalidates
+        self._digest_cache: Optional[bytes] = None
         if values:
             for name, vals in values.items():
                 for v in vals:
@@ -117,12 +121,16 @@ class Record:
         if value is None or value == "":
             return
         self._values.setdefault(prop, []).append(str(value))
+        self._digest_cache = None
 
     def properties(self) -> Sequence[str]:
         return list(self._values.keys())
 
     def get_values(self, prop: str) -> List[str]:
-        return self._values.get(prop, [])
+        # a COPY: handing out the live list would let callers mutate the
+        # record behind add_value's back (the digest memo must see every
+        # mutation, and Duke records are value objects)
+        return list(self._values.get(prop, ()))
 
     def get_value(self, prop: str) -> Optional[str]:
         vals = self._values.get(prop)

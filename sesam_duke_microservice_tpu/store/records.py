@@ -34,17 +34,31 @@ def _row_digest(rid: str, data: str) -> bytes:
     return h.digest()
 
 
+def serialize_record(record: Record) -> str:
+    """THE canonical record serialization — the store row payload AND the
+    digest input share this one function, so the two can never drift."""
+    return json.dumps(record.to_dict(), separators=(",", ":"))
+
+
 def record_digest(record: Record) -> bytes:
     """``_row_digest`` of a live Record — the SAME bytes the store folds
     for its serialized row, so an index-side incremental hash and the
     store's incremental hash agree exactly when (and only when) their
-    record sets agree."""
+    record sets agree.  Memoized on core Records only (invalidated by
+    ``add_value``; ``get_values`` returns copies so no mutation bypasses
+    it): the persistent ingest path digests each record for the store
+    row AND the index fold.  Foreign record-like objects are never
+    cached — nothing would invalidate them."""
+    memoizable = type(record) is Record
+    if memoizable and record._digest_cache is not None:
+        return record._digest_cache
     rid = record.record_id
     if rid is None:
         raise ValueError("record has no ID property")
-    return _row_digest(
-        rid, json.dumps(record.to_dict(), separators=(",", ":"))
-    )
+    digest = _row_digest(rid, serialize_record(record))
+    if memoizable:
+        record._digest_cache = digest
+    return digest
 
 
 def _xor(a: bytes, b: bytes) -> bytes:
@@ -297,7 +311,7 @@ class SqliteRecordStore(RecordStore):
         rid = record.record_id
         if rid is None:
             raise ValueError("record has no ID property")
-        return rid, json.dumps(record.to_dict(), separators=(",", ":"))
+        return rid, serialize_record(record)
 
     def put(self, record: Record) -> None:
         self.put_many([record])
@@ -307,9 +321,11 @@ class SqliteRecordStore(RecordStore):
         # (REPLACE semantics); dedupe up front so the hash folds each id
         # exactly once
         by_id = {}
+        rec_by_id = {}
         for r in records:
             rid, data = self._encode(r)
             by_id[rid] = data
+            rec_by_id[rid] = r
         rows = list(by_id.items())
         if not rows:
             return
@@ -329,7 +345,14 @@ class SqliteRecordStore(RecordStore):
                 ):
                     acc = _xor(acc, _row_digest(rid, data))
             for rid, data in rows:
-                acc = _xor(acc, _row_digest(rid, data))
+                digest = _row_digest(rid, data)
+                acc = _xor(acc, digest)
+                # seed the record's memo: the index folds the same digest
+                # right after this put (engine.device_matcher); safe
+                # because the row data IS serialize_record(record)
+                record = rec_by_id[rid]
+                if type(record) is Record:
+                    record._digest_cache = digest
             # REPLACE deletes-then-inserts under the hood, assigning a fresh
             # rowid so replay order tracks last write — mirroring Lucene's
             # delete-then-readd on reindex; one transaction per batch

@@ -405,3 +405,27 @@ def test_lazy_feed_page_prefetch_batches_lookups(tmp_path):
         assert not gets, f"{len(gets)} point lookups during page resolution"
     finally:
         wl2.close()
+
+
+def test_record_digest_memo_invalidates_on_mutation():
+    """record_digest memoizes per record but mutation invalidates; the
+    digest stays a pure function of content."""
+    from sesam_duke_microservice_tpu.store.records import record_digest
+
+    r = _record("x", NAME="a")
+    d1 = record_digest(r)
+    assert record_digest(r) == d1
+    r.add_value("NAME", "b")
+    d2 = record_digest(r)
+    assert d2 != d1
+    fresh = _record("x", NAME="a")
+    fresh.add_value("NAME", "b")
+    assert record_digest(fresh) == d2
+
+    # store put seeds the memo with the row digest it folded
+    store = SqliteRecordStore(":memory:")
+    rec = _record("y", NAME="z")
+    store.put(rec)
+    assert rec._digest_cache is not None
+    assert record_digest(rec) == rec._digest_cache
+    store.close()
