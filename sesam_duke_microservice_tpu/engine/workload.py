@@ -84,6 +84,15 @@ class Workload:
         # held while acquiring self.lock)
         self._mb_mutex = threading.Lock()
         self._mb_queue: List[_BatchRequest] = []
+        # Sticky store/index divergence latch: set when a record_store
+        # write committed but its index application (tombstone indexing /
+        # link retraction / scoring pass) then failed.  While set, the
+        # store holds rows the index never applied, so _mark_synced must
+        # never stamp again in this process — ANY later stamp would cover
+        # the orphaned rows (the store hash includes them) and the restart
+        # staleness guard would skip the replay that re-applies them.
+        # Cleared only by a restart replay (a fresh Workload).
+        self._store_dirty = False
         self.datasources: Dict[str, IncrementalDataSource] = {
             ds.dataset_id: IncrementalDataSource(ds)
             for ds in config.duke.data_sources
@@ -143,8 +152,11 @@ class Workload:
         the snapshot staleness guard — engine.device_matcher
         .mark_store_synced).  Called only after a batch applied end to
         end; a failure between the store write and the index commit
-        leaves the stamp stale, forcing a replay on the next restart."""
-        if self.record_store is None:
+        leaves the stamp stale, forcing a replay on the next restart.
+        Once any batch left the store ahead of the index
+        (``_store_dirty``), no later batch may stamp either — the store
+        hash would cover the orphaned rows."""
+        if self.record_store is None or self._store_dirty:
             return
         mark = getattr(self.index, "mark_store_synced", None)
         if mark is not None:
@@ -176,9 +188,11 @@ class Workload:
             any_deleted = False
             ok: List[_BatchRequest] = []
             for req, records in zip(group, group_records):
+                put_done = False
                 try:
                     if self.record_store is not None:
                         self.record_store.put_many(records)
+                        put_done = True
                     deleted = [r for r in records if r.is_deleted()]
                     for record in deleted:
                         self.index.index(record)
@@ -187,6 +201,12 @@ class Workload:
                             link.retract()
                             self.link_database.assert_link(link)
                 except Exception as e:  # store errors stay per-request
+                    if put_done:
+                        # the store committed rows the index will never
+                        # apply: latch the divergence so no later stamp
+                        # (this flush or any future batch) can mask it
+                        # (_mark_synced honors the latch)
+                        self._store_dirty = True
                     req.error = e
                     req.event.set()
                     continue
@@ -201,6 +221,10 @@ class Workload:
                 if ok:
                     self._mark_synced()
             except Exception as e:
+                if self.record_store is not None and ok:
+                    # the group's store writes committed but the shared
+                    # scoring/commit pass did not complete
+                    self._store_dirty = True
                 for req in ok:
                     req.error = e
             finally:
@@ -237,6 +261,7 @@ class Workload:
         live = [r for r in records if not r.is_deleted()]
         deleted = [r for r in records if r.is_deleted()]
 
+        put_done = False
         try:
             if http_transform:
                 self.index.set_indexing_disabled(True)
@@ -246,6 +271,7 @@ class Workload:
                     # durable source of truth first; the blocking index is a
                     # replayable cache of this store (SURVEY.md section 7)
                     self.record_store.put_many(records)
+                    put_done = True
                 for record in deleted:
                     # tombstone in the index (still resolvable by the GET
                     # feed's point lookups), then retract its links
@@ -263,6 +289,13 @@ class Workload:
                 return self._transform_response(entities)
             self._mark_synced()
             return []
+        except BaseException:
+            if put_done:
+                # store committed, index application failed: latch so no
+                # later batch can stamp over the divergence (_mark_synced
+                # honors the latch; a restart replay re-applies the rows)
+                self._store_dirty = True
+            raise
         finally:
             self.index.set_indexing_disabled(False)
             self.listener.set_link_database_updates_disabled(False)
