@@ -31,6 +31,12 @@ import numpy as np
 # 4096-query buckets) — the steady-state serving regime the microbatch
 # queue produces under load; r2 measured single-block 1024-query batches.
 CORPUS = int(os.environ.get("BENCH_CORPUS", "20000"))
+# BENCH_BACKEND selects the scoring backend: "device" (single-chip brute
+# force, the default/headline), "sharded-brute" (the same exact scoring
+# over a jax.sharding.Mesh — on a 1-device mesh this measures the
+# shard_map dispatch overhead of the flagship serving configuration), or
+# "ann"/"sharded" (embedding-ANN blocking, single-chip / mesh)
+BACKEND = os.environ.get("BENCH_BACKEND", "device")
 QUERIES = int(os.environ.get("BENCH_QUERIES", "8192"))
 CPU_SAMPLE_PAIRS = int(os.environ.get("BENCH_CPU_PAIRS", "20000"))
 
@@ -121,20 +127,49 @@ def cpu_baseline_pairs_per_sec(schema, records) -> float:
     return CPU_SAMPLE_PAIRS / dt
 
 
-def device_pairs_per_sec(schema, corpus_records, query_records) -> float:
-    """Steady-state device scoring rate over an indexed corpus."""
+def _backend(schema):
+    if BACKEND == "sharded-brute":
+        from sesam_duke_microservice_tpu.engine.sharded_matcher import (
+            ShardedDeviceIndex,
+            ShardedDeviceProcessor,
+        )
+
+        index = ShardedDeviceIndex(schema)
+        return index, ShardedDeviceProcessor(schema, index)
+    if BACKEND == "sharded":
+        from sesam_duke_microservice_tpu.engine.sharded_matcher import (
+            ShardedAnnIndex,
+            ShardedAnnProcessor,
+        )
+
+        index = ShardedAnnIndex(schema)
+        return index, ShardedAnnProcessor(schema, index)
+    if BACKEND == "ann":
+        from sesam_duke_microservice_tpu.engine.ann_matcher import (
+            AnnIndex,
+            AnnProcessor,
+        )
+
+        index = AnnIndex(schema)
+        return index, AnnProcessor(schema, index)
     from sesam_duke_microservice_tpu.engine.device_matcher import (
         DeviceIndex,
         DeviceProcessor,
     )
+
+    index = DeviceIndex(schema)
+    return index, DeviceProcessor(schema, index)
+
+
+def device_pairs_per_sec(schema, corpus_records, query_records) -> float:
+    """Steady-state device scoring rate over an indexed corpus."""
     from sesam_duke_microservice_tpu.utils.jit_cache import (
         enable_persistent_cache,
     )
 
     enable_persistent_cache()
 
-    index = DeviceIndex(schema)
-    proc = DeviceProcessor(schema, index)
+    index, proc = _backend(schema)
 
     # build the corpus (feature extraction + device transfer, not timed:
     # the metric is scoring throughput; ingest cost is amortized across the

@@ -17,7 +17,8 @@ service listener and scores its surviving links).
 
 Usage::
 
-    python benchmarks/f1_stresstest.py [--backend host|device|ann]
+    python benchmarks/f1_stresstest.py
+        [--backend host|device|ann|sharded|sharded-brute]
         [--workload dedup|linkage] [--one-to-one]
         [--entities 2000] [--dup-rate 0.3] [--batch 500] [--seed 1234]
 
@@ -192,12 +193,30 @@ class PairCollector:
 def build_processor(schema, backend: str, group_filtering: bool = False):
     from sesam_duke_microservice_tpu.core.config import MatchTunables
 
-    if backend in ("device", "ann"):
+    if backend != "host":
         from sesam_duke_microservice_tpu.utils.jit_cache import (
             enable_persistent_cache,
         )
 
         enable_persistent_cache()
+    if backend == "sharded":
+        from sesam_duke_microservice_tpu.engine.sharded_matcher import (
+            ShardedAnnIndex,
+            ShardedAnnProcessor,
+        )
+
+        index = ShardedAnnIndex(schema, tunables=MatchTunables())
+        return ShardedAnnProcessor(schema, index,
+                                   group_filtering=group_filtering)
+    if backend == "sharded-brute":
+        from sesam_duke_microservice_tpu.engine.sharded_matcher import (
+            ShardedDeviceIndex,
+            ShardedDeviceProcessor,
+        )
+
+        index = ShardedDeviceIndex(schema, tunables=MatchTunables())
+        return ShardedDeviceProcessor(schema, index,
+                                      group_filtering=group_filtering)
     if backend == "device":
         from sesam_duke_microservice_tpu.engine.device_matcher import (
             DeviceIndex,
@@ -325,7 +344,7 @@ def run(backend: str, n_entities: int, dup_rate: float, batch: int,
         proc.add_match_listener(collector)
 
     escalations_start = 0
-    if backend in ("device", "ann"):
+    if backend != "host":
         from sesam_duke_microservice_tpu.engine import device_matcher as DM
 
         escalations_start = DM.ESCALATIONS
@@ -403,7 +422,7 @@ def run(backend: str, n_entities: int, dup_rate: float, batch: int,
         out["retrieval_s"] = round(stats.retrieval_seconds, 2)
         out["compare_s"] = round(stats.compare_seconds, 2)
         out["pairs_compared"] = stats.pairs_compared
-    if backend in ("device", "ann"):
+    if backend != "host":
         from sesam_duke_microservice_tpu.engine import device_matcher as DM
 
         # delta vs run start so repeated in-process runs don't attribute
@@ -415,7 +434,8 @@ def run(backend: str, n_entities: int, dup_rate: float, batch: int,
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--backend", default="host",
-                    choices=["host", "device", "ann"])
+                    choices=["host", "device", "ann", "sharded",
+                             "sharded-brute"])
     ap.add_argument("--entities", type=int, default=2000)
     ap.add_argument("--dup-rate", type=float, default=0.3)
     ap.add_argument("--batch", type=int, default=500)
