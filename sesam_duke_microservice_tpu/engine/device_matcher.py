@@ -445,6 +445,18 @@ class DeviceIndex(CandidateIndex):
             pending, self._pending = self._pending, []
         if not pending:
             return
+        # multi-host serving: the drained batch is exactly the corpus
+        # mutation about to apply — broadcast it so follower replicas make
+        # the identical mutation (parallel.dispatch invariant 1).  The key
+        # is tagged by the dispatcher on the frontend only; followers and
+        # single-process runs skip.
+        key = getattr(self, "_dispatch_key", None)
+        if key is not None:
+            from ..parallel import dispatch
+
+            d = dispatch.current()
+            if d is not None:
+                d.broadcast(("commit", key, pending))
         # last write per ID wins within a batch (Duke re-index semantics)
         by_id: Dict[str, Record] = {}
         for r in pending:
@@ -1253,6 +1265,12 @@ class DeviceProcessor:
     # kernels; the ANN subclass retrieves then rescores only top-C, so its
     # pairs_compared stat must count the rescored candidates instead
     exhaustive = True
+    # multi-host follower replicas replay only the device-program side of
+    # a batch (parallel.dispatch): host finalization of survivors — and
+    # everything downstream of it (listeners, link DBs) — runs on the
+    # frontend alone.  The device-program ORDER must stay identical either
+    # way, so the flag guards only the per-query host loop.
+    finalize_survivors = True
 
     def __init__(self, schema: DukeSchema, database: DeviceIndex, *,
                  group_filtering: bool = False, profile: bool = False,
@@ -1295,6 +1313,41 @@ class DeviceProcessor:
         # fingerprint plus the next doubling step
         self._scorers.prewarm_async(self.group_filtering)
 
+        # multi-host serving: followers replay the scoring pass with the
+        # same query records (the corpus mutation already broadcast from
+        # commit()); must precede _score_blocks so every process enqueues
+        # the block programs in the same global order
+        key = getattr(self.database, "_dispatch_key", None)
+        if key is not None:
+            from ..parallel import dispatch
+
+            d = dispatch.current()
+            if d is not None:
+                d.broadcast(("score", key, list(records)))
+
+        self._score_blocks(records)
+
+        self.stats.batches += 1
+        for listener in self.listeners:
+            listener.batch_done()
+        if self.profile:
+            logger.info(
+                "batch=%d records, corpus=%d, %.3fs",
+                len(records), self.database.corpus.size,
+                time.monotonic() - t0,
+            )
+
+    def _score_blocks(self, records: Sequence[Record]) -> None:
+        """The device-program side of a batch: double-buffered block
+        dispatch + escalation, then (frontend only) host finalization.
+
+        Multi-host follower replicas call this directly with
+        ``finalize_survivors=False``: the dispatch structure — block
+        order, pre-dispatch of block N+1 before block N resolves,
+        escalation re-runs — must match the frontend program-for-program
+        or the cross-host collectives deadlock, so the loop is shared
+        rather than reimplemented (parallel.dispatch invariant 2).
+        """
         threshold = self.schema.threshold
         maybe = self.schema.maybe_threshold
         corpus = self.database.corpus
@@ -1328,6 +1381,8 @@ class DeviceProcessor:
             t2 = time.monotonic()
             self.stats.retrieval_seconds += t2 - t1
 
+            if not self.finalize_survivors:
+                continue
             for qi, record in enumerate(block):
                 survivors = result.survivors(qi)
                 found = False
@@ -1360,15 +1415,6 @@ class DeviceProcessor:
                         (result.top_index[qi] >= 0).sum()
                     )
             self.stats.compare_seconds += time.monotonic() - t2
-
-        self.stats.batches += 1
-        for listener in self.listeners:
-            listener.batch_done()
-        if self.profile:
-            logger.info(
-                "batch=%d records, corpus=%d, %.3fs",
-                len(records), corpus.size, time.monotonic() - t0,
-            )
 
     def _emit(self, event: str, r1: Record, r2: Record, prob: float) -> None:
         for listener in self.listeners:

@@ -23,6 +23,7 @@ Flow parity notes:
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import threading
 from typing import Dict, List, Optional, Sequence
@@ -147,6 +148,16 @@ class Workload:
             raise req.error
         return []
 
+    def _mesh_op_lock(self):
+        """Multi-host serving: the dispatcher's global op lock, held across
+        every device-program-producing section so processes enqueue mesh
+        programs in ONE global order (parallel.dispatch invariant 2).
+        Single-process serving gets a no-op context."""
+        from ..parallel import dispatch
+
+        d = dispatch.current()
+        return d.op_lock if d is not None else contextlib.nullcontext()
+
     def _mark_synced(self) -> None:
         """Stamp the index as fully caught up with the store (consumed by
         the snapshot staleness guard — engine.device_matcher
@@ -214,10 +225,11 @@ class Workload:
                 all_live.extend(r for r in records if not r.is_deleted())
                 ok.append(req)
             try:
-                if any_deleted:
-                    self.index.commit()
-                if all_live:
-                    self.processor.deduplicate(all_live)
+                with self._mesh_op_lock():
+                    if any_deleted:
+                        self.index.commit()
+                    if all_live:
+                        self.processor.deduplicate(all_live)
                 if ok:
                     self._mark_synced()
             except Exception as e:
@@ -279,11 +291,12 @@ class Workload:
                     for link in self.link_database.get_all_links_for(record.record_id):
                         link.retract()
                         self.link_database.assert_link(link)
-                if deleted:
-                    self.index.commit()
 
-            if live or http_transform:
-                self.processor.deduplicate(live)
+            with self._mesh_op_lock():
+                if deleted and not http_transform:
+                    self.index.commit()
+                if live or http_transform:
+                    self.processor.deduplicate(live)
 
             if http_transform:
                 return self._transform_response(entities)

@@ -1,0 +1,490 @@
+"""Multi-host serving: single-controller dispatch of mesh operations.
+
+The reference wires its matcher directly into the HTTP handlers of one JVM
+(App.java:343-345,1005); SURVEY.md section 5.8 defines the TPU-native
+scale-out as a single-controller dispatch model over the JAX collective
+stack.  This module is that model's control plane:
+
+  * **Frontend** (process 0): serves the full REST surface and owns every
+    host-side subsystem — ingest, link databases, listeners, durable
+    stores, feeds.  Each mesh-touching operation (a corpus commit, a
+    scoring pass) is broadcast to the followers *before* the frontend
+    executes it.
+  * **Followers** (process 1..N-1): no HTTP, no link state — each runs a
+    replica of every workload's sharded index (corpus host mirror + the
+    jitted shard_map programs) and replays the frontend's operation
+    stream in order, entering the same device programs in lockstep so the
+    ``all_gather``/``psum`` collectives rendezvous across hosts
+    (ICI within a slice, DCN across — parallel/multihost.py).
+
+Correctness rests on two invariants:
+
+  1. **Bit-identical host mirrors.**  In the multi-controller model each
+     process supplies its local shards of every global array from its own
+     host corpus mirror, so the mirrors must match across processes
+     exactly.  Followers bootstrap from the frontend's corpus state (the
+     snapshot wire format of ``DeviceIndex.snapshot_save`` plus the
+     record mirror) and then apply the same deterministic mutations in
+     the same order (op ``commit``).
+  2. **Identical device-program order.**  XLA executes each process's
+     programs in dispatch order; collectives deadlock if two processes
+     enqueue the same programs in different orders.  The frontend holds
+     ``Dispatcher.op_lock`` across every broadcast+execute section
+     (serializing across workloads), and followers replay the single op
+     stream sequentially.  Escalation re-runs (``resolve_block``) are
+     driven by replicated device outputs, so every process makes the same
+     widening decision at the same point — including the double-buffered
+     dispatch order of ``DeviceProcessor`` (the follower runs the same
+     loop structure via ``_score_blocks``).
+
+The op channel is a plain length-prefixed-pickle TCP stream from the
+frontend to each follower; the frontend's address is published through
+the jax.distributed coordination KV store (rendezvous only — the data
+path never rides the coordinator).  A dead follower surfaces as a hung
+collective, the standard JAX multi-controller failure mode; the service
+logs the follower set at startup so operators can correlate.
+
+Not supported in multi-host mode (clear errors, see service/app.py):
+``POST /{kind}/{name}/rematch`` — the ring layout's query-sharded result
+fetch needs a cross-host gather that is not wired yet.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import os
+import pickle
+import socket
+import struct
+import tempfile
+import threading
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger("dispatch")
+
+# rendezvous key in the jax.distributed coordination service KV store
+_KV_ADDR_KEY = "sesam_duke/dispatch/addr"
+_CONNECT_TIMEOUT_S = float(os.environ.get("DUKE_DISPATCH_TIMEOUT", "600"))
+
+_DISPATCHER: Optional["Dispatcher"] = None
+
+
+def current() -> Optional["Dispatcher"]:
+    """The active frontend dispatcher, or None (single-process serving and
+    follower processes both see None — the broadcast hooks no-op)."""
+    return _DISPATCHER
+
+
+# -- wire format -------------------------------------------------------------
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack(">Q", len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise EOFError("dispatch channel closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket):
+    (n,) = struct.unpack(">Q", _recv_exact(sock, 8))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _kv_client():
+    """The jax.distributed coordination-service KV client (private API —
+    isolated here so an upstream rename breaks exactly one function; the
+    DUKE_DISPATCH_ADDR env var bypasses it entirely)."""
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        raise RuntimeError(
+            "jax.distributed is not initialized (multi-host dispatch needs "
+            "the coordination service, or set DUKE_DISPATCH_ADDR)"
+        )
+    return client
+
+
+def _env_fingerprint() -> dict:
+    """Shape-relevant configuration that must match across processes (a
+    mismatch would compile different programs → collective deadlock)."""
+    import jax
+
+    from ..engine import device_matcher as DM
+
+    return {
+        "jax": jax.__version__,
+        "devices": jax.device_count(),
+        "chunk": DM._CHUNK,
+        "buckets": DM._QUERY_BUCKETS,
+        "update_slice": DM._UPDATE_SLICE,
+        "value_slots_max": DM._VALUE_SLOTS_MAX,
+        "initial_top_k": DM._INITIAL_TOP_K,
+        "ann_dim": os.environ.get("DEVICE_ANN_DIM", "256"),
+        "ann_c": os.environ.get("DEVICE_ANN_CANDIDATES", "64"),
+        # every env knob that sizes a feature tensor (ops.features): a
+        # mismatch here compiles different-shape programs per process and
+        # deadlocks the first cross-host collective
+        "max_chars": os.environ.get("DEVICE_MAX_CHARS", ""),
+        "max_grams": os.environ.get("DEVICE_MAX_GRAMS", ""),
+        "max_tokens": os.environ.get("DEVICE_MAX_TOKENS", ""),
+        "value_slots": os.environ.get("DEVICE_VALUE_SLOTS", ""),
+    }
+
+
+# -- frontend ----------------------------------------------------------------
+
+
+class Dispatcher:
+    """Frontend-side op broadcaster (process 0 of a multi-host job)."""
+
+    def __init__(self, app):
+        self.app = app
+        # serializes every broadcast+execute section across workloads so
+        # all processes enqueue device programs in one global order
+        self.op_lock = threading.RLock()
+        self._send_lock = threading.Lock()
+        self._conns: List[socket.socket] = []
+        self._server: Optional[socket.socket] = None
+        self._closed = False
+        # latched on the first broadcast failure: once any follower
+        # missed an op, its mirror is behind forever (ops are not
+        # replayable), so every further mesh op must refuse loudly —
+        # serving partial-mesh results or deadlocking a collective would
+        # both be silent corruption.  Recovery = restart the job.
+        self._failed: Optional[str] = None
+
+    # - lifecycle -
+
+    def start(self) -> None:
+        import secrets
+
+        import jax
+
+        n_followers = jax.process_count() - 1
+        if n_followers <= 0:
+            raise RuntimeError("Dispatcher.start() needs a multi-process job")
+        bind_host = os.environ.get("DUKE_DISPATCH_BIND", "0.0.0.0")
+        advertise = os.environ.get("DUKE_DISPATCH_HOST")
+        port = int(os.environ.get("DUKE_DISPATCH_PORT", "0"))
+        self._server = socket.create_server((bind_host, port))
+        actual_port = self._server.getsockname()[1]
+        if advertise is None:
+            advertise = socket.gethostname()
+        # join token: published only through the coordination-service KV
+        # store, so a follower slot requires coordination-service access —
+        # an arbitrary process that can reach the TCP port cannot claim a
+        # slot (and receive the bootstrap's record payload) or starve the
+        # real followers out of theirs
+        token = secrets.token_hex(16)
+        addr = f"{advertise}:{actual_port}"
+        _kv_client().key_value_set(_KV_ADDR_KEY, f"{addr}/{token}")
+        logger.info(
+            "dispatch: waiting for %d follower(s) on %s", n_followers, addr
+        )
+        self._server.settimeout(_CONNECT_TIMEOUT_S)
+        while len(self._conns) < n_followers:
+            conn, peer = self._server.accept()
+            try:
+                conn.settimeout(30.0)
+                hello = _recv_msg(conn)
+                if hello != ("hello", token):
+                    raise ValueError("bad join token")
+                conn.settimeout(None)
+            except Exception as e:
+                logger.warning(
+                    "dispatch: rejected connection from %s (%s)", peer, e
+                )
+                conn.close()
+                continue
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.append(conn)
+            logger.info("dispatch: follower connected from %s", peer)
+        self._tag_workloads(self.app.deduplications, self.app.record_linkages)
+        self.broadcast((
+            "bootstrap",
+            self.app.backend,
+            self.app.config_string,
+            self._capture_states(),
+            _env_fingerprint(),
+        ))
+        global _DISPATCHER
+        _DISPATCHER = self
+
+    def close(self) -> None:
+        global _DISPATCHER
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.broadcast(("shutdown",))
+        except Exception:
+            pass
+        for conn in self._conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if self._server is not None:
+            self._server.close()
+        if _DISPATCHER is self:
+            _DISPATCHER = None
+
+    # - ops -
+
+    def broadcast(self, op: tuple) -> None:
+        """Send one op to every follower (in one global order).
+
+        A send failure latches the dispatcher: the dead follower's mirror
+        is now permanently behind, so every subsequent op raises instead
+        of diverging the mesh (the standard JAX multi-controller stance —
+        a lost process ends the job)."""
+        if self._failed is not None:
+            raise RuntimeError(
+                "multi-host dispatch is down (a follower lost an op: "
+                f"{self._failed}); restart the job to recover"
+            )
+        data = pickle.dumps(op, protocol=pickle.HIGHEST_PROTOCOL)
+        frame = struct.pack(">Q", len(data)) + data
+        with self._send_lock:
+            for conn in self._conns:
+                try:
+                    conn.sendall(frame)
+                except OSError as e:
+                    self._failed = repr(e)
+                    logger.error(
+                        "dispatch: broadcast to a follower failed (%s); "
+                        "halting mesh ops — restart the job", e,
+                    )
+                    raise RuntimeError(
+                        f"multi-host dispatch broadcast failed: {e}"
+                    ) from e
+
+    def on_reload(self, sc, new_dedups: Dict, new_linkages: Dict) -> None:
+        """Called by DukeApp.apply_config after building the replacement
+        workloads (old locks held, nothing in flight): re-tags the new
+        indexes and ships followers the new config + corpus states."""
+        self._tag_workloads(new_dedups, new_linkages)
+        states = self._capture_states(new_dedups, new_linkages)
+        self.broadcast(("reload", self.app.backend, sc.config_string, states))
+
+    # - helpers -
+
+    def _tag_workloads(self, dedups: Dict, linkages: Dict) -> None:
+        for kind, registry in (("deduplication", dedups),
+                               ("recordlinkage", linkages)):
+            for name, wl in registry.items():
+                wl.index._dispatch_key = (kind, name)
+
+    def _capture_states(self, dedups=None, linkages=None) -> Dict:
+        dedups = self.app.deduplications if dedups is None else dedups
+        linkages = self.app.record_linkages if linkages is None else linkages
+        states = {}
+        for kind, registry in (("deduplication", dedups),
+                               ("recordlinkage", linkages)):
+            for name, wl in registry.items():
+                states[(kind, name)] = _capture_state(wl.index)
+        return states
+
+
+def _capture_state(index) -> dict:
+    """Corpus bootstrap payload for one workload: the snapshot wire format
+    (feature tensors, masks, row ids, value-slot widths — row layout
+    preserved exactly, which invariant 1 requires) plus the record mirror
+    the follower needs for value-slot rebuilds and snapshot adoption."""
+    snapshot = None
+    if getattr(index, "corpus", None) is not None and index.corpus.size > 0:
+        fd, tmp = tempfile.mkstemp(suffix=".npz")
+        os.close(fd)
+        try:
+            index.snapshot_save(tmp)
+            with open(tmp, "rb") as f:
+                snapshot = f.read()
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    return {
+        "snapshot": snapshot,
+        "records": list(index.records.values()),
+    }
+
+
+# -- follower ----------------------------------------------------------------
+
+
+class FollowerProcessor:
+    """Device-program replayer for one workload replica: the scoring side
+    of ``DeviceProcessor`` with host finalization off.  It deliberately
+    reuses ``DeviceProcessor._score_blocks`` so the dispatch order
+    (double-buffered blocks, escalation re-runs) is the frontend's
+    bit-for-bit — drift there deadlocks collectives (invariant 2)."""
+
+    def __init__(self, schema, index, *, group_filtering: bool):
+        from ..engine.device_matcher import DeviceProcessor
+
+        self._proc = DeviceProcessor(
+            schema, index, group_filtering=group_filtering
+        )
+        self._proc.finalize_survivors = False
+
+    def score(self, records) -> None:
+        self._proc._score_blocks(records)
+
+
+class _Replica:
+    """One workload's follower-side state: sharded index + processor."""
+
+    def __init__(self, sc, kind: str, name: str, backend: str, state: dict):
+        registry = (sc.deduplications if kind == "deduplication"
+                    else sc.record_linkages)
+        wc = registry[name]
+        if backend == "sharded-brute":
+            from ..engine.sharded_matcher import ShardedDeviceIndex
+
+            self.index = ShardedDeviceIndex(wc.duke, tunables=sc.tunables)
+        else:
+            from ..engine.sharded_matcher import ShardedAnnIndex
+
+            self.index = ShardedAnnIndex(wc.duke, tunables=sc.tunables)
+        self.processor = FollowerProcessor(
+            wc.duke, self.index, group_filtering=wc.is_record_linkage
+        )
+        if state["snapshot"]:
+            self._adopt(state)
+
+    def _adopt(self, state: dict) -> None:
+        import numpy as np
+
+        fd, tmp = tempfile.mkstemp(suffix=".npz")
+        os.close(fd)
+        try:
+            with open(tmp, "wb") as f:
+                f.write(state["snapshot"])
+            # trusted bootstrap from the live frontend: the content compare
+            # is satisfied by the snapshot's own stamp (the staleness guard
+            # protects restarts from DISK state; this state was captured
+            # from a quiesced live corpus seconds ago)
+            with np.load(tmp) as data:
+                content = str(data["__content"])
+            records_by_id = {r.record_id: r for r in state["records"]}
+            if not self.index.snapshot_load(
+                tmp, records_by_id, content_hash=content
+            ):
+                raise RuntimeError(
+                    "follower bootstrap: corpus state rejected (plan/env "
+                    "mismatch with the frontend?)"
+                )
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self.index.close()
+
+
+def follower_main(poll_timeout_ms: int = None) -> None:
+    """Follower process entrypoint: connect to the frontend's dispatch
+    stream and replay mesh ops until shutdown/EOF.  Call after
+    ``multihost.initialize()`` in a process with ``jax.process_index() >
+    0``; never returns until the job ends."""
+    from ..core.config import parse_config
+    from ..utils.jit_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    addr = os.environ.get("DUKE_DISPATCH_ADDR")
+    if addr is None:
+        timeout = poll_timeout_ms or int(_CONNECT_TIMEOUT_S * 1000)
+        addr = _kv_client().blocking_key_value_get(_KV_ADDR_KEY, timeout)
+    addr, _, token = addr.partition("/")
+    host, _, port = addr.rpartition(":")
+    logger.info("follower: connecting to dispatch stream at %s", addr)
+    sock = socket.create_connection((host, int(port)),
+                                    timeout=_CONNECT_TIMEOUT_S)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    _send_msg(sock, ("hello", token))  # join token (Dispatcher.start)
+    sock.settimeout(None)  # ops arrive whenever the frontend has work
+
+    replicas: Dict[Tuple[str, str], _Replica] = {}
+
+    def rebuild(backend: str, config_string: str, states: dict) -> None:
+        for replica in replicas.values():
+            replica.close()
+        replicas.clear()
+        sc = parse_config(config_string)
+        for (kind, name), state in states.items():
+            replicas[(kind, name)] = _Replica(sc, kind, name, backend, state)
+        logger.info(
+            "follower: %d workload replica(s) ready (%s)",
+            len(replicas), backend,
+        )
+
+    try:
+        while True:
+            try:
+                op = _recv_msg(sock)
+            except EOFError:
+                logger.info("follower: dispatch stream closed; exiting")
+                return
+            tag = op[0]
+            if tag == "bootstrap":
+                _, backend, config_string, states, fingerprint = op
+                mine = _env_fingerprint()
+                if fingerprint != mine:
+                    raise RuntimeError(
+                        "follower env/shape fingerprint mismatch vs "
+                        f"frontend: {fingerprint} != {mine} — all processes "
+                        "must run identical DEVICE_*/schema configuration"
+                    )
+                rebuild(backend, config_string, states)
+            elif tag == "reload":
+                _, backend, config_string, states = op
+                rebuild(backend, config_string, states)
+            elif tag == "commit":
+                _, key, records = op
+                replica = replicas[key]
+                for record in records:
+                    replica.index.index(record)
+                replica.index.commit()
+            elif tag == "score":
+                _, key, records = op
+                replicas[key].processor.score(records)
+            elif tag == "shutdown":
+                logger.info("follower: shutdown op received; exiting")
+                return
+            else:
+                raise RuntimeError(f"unknown dispatch op {tag!r}")
+    finally:
+        for replica in replicas.values():
+            try:
+                replica.close()
+            except Exception:
+                pass
+        sock.close()
+
+
+# -- frontend entry ----------------------------------------------------------
+
+
+def start_dispatcher(app) -> Dispatcher:
+    """Create+start the frontend dispatcher for a multi-process job."""
+    if app.backend not in ("sharded", "sharded-brute"):
+        raise RuntimeError(
+            "multi-host serving requires --backend sharded or sharded-brute "
+            f"(got {app.backend!r}); single-device backends cannot span hosts"
+        )
+    d = Dispatcher(app)
+    d.start()
+    return d

@@ -1,0 +1,102 @@
+"""Two-process dispatch smoke: the multi-host serving path on one machine.
+
+Run as ``python -m sesam_duke_microservice_tpu.parallel.dispatch_smoke
+<role> <coordinator>`` with role ``frontend`` or ``follower``; each
+process gets one virtual CPU device, so the global corpus mesh spans the
+two processes and every scoring pass crosses the process boundary
+(all_gather over the loopback "DCN").  The frontend drives real workload
+batches through the dispatcher exactly as the HTTP handlers would
+(``__graft_entry__.dryrun_multichip`` uses this as its two-process mode;
+``tests/test_multihost_serving.py`` covers the full HTTP surface).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+# virtual-CPU smoke: pin the platform before any computation — on axon
+# hosts the sitecustomize hook imports jax at interpreter startup and the
+# JAX_PLATFORMS env var alone is too late (utils.virtual_mesh docs); an
+# axon-platform child would report process_count()==1 regardless of the
+# joined coordination job and the dispatcher would refuse to start
+from ..utils.virtual_mesh import force_cpu_platform
+
+force_cpu_platform()
+
+SMOKE_XML = """
+<DukeMicroService>
+  <Deduplication name="people" link-database-type="in-memory">
+    <duke>
+      <schema>
+        <threshold>0.8</threshold>
+        <property><name>NAME</name><comparator>levenshtein</comparator><low>0.1</low><high>0.95</high></property>
+      </schema>
+      <data-source class="io.sesam.dukemicroservice.IncrementalDeduplicationDataSource">
+        <param name="dataset-id" value="crm"/>
+        <column name="name" property="NAME"/>
+      </data-source>
+    </duke>
+  </Deduplication>
+</DukeMicroService>
+"""
+
+
+def main() -> None:
+    import logging
+
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    role = sys.argv[1]
+    coordinator = sys.argv[2]
+    process_id = 0 if role == "frontend" else 1
+
+    from . import multihost
+
+    assert multihost.initialize(
+        coordinator_address=coordinator, num_processes=2,
+        process_id=process_id,
+    ), "smoke needs a 2-process distributed job"
+    import jax
+
+    print(f"SMOKE {role}: pc={jax.process_count()} devs="
+          f"{jax.device_count()}", file=sys.stderr, flush=True)
+
+    if role == "follower":
+        from .dispatch import follower_main
+
+        follower_main()
+        print("SMOKE_FOLLOWER_OK", flush=True)
+        return
+
+    os.environ["CONFIG_STRING"] = SMOKE_XML
+    os.environ.setdefault("MIN_RELEVANCE", "0.05")
+    from ..service.app import create_app
+    from .dispatch import start_dispatcher
+
+    app = create_app(backend="sharded-brute", persistent=False)
+    dispatcher = start_dispatcher(app)
+    try:
+        wl = app.deduplications["people"]
+        with wl.lock:
+            # duplicates at (1,2): both scoring passes run over the
+            # 2-process mesh in lockstep with the follower
+            wl.process_batch("crm", [
+                {"_id": "1", "name": "entity number one"},
+                {"_id": "2", "name": "entity number one"},
+                {"_id": "3", "name": "completely different"},
+            ])
+            wl.process_batch("crm", [{"_id": "1", "_deleted": True}])
+            rows = wl.links_since(0)
+        live = [r for r in rows if not r["_deleted"]]
+        retracted = [r for r in rows if r["_deleted"]]
+        assert not live and len(retracted) == 1, rows
+        assert {retracted[0]["entity1"], retracted[0]["entity2"]} == {"1", "2"}
+        print("SMOKE_FRONTEND_OK " + json.dumps(len(rows)), flush=True)
+    finally:
+        dispatcher.close()
+        app.close()
+
+
+if __name__ == "__main__":
+    main()

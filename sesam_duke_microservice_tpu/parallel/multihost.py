@@ -49,6 +49,16 @@ def initialize(coordinator_address: Optional[str] = None,
     """
     import jax
 
+    # idempotent: the service entrypoint initializes once, then every
+    # sharded index construction calls through serving_mesh() again — a
+    # second jax.distributed.initialize would raise ("must be called
+    # before any JAX calls") because the first one already brought the
+    # backend up
+    from jax._src import distributed as _dist
+
+    if _dist.global_state.client is not None:
+        return jax.process_count() > 1
+
     coordinator_address = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS"
     )
@@ -101,6 +111,7 @@ def initialize(coordinator_address: Optional[str] = None,
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
+    logger.info("jax.distributed.initialize(%s)", kwargs)
     jax.distributed.initialize(**kwargs)
     logger.info(
         "joined distributed job: process %d/%d, %d local / %d global devices",

@@ -33,11 +33,37 @@ def main() -> None:
         from ..utils.jit_cache import enable_persistent_cache
 
         enable_persistent_cache()
-    app = create_app(backend=args.backend, persistent=not args.ephemeral)
-    server = serve(app, port=args.port, host=args.host)
+
     log = logging.getLogger("duke-tpu-service")
+
+    # multi-host serving (SURVEY.md section 5.8): join the jax.distributed
+    # job first; process 0 becomes the HTTP frontend + op dispatcher,
+    # every other process runs the follower replay loop (no HTTP).
+    dispatcher = None
+    from ..parallel import multihost
+
+    multihost.initialize()
+    import jax
+
+    if jax.process_count() > 1:
+        from ..parallel.dispatch import follower_main, start_dispatcher
+
+        if jax.process_index() != 0:
+            log.info(
+                "process %d/%d: follower mode (frontend is process 0)",
+                jax.process_index(), jax.process_count(),
+            )
+            follower_main()
+            return
+        app = create_app(backend=args.backend,
+                         persistent=not args.ephemeral)
+        dispatcher = start_dispatcher(app)
+    else:
+        app = create_app(backend=args.backend, persistent=not args.ephemeral)
+    server = serve(app, port=args.port, host=args.host)
     log.info(
-        "Serving on %s:%d (backend=%s)", args.host, args.port, args.backend
+        "Serving on %s:%d (backend=%s%s)", args.host, args.port, args.backend,
+        f", {jax.process_count()} hosts" if dispatcher else "",
     )
 
     # graceful shutdown on SIGTERM (docker stop) / SIGINT: stop accepting,
@@ -56,6 +82,8 @@ def main() -> None:
         server.serve_forever()
     finally:
         app.close()
+        if dispatcher is not None:
+            dispatcher.close()
         log.info("shutdown complete")
 
 

@@ -158,6 +158,15 @@ class DukeApp:
                         except Exception:
                             logger.exception("Error closing partially-built workload")
                     raise
+                # multi-host serving: ship followers the new config + the
+                # just-built corpora so their replicas swap in lockstep
+                # (old locks held -> nothing in flight on the op stream)
+                from ..parallel import dispatch
+
+                d = dispatch.current()
+                if d is not None:
+                    with d.op_lock:
+                        d.on_reload(sc, new_dedups, new_linkages)
                 self.config = sc
                 self.deduplications = new_dedups
                 self.record_linkages = new_linkages
@@ -567,7 +576,17 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                 f"in the configuration)",
             )
         from ..engine.rematch import ring_rematch
+        from ..parallel import dispatch
 
+        if dispatch.current() is not None:
+            # the ring layout's query-sharded result fetch needs a
+            # cross-host gather that is not wired into the follower op
+            # stream yet (parallel/dispatch.py module docs)
+            raise _HttpError(
+                501,
+                "Ring re-match is not yet supported in multi-host serving; "
+                "run it from a single-host mesh deployment.",
+            )
         with workload.lock:
             if workload.closed:
                 raise _HttpError(503, _BUSY_TEMPLATE.format(kind=label))

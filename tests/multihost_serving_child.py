@@ -1,0 +1,51 @@
+"""Child process for the two-process multi-host SERVING test.
+
+Each child is one process of a 2-process jax.distributed job with 2
+virtual CPU devices (global mesh = 4).  Both enter the real service
+entrypoint (``service.__main__.main``): process 0 becomes the HTTP
+frontend + op dispatcher, process 1 the follower replay loop — exactly
+the production multi-host path of parallel/dispatch.py.
+
+Usage: multihost_serving_child.py <process_id> <coordinator> <http_port>
+       <backend>
+
+Env contract (set by the parent): CONFIG_STRING, DEVICE_* shape knobs
+identical across processes, DUKE_DISPATCH_HOST=127.0.0.1.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=2"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    process_id = int(sys.argv[1])
+    coordinator = sys.argv[2]
+    http_port = sys.argv[3]
+    backend = sys.argv[4]
+
+    os.environ["JAX_COORDINATOR_ADDRESS"] = coordinator
+    os.environ["JAX_NUM_PROCESSES"] = "2"
+    os.environ["JAX_PROCESS_ID"] = str(process_id)
+
+    sys.argv = [
+        "duke-service", "--port", http_port, "--host", "127.0.0.1",
+        "--backend", backend,
+    ]
+    from sesam_duke_microservice_tpu.service.__main__ import main as svc_main
+
+    svc_main()
+
+
+if __name__ == "__main__":
+    main()
