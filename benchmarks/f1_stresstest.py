@@ -293,7 +293,7 @@ def truth_links(t1, t2):
 def run(backend: str, n_entities: int, dup_rate: float, batch: int,
         seed: int = 1234, workload: str = "dedup",
         one_to_one: bool = False, name_syllables=(2, 4),
-        ssn_exact: bool = False):
+        ssn_exact: bool = False, dump_pairs: str = None):
     from sesam_duke_microservice_tpu.core.records import (
         GROUP_NO_PROPERTY_NAME,
     )
@@ -402,6 +402,12 @@ def run(backend: str, n_entities: int, dup_rate: float, batch: int,
     recall = tp / len(expected) if expected else 1.0
     f1 = (2 * precision * recall / (precision + recall)
           if precision + recall else 0.0)
+    if dump_pairs:
+        # emitted pair set + host-exact confidences, for cross-backend
+        # link-set agreement diffs (VERDICT r3 #4)
+        with open(dump_pairs, "w") as f:
+            for (a, b), conf in sorted(pair_items.items()):
+                f.write(f"{a}\t{b}\t{conf:.12f}\n")
     out = {
         "backend": backend,
         "workload": workload,
@@ -447,6 +453,9 @@ def main():
     ap.add_argument("--ssn-exact", action="store_true",
                     help="scale-appropriate schema: Exact ssn comparator "
                          "(see stresstest_schema)")
+    ap.add_argument("--dump-pairs", default=None,
+                    help="write the emitted pair set (id1\\tid2\\tconf) "
+                         "to this path for cross-backend agreement diffs")
     ap.add_argument("--name-syllables", default="2-4",
                     help="surname syllable range lo-hi (use 3-5 at 10^6 "
                          "scale so the name pool doesn't saturate)")
@@ -455,7 +464,8 @@ def main():
     print(json.dumps(
         run(args.backend, args.entities, args.dup_rate, args.batch,
             args.seed, workload=args.workload, one_to_one=args.one_to_one,
-            name_syllables=(lo, hi), ssn_exact=args.ssn_exact)
+            name_syllables=(lo, hi), ssn_exact=args.ssn_exact,
+            dump_pairs=args.dump_pairs)
     ))
 
 

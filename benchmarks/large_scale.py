@@ -101,7 +101,7 @@ def run_sharded(args) -> None:
         rows, _ = generate(n, args.dup_rate, seed)
         records = to_records(rows)
         for r in records:
-            r._values["ID"] = [f"s{seed}__{r.record_id}"]
+            r.set_values("ID", [f"s{seed}__{r.record_id}"])
         feats = F.extract_batch(plan, records)
         # the production corpus storage dtype (E.STORAGE_DTYPE)
         feats[E.ANN_PROP] = {E.ANN_TENSOR: enc.encode_corpus(records)}
@@ -139,7 +139,7 @@ def run_sharded(args) -> None:
         rows, _ = generate(args.batch, args.dup_rate, seed)
         records = to_records(rows)
         for r in records:
-            r._values["ID"] = [f"q{seed}__{r.record_id}"]
+            r.set_values("ID", [f"q{seed}__{r.record_id}"])
         qf = {
             p: {k: jnp.asarray(a) for k, a in t.items()}
             for p, t in F.extract_batch(plan, records).items()
@@ -228,31 +228,39 @@ def main():
     proc = build_processor(schema, "ann")
     index = proc.database
 
-    # ingest in slabs to bound host memory
-    t0 = time.perf_counter()
+    # ingest in slabs to bound host memory.  The clock covers only the
+    # framework's work (index + commit: extraction, embedding, corpus
+    # append, digests) — synthetic data generation is harness cost and is
+    # reported separately (r4 methodology fix; the r3 number folded
+    # generate()+to_records() into the ingest rate).
+    ingest_s = 0.0
+    gen_s = 0.0
     slab = 100_000
     remaining = args.rows
     seed = 1000
     while remaining > 0:
         n = min(slab, remaining)
+        t_gen = time.perf_counter()
         rows, _ = generate(n, args.dup_rate, seed)
         records = to_records(rows)
         # distinct ids per slab
         for r in records:
-            r._values["ID"] = [f"s{seed}__{r.record_id}"]
+            r.set_values("ID", [f"s{seed}__{r.record_id}"])
+        t0 = time.perf_counter()
+        gen_s += t0 - t_gen
         for r in records:
             index.index(r)
         index.commit()
+        ingest_s += time.perf_counter() - t0
         remaining -= n
         seed += 1
-    ingest_s = time.perf_counter() - t0
     ingest_rate = args.rows / ingest_s
 
     # warm the scorer (compile + K/C settling)
     qrows, _ = generate(args.batch, args.dup_rate, 7777)
     warm = to_records(qrows)
     for r in warm:
-        r._values["ID"] = [f"warm__{r.record_id}"]
+        r.set_values("ID", [f"warm__{r.record_id}"])
     proc.deduplicate(warm)
 
     # steady-state incremental batches
@@ -261,7 +269,7 @@ def main():
         qrows, _ = generate(args.batch, args.dup_rate, 8000 + i)
         batch = to_records(qrows)
         for r in batch:
-            r._values["ID"] = [f"q{i}__{r.record_id}"]
+            r.set_values("ID", [f"q{i}__{r.record_id}"])
         t0 = time.perf_counter()
         proc.deduplicate(batch)
         times.append(time.perf_counter() - t0)
@@ -279,6 +287,7 @@ def main():
     print(json.dumps({
         "rows": corpus_rows,
         "ingest_rows_per_sec": round(ingest_rate, 1),
+        "harness_gen_rows_per_sec": round(args.rows / gen_s, 1),
         "query_rows_per_sec": round(args.batch / best, 1),
         "effective_pairs_per_sec": round(args.batch * corpus_rows / best, 1),
         "hbm_bytes_per_row": per_row,

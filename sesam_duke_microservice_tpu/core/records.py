@@ -104,7 +104,7 @@ class Record:
     strings; empty strings are never stored (Duke's RecordBuilder drops them).
     """
 
-    __slots__ = ("_values", "_digest_cache")
+    __slots__ = ("_values", "_digest_cache", "_id_cache")
 
     def __init__(self, values: Optional[Dict[str, List[str]]] = None):
         self._values: Dict[str, List[str]] = {}
@@ -112,6 +112,9 @@ class Record:
         # persistent ingest path digests every record twice (store row +
         # index fold); mutation invalidates
         self._digest_cache: Optional[bytes] = None
+        # memoized record_id: the ingest bookkeeping path (corpus append,
+        # id_to_row, digests, listeners) reads it several times per record
+        self._id_cache: Optional[str] = None
         if values:
             for name, vals in values.items():
                 for v in vals:
@@ -122,6 +125,22 @@ class Record:
             return
         self._values.setdefault(prop, []).append(str(value))
         self._digest_cache = None
+        self._id_cache = None
+
+    def set_values(self, prop: str, values: List[str]) -> None:
+        """Replace one property's value list (invalidates the memos —
+        callers must never poke ``_values`` directly).  Empty values are
+        dropped like ``add_value`` does, and a fully-empty list removes
+        the key: a stored empty list would serialize differently from its
+        own store round-trip (add_value never creates one) and trip the
+        store/index divergence latch."""
+        filtered = [str(v) for v in values if v]
+        if filtered:
+            self._values[prop] = filtered
+        else:
+            self._values.pop(prop, None)
+        self._digest_cache = None
+        self._id_cache = None
 
     def properties(self) -> Sequence[str]:
         return list(self._values.keys())
@@ -138,7 +157,10 @@ class Record:
 
     @property
     def record_id(self) -> Optional[str]:
-        return self.get_value(ID_PROPERTY_NAME)
+        rid = self._id_cache
+        if rid is None:
+            rid = self._id_cache = self.get_value(ID_PROPERTY_NAME)
+        return rid
 
     def is_deleted(self) -> bool:
         return self.get_value(DELETED_PROPERTY_NAME) == "true"

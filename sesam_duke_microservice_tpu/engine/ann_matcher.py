@@ -75,12 +75,13 @@ class AnnIndex(DeviceIndex):
         self.emb_storage = str(np.dtype(E.STORAGE_DTYPE))
 
     def _extract(self, records: Sequence[Record], plan=None):
-        feats = super()._extract(records, plan)
-        # E.STORAGE_DTYPE (bf16) — see ops.encoder for the rationale
-        feats[E.ANN_PROP] = {
-            E.ANN_TENSOR: self.encoder.encode_corpus(records)
-        }
-        return feats
+        # the embedding (E.STORAGE_DTYPE bf16 — see ops.encoder) rides
+        # through extract_batch so feature + embedding extraction share
+        # one entry point
+        from ..ops import features as F
+
+        return F.extract_batch(plan or self.plan, records,
+                               encoder=self.encoder)
 
     @property
     def scorer_cache(self) -> "_AnnScorerCache":

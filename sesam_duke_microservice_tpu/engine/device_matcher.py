@@ -170,12 +170,15 @@ class DeviceCorpus:
             }
         self._grow(self.size + n)
         rows = np.arange(self.size, self.size + n)
+        # appended rows are contiguous: slice assignment is a straight
+        # memcpy, where fancy indexing with the arange pays an index path
+        lo, hi = self.size, self.size + n
         for prop, tensors in feats.items():
             for name, arr in tensors.items():
-                self.feats[prop][name][rows] = arr
-        self.row_valid[rows] = True
-        self.row_deleted[rows] = deleted
-        self.row_group[rows] = group
+                self.feats[prop][name][lo:hi] = arr
+        self.row_valid[lo:hi] = True
+        self.row_deleted[lo:hi] = deleted
+        self.row_group[lo:hi] = group
         self.row_ids.extend(ids)
         old_size, self.size = self.size, self.size + n
         self._dirty_masks = True

@@ -89,6 +89,15 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_float)]
         lib.duke_embed_batch.restype = None
+        lib.duke_fnv1a64_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), i64p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.duke_fnv1a64_batch.restype = None
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.duke_gram_set_batch.argtypes = [
+            u32p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            i32p, i32p]
+        lib.duke_gram_set_batch.restype = None
         # scalar entry points take the UTF-32 bytes object directly
         # (c_char_p), skipping numpy packing
         cc = ctypes.c_char_p
@@ -132,6 +141,51 @@ def _pack(strings: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
 
 def _ptrs(buf: np.ndarray, off: np.ndarray):
     return buf.ctypes.data_as(_U32P), off.ctypes.data_as(_I64P)
+
+
+def fnv1a64_bytes_batch(bufs: Sequence[bytes]) -> np.ndarray:
+    """Bulk FNV-1a64 over pre-encoded UTF-8 buffers -> (N,) uint64.
+
+    Bit-identical to ``ops.features.fnv1a64`` (which hashes the UTF-8
+    encoding); the ingest path hashes every value + q-gram + token per
+    record, so this one C pass replaces the numpy grouped-fold hot spot.
+    """
+    lib = _load()
+    assert lib is not None
+    n = len(bufs)
+    off = np.zeros(n + 1, dtype=np.int64)
+    total = 0
+    for i, b in enumerate(bufs):
+        total += len(b)
+        off[i + 1] = total
+    buf = (np.frombuffer(b"".join(bufs), dtype=np.uint8) if total
+           else np.zeros(1, dtype=np.uint8))
+    out = np.empty((n,), dtype=np.uint64)
+    lib.duke_fnv1a64_batch(
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        off.ctypes.data_as(_I64P), n,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+    )
+    return out
+
+
+def gram_set_batch(values: Sequence[str], q: int,
+                   max_grams: int, set_pad: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Bulk q-gram set ids: ((N, max_grams) int32 sorted-distinct folded
+    gram hashes padded with ``set_pad``, (N,) int32 counts).  Bit-identical
+    to the Python path in ops.features (qgrams + hash + sorted(set))."""
+    lib = _load()
+    assert lib is not None
+    buf, off = _pack(values)
+    n = len(values)
+    grams = np.full((n, max_grams), set_pad, dtype=np.int32)
+    counts = np.zeros((n,), dtype=np.int32)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.duke_gram_set_batch(
+        *_ptrs(buf, off), n, q, max_grams,
+        grams.ctypes.data_as(i32p), counts.ctypes.data_as(i32p),
+    )
+    return grams, counts
 
 
 def lev_sim_batch(a: Sequence[str], b: Sequence[str]) -> np.ndarray:

@@ -314,6 +314,84 @@ int64_t duke_lev_distance(const uint32_t* a, int64_t na, const uint32_t* b,
     return lev_distance(a, na, b, nb);
 }
 
+// Bulk q-gram set extraction (ops.features GRAM_SET): for each value
+// (UTF-32 codepoint range), hash every q-codepoint window — the whole
+// value when shorter than q — with FNV-1a64 over the window's UTF-8
+// encoding, fold to int32 ((h ^ h>>32) low word, two's complement),
+// dedupe + sort ascending (signed), truncate to max_grams.  out_grams is
+// (n, max_grams) prefilled with the SET_PAD sentinel; bit-identical to
+// the Python path (qgrams + fnv1a64_batch + sorted(set(...))) —
+// differential-tested in tests/test_native.py.
+void duke_gram_set_batch(const uint32_t* buf, const int64_t* off, int64_t n,
+                         int64_t q, int64_t max_grams, int32_t* out_grams,
+                         int32_t* out_counts) {
+    constexpr uint64_t kOffset = 0xCBF29CE484222325ULL;
+    constexpr uint64_t kPrime = 0x100000001B3ULL;
+    std::vector<int32_t> ids;
+    for (int64_t i = 0; i < n; ++i) {
+        const uint32_t* cp = buf + off[i];
+        const int64_t len = off[i + 1] - off[i];
+        out_counts[i] = 0;
+        if (len == 0) continue;
+        const int64_t win = len < q ? len : q;
+        const int64_t n_win = len < q ? 1 : len - q + 1;
+        ids.clear();
+        for (int64_t w = 0; w < n_win; ++w) {
+            uint64_t h = kOffset;
+            for (int64_t j = 0; j < win; ++j) {
+                // inline UTF-8 encoding of one codepoint (surrogatepass:
+                // D800-DFFF take the normal 3-byte form, matching
+                // str.encode("utf-8", "surrogatepass"))
+                const uint32_t c = cp[w + j];
+                if (c < 0x80) {
+                    h = (h ^ c) * kPrime;
+                } else if (c < 0x800) {
+                    h = (h ^ (0xC0 | (c >> 6))) * kPrime;
+                    h = (h ^ (0x80 | (c & 0x3F))) * kPrime;
+                } else if (c < 0x10000) {
+                    h = (h ^ (0xE0 | (c >> 12))) * kPrime;
+                    h = (h ^ (0x80 | ((c >> 6) & 0x3F))) * kPrime;
+                    h = (h ^ (0x80 | (c & 0x3F))) * kPrime;
+                } else {
+                    h = (h ^ (0xF0 | (c >> 18))) * kPrime;
+                    h = (h ^ (0x80 | ((c >> 12) & 0x3F))) * kPrime;
+                    h = (h ^ (0x80 | ((c >> 6) & 0x3F))) * kPrime;
+                    h = (h ^ (0x80 | (c & 0x3F))) * kPrime;
+                }
+            }
+            ids.push_back(static_cast<int32_t>(
+                static_cast<uint32_t>((h ^ (h >> 32)) & 0xFFFFFFFFULL)));
+        }
+        std::sort(ids.begin(), ids.end());
+        ids.erase(std::unique(ids.begin(), ids.end()), ids.end());
+        const int64_t count = static_cast<int64_t>(ids.size()) < max_grams
+                                  ? static_cast<int64_t>(ids.size())
+                                  : max_grams;
+        int32_t* row = out_grams + i * max_grams;
+        for (int64_t g = 0; g < count; ++g) row[g] = ids[g];
+        out_counts[i] = static_cast<int32_t>(count);
+    }
+}
+
+// Bulk FNV-1a64 over UTF-8 byte ranges: the ingest hot path hashes every
+// value plus every q-gram/token per record (ops.features), and even the
+// vectorized numpy fold costs ~45 us per KB of grouped padding work.
+// buf/off follow the batch packing convention (off has n+1 entries).
+// Bit-identical to ops.features.fnv1a64 (differential-tested).
+void duke_fnv1a64_batch(const uint8_t* buf, const int64_t* off, int64_t n,
+                        uint64_t* out) {
+    constexpr uint64_t kOffset = 0xCBF29CE484222325ULL;
+    constexpr uint64_t kPrime = 0x100000001B3ULL;
+    for (int64_t i = 0; i < n; ++i) {
+        uint64_t h = kOffset;
+        for (int64_t p = off[i]; p < off[i + 1]; ++p) {
+            h ^= buf[p];
+            h *= kPrime;
+        }
+        out[i] = h;
+    }
+}
+
 // Scalar entry points for the per-pair comparator dispatch: take the raw
 // UTF-32 byte buffers straight from str.encode() so the Python side skips
 // numpy packing (the batch functions amortize that cost; a scalar call

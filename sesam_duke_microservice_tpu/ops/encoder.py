@@ -154,23 +154,30 @@ class RecordEncoder:
             return np.zeros((0, self.dim), dtype=np.float32)
         native = _native_embed()
         if native is not None:
-            # bulk path through the C++ library: one FFI call for the whole
-            # batch (tests pin it to the numpy path's exact output)
-            strings: List[str] = []
-            salts: List[np.uint64] = []
-            rec_off = np.zeros(len(records) + 1, dtype=np.int64)
-            for i, record in enumerate(records):
-                for name in self.props:
-                    for value in record.get_values(name):
-                        if value:
-                            strings.append(f" {value.lower()} ")
-                            salts.append(_salt(name))
-                rec_off[i + 1] = len(strings)
-            return native.embed_batch(
-                strings, np.asarray(salts, dtype=np.uint64), rec_off,
-                self.dim,
-            )
+            return self._encode_batch_native(records, native)
         return np.stack([self.encode(r) for r in records])
+
+    def _encode_batch_native(self, records: Sequence[Record],
+                             native) -> np.ndarray:
+        # bulk path through the C++ library: one FFI call for the whole
+        # chunk (tests pin it to the numpy path's exact output)
+        strings: List[str] = []
+        salts: List[np.uint64] = []
+        rec_off = np.zeros(len(records) + 1, dtype=np.int64)
+        empty: List[str] = []
+        prop_salts = [(name, _salt(name)) for name in self.props]
+        for i, record in enumerate(records):
+            values_map = record._values  # read-only peek (no copies)
+            for name, salt in prop_salts:
+                for value in values_map.get(name, empty):
+                    if value:  # defensive: keep parity with encode()'s guard
+                        strings.append(f" {value.lower()} ")
+                        salts.append(salt)
+            rec_off[i + 1] = len(strings)
+        return native.embed_batch(
+            strings, np.asarray(salts, dtype=np.uint64), rec_off,
+            self.dim,
+        )
 
 
 def retrieval_scan(q_emb, corpus_emb, corpus_valid, corpus_deleted,

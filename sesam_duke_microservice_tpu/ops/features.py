@@ -63,17 +63,22 @@ def fnv1a64(value: str) -> int:
 def fnv1a64_batch(values: Sequence[str]) -> np.ndarray:
     """Vectorized ``fnv1a64`` over many strings -> (N,) uint64.
 
-    Bit-identical to the scalar loop (differential-tested): the fold runs
-    over byte POSITIONS (vectorized across values), so the cost is
-    O(max_len) numpy ops instead of O(total_bytes) Python ops — the ingest
-    path hashes every value (plus every q-gram/token) per record, which
-    profiled as a third of end-to-end batch time before this.
+    Bit-identical to the scalar loop (differential-tested).  Fast path:
+    one bulk C pass over the concatenated UTF-8 bytes
+    (``native.fnv1a64_bytes_batch`` — the ingest path hashes every value
+    plus every q-gram/token per record, and this was the top profiled
+    ingest cost).  Fallback: the numpy fold over byte POSITIONS
+    (vectorized across values, O(max_len) numpy ops).
     """
     n = len(values)
     out = np.full((n,), _FNV_OFFSET, dtype=np.uint64)
     if n == 0:
         return out
     bufs = [v.encode("utf-8", "surrogatepass") for v in values]
+    from .. import native
+
+    if native.available():
+        return native.fnv1a64_bytes_batch(bufs)
     # group by byte-length power of two: a naive single padded matrix is
     # O(n * maxlen), so ONE long outlier value (arbitrary JSON fields) in
     # a big batch would balloon both the matrix and the fold loop; within
@@ -298,32 +303,56 @@ def extract_property(
         hash_lo[ii, kk] = lo
 
     if kind in (CHARS, CHARS_WEIGHTED):
-        for i, k, value in flat:
-            trunc = value[:MAX_CHARS]
+        if flat:
             # utf-32-le round-trips every codepoint (incl. lone
-            # surrogates) as one uint32 — a single C-speed conversion
-            # instead of a per-char ord() loop
-            cp = np.frombuffer(
-                trunc.encode("utf-32-le", "surrogatepass"), dtype="<u4"
-            )
-            length[i, k] = cp.size
-            chars[i, k, : cp.size] = cp.astype(np.int32)
+            # surrogates) as one uint32 — encode per value, then ONE
+            # concatenated buffer + boolean-mask scatter fills the whole
+            # (m, MAX_CHARS) block (row-major mask order == concatenation
+            # order), replacing a frombuffer + slice-assign per value
+            bufs = [
+                t[2][:MAX_CHARS].encode("utf-32-le", "surrogatepass")
+                for t in flat
+            ]
+            m = len(flat)
+            lens = np.fromiter((len(b) >> 2 for b in bufs), np.int64,
+                               count=m)
+            mat = np.zeros((m, MAX_CHARS), dtype=np.int32)
+            if int(lens.sum()):
+                all_cp = np.frombuffer(b"".join(bufs), dtype="<u4")
+                mat[np.arange(MAX_CHARS)[None, :] < lens[:, None]] = (
+                    all_cp.astype(np.int32)
+                )
+            chars[ii, kk] = mat  # ii/kk from the hash block above
+            length[ii, kk] = lens.astype(np.int32)
             if classes is not None:
-                for j, ch in enumerate(trunc):
-                    classes[i, k, j] = _char_class(ch)
+                for i, k, value in flat:
+                    for j, ch in enumerate(value[:MAX_CHARS]):
+                        classes[i, k, j] = _char_class(ch)
     elif kind == GRAM_SET:
-        # one flat hash pass over every gram of every value
-        gram_lists = [C.qgrams(t[2], q) for t in flat]
-        all_ids = _fold32(
-            fnv1a64_batch([g for gl in gram_lists for g in gl])
-        )
-        pos = 0
-        for (i, k, _), gl in zip(flat, gram_lists):
-            ids = sorted(set(all_ids[pos:pos + len(gl)].tolist()))
-            pos += len(gl)
-            ids = ids[:MAX_GRAMS]
-            grams[i, k, : len(ids)] = ids
-            gram_count[i, k] = len(ids)
+        from .. import native
+
+        if flat and native.available():
+            # one bulk C pass: window + UTF-8 + hash + dedupe + sort per
+            # value (replaces ~5 gram-substring Python objects + one
+            # str.encode per window — the top ingest cost after hashing)
+            gmat, gcounts = native.gram_set_batch(
+                [t[2] for t in flat], q, MAX_GRAMS, int(SET_PAD)
+            )
+            grams[ii, kk] = gmat
+            gram_count[ii, kk] = gcounts
+        else:
+            # one flat hash pass over every gram of every value
+            gram_lists = [C.qgrams(t[2], q) for t in flat]
+            all_ids = _fold32(
+                fnv1a64_batch([g for gl in gram_lists for g in gl])
+            )
+            pos = 0
+            for (i, k, _), gl in zip(flat, gram_lists):
+                ids = sorted(set(all_ids[pos:pos + len(gl)].tolist()))
+                pos += len(gl)
+                ids = ids[:MAX_GRAMS]
+                grams[i, k, : len(ids)] = ids
+                gram_count[i, k] = len(ids)
     elif kind == TOKEN_SET:
         token_lists = [t[2].split() for t in flat]
         all_ids = _fold32(
@@ -390,17 +419,44 @@ def extract_property(
 
 
 def extract_batch(
-    plan: SchemaFeatures, records: Sequence[Record]
+    plan: SchemaFeatures, records: Sequence[Record], *, encoder=None
 ) -> Dict[str, Dict[str, np.ndarray]]:
     """Extract all device-scored properties for a batch of records.
 
-    Returns ``{property_name: {tensor_name: (N, V, ...) array}}``.
+    Returns ``{property_name: {tensor_name: (N, V, ...) array}}``; when
+    ``encoder`` is given (the ANN backend), the embedding rides in the
+    result under its pseudo-property.
+
+    Deliberately serial.  Parallel variants were built and measured
+    (r4): a thread fan-out gains nothing because the remaining per-value
+    glue (string encode, flat-list construction, embedding packing) is
+    GIL-bound Python — the C/numpy bulk passes it feeds already release
+    the GIL but no longer dominate; a spawn process pool LOSES 3-5x
+    because the result tensors (~1 KB/row) pay pickling + IPC both ways.
+    The wins that stuck are in the serial path itself: bulk C FNV
+    hashing and q-gram set extraction (native.duke_fnv1a64_batch /
+    duke_gram_set_batch), one-pass codepoint scatter, and no-copy record
+    reads — see BASELINE.md "Ingest".
     """
+    from . import encoder as E
+
+    out = _extract_serial(plan, records)
+    if encoder is not None:
+        out[E.ANN_PROP] = {E.ANN_TENSOR: encoder.encode_corpus(records)}
+    return out
+
+
+def _extract_serial(
+    plan: SchemaFeatures, records: Sequence[Record]
+) -> Dict[str, Dict[str, np.ndarray]]:
     out: Dict[str, Dict[str, np.ndarray]] = {}
+    empty: List[str] = []
     for spec in plan.device_props:
-        values = [
-            [val for val in r.get_values(spec.name) if val] for r in records
-        ]
+        # read-only peek at the live value lists (get_values copies per
+        # call — measurable at 10^5-record slabs x several properties);
+        # stored values are never empty (Record.add_value drops them)
+        name = spec.name
+        values = [r._values.get(name, empty) for r in records]
         out[spec.name] = extract_property(spec, values)
     return out
 

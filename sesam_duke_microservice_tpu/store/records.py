@@ -36,8 +36,13 @@ def _row_digest(rid: str, data: str) -> bytes:
 
 def serialize_record(record: Record) -> str:
     """THE canonical record serialization — the store row payload AND the
-    digest input share this one function, so the two can never drift."""
-    return json.dumps(record.to_dict(), separators=(",", ":"))
+    digest input share this one function, so the two can never drift.
+    Core Records serialize their live value dict directly (json.dumps
+    only reads it; ``to_dict``'s defensive copy was a measurable slice of
+    ingest at 10^5-row slabs); byte-identical either way."""
+    values = (record._values if type(record) is Record
+              else record.to_dict())
+    return json.dumps(values, separators=(",", ":"))
 
 
 def record_digest(record: Record) -> bytes:

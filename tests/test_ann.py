@@ -102,7 +102,7 @@ class TestAnnVsBruteForce:
         b1 = random_records(30, seed=1)
         b2 = random_records(25, seed=2)
         for i, r in enumerate(b2):
-            r._values[ID_PROPERTY_NAME] = [f"s{i}"]
+            r.set_values(ID_PROPERTY_NAME, [f"s{i}"])
         device, _, _ = run_device(schema, [b1, b2])
         ann, _, _ = run_ann(schema, [b1, b2])
         assert ann.match_set() == device.match_set()

@@ -180,7 +180,7 @@ class TestDeviceVsHostParity:
         b2 = random_records(25, seed=2)
         # distinct ids for the second batch
         for i, r in enumerate(b2):
-            r._values[ID_PROPERTY_NAME] = [f"s{i}"]
+            r.set_values(ID_PROPERTY_NAME, [f"s{i}"])
         host = run_host(schema, [b1, b2])
         device, _, _ = run_device(schema, [b1, b2])
         assert device.match_set() == host.match_set()
@@ -471,14 +471,14 @@ class TestSnapshot:
         proc2.add_match_listener(log2)
         probe = random_records(10, seed=77)
         for i, r in enumerate(probe):
-            r._values["ID"] = [f"p{i}"]
+            r.set_values("ID", [f"p{i}"])
         proc2.deduplicate(probe)
 
         log3 = EventLog()
         proc.listeners[:] = [log3]
         probe2 = random_records(10, seed=77)
         for i, r in enumerate(probe2):
-            r._values["ID"] = [f"p{i}"]
+            r.set_values("ID", [f"p{i}"])
         proc.deduplicate(probe2)
         assert log2.match_set() == log3.match_set()
 

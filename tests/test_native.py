@@ -162,8 +162,8 @@ def test_embed_batch_matches_numpy_oracle():
     enc = E.RecordEncoder(schema, 128)
     records = random_records(120, seed=9)
     # unicode + empty-field coverage
-    records[0]._values["name"] = ["åse blåbærsyltetøy 中文"]
-    records[1]._values["name"] = [""]
+    records[0].set_values("name", ["åse blåbærsyltetøy 中文"])
+    records[1].set_values("name", [""])
 
     nat = enc.encode_batch(records)
     saved = E._native_embed
