@@ -293,3 +293,56 @@ def test_sharded_http_service_end_to_end():
     finally:
         server.shutdown()
         app.close()
+
+
+def _escalation_batch(n_dups, n_filler):
+    """n_dups records sharing one name (every pair a candidate) plus
+    distinct filler rows."""
+    rows = [
+        {"_id": f"dup{i}", "name": "grace hopper",
+         "email": f"g{i}@navy.mil"}
+        for i in range(n_dups)
+    ]
+    rows += [
+        {"_id": f"f{i}", "name": f"unrelated person {i:04d}",
+         "email": f"u{i}@x.no"}
+        for i in range(n_filler)
+    ]
+    return rows
+
+
+@pytest.mark.parametrize("sharded,single", [
+    ("sharded-brute", "device"),   # K-escalation (top-K overflow)
+    ("sharded", "ann"),            # C-escalation (retrieval saturation)
+])
+def test_sharded_escalation_fires_and_matches_single_chip(sharded, single):
+    """VERDICT r3 #7: the claim that 'escalation loops run unchanged' on
+    the mesh must be tested, not asserted.  One name cluster larger than
+    the initial top-K/top-C forces the widening loop INSIDE shard_map
+    (count is psum'd over the mesh, so the decision depends on the
+    collective); links + confidences must equal the single-chip backend's
+    under escalation, and the escalation counter must actually move on
+    both."""
+    from sesam_duke_microservice_tpu.engine import device_matcher as DM
+
+    # DEVICE_TOP_K=16 (K path) and initial_top_c=64 (C path): a
+    # 72-strong duplicate cluster overflows both widths
+    batches = [_escalation_batch(72, 24)]
+
+    def run_counting(backend):
+        start = DM.ESCALATIONS
+        links = _run_dedup(backend, batches)
+        return links, DM.ESCALATIONS - start
+
+    sharded_links, sharded_esc = run_counting(sharded)
+    single_links, single_esc = run_counting(single)
+    assert sharded_esc > 0, "mesh escalation never fired"
+    assert single_esc > 0, "single-chip escalation never fired"
+    assert sharded_links == single_links
+    # the cluster must actually be fully linked (C(40,2) pairs) — proof
+    # the widened pass surfaced candidates beyond the initial width
+    dup_pairs = [
+        (a, b) for a, b, _ in sharded_links
+        if a.startswith("dup") and b.startswith("dup")
+    ]
+    assert len(dup_pairs) == 72 * 71 // 2
