@@ -1,0 +1,147 @@
+"""Mixed-schema throughput: one long-text property must not drag the
+whole schema off the fast path (VERDICT r3 #5).
+
+Three configurations over the same corpus size, end-to-end through the
+DeviceProcessor (the bench.py methodology — scoring rate over an indexed
+corpus, warm shapes, ingest excluded from the timed region):
+
+  * ``short``: three short properties (name Levenshtein / area Numeric /
+    ssn Exact) — the headline configuration.
+  * ``mixed``: the same three PLUS a ~1000-char Levenshtein property.
+    With char-width auto-sizing the long property demotes to the host
+    path past DEVICE_DEMOTE_CHARS (default 256), so the device keeps
+    pruning on the short properties; survivors pay host finalization of
+    the long field.
+  * ``mixed-256``: the long values truncated to fit the 256-char N-word
+    Myers kernel (DEVICE_DEMOTE_CHARS=0 semantics via data length) — the
+    all-on-device alternative, for the gap measurement.
+
+Usage: python benchmarks/mixed_schema_bench.py [--corpus 20000]
+       [--queries 4096]
+Prints one JSON line per configuration.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+WORDS = ("alpha bravo charlie delta echo foxtrot golf hotel india juliett "
+         "kilo lima mike november oscar papa quebec romeo sierra tango "
+         "uniform victor whiskey xray yankee zulu").split()
+
+
+def records_for(n, seed, dataset, *, long_chars=0):
+    from sesam_duke_microservice_tpu.core.records import (
+        DATASET_ID_PROPERTY_NAME,
+        ID_PROPERTY_NAME,
+        ORIGINAL_ENTITY_ID_PROPERTY_NAME,
+        Record,
+    )
+
+    rng = random.Random(seed)
+    out = []
+    for i in range(n):
+        r = Record()
+        eid = f"{rng.randint(1, 1_000_000)}_{i}"
+        r.add_value(ID_PROPERTY_NAME, f"{dataset}__{eid}")
+        r.add_value(ORIGINAL_ENTITY_ID_PROPERTY_NAME, eid)
+        r.add_value(DATASET_ID_PROPERTY_NAME, dataset)
+        r.add_value("name", f"{rng.choice(WORDS)} {rng.choice(WORDS)}")
+        r.add_value("area", str(rng.randint(1, 10)))
+        r.add_value("ssn", str(rng.randint(1, 1_000_000)))
+        if long_chars:
+            body = " ".join(
+                rng.choice(WORDS) for _ in range(long_chars // 6)
+            )
+            r.add_value("desc", body[:long_chars])
+        out.append(r)
+    return out
+
+
+def schema_for(with_long):
+    from sesam_duke_microservice_tpu.core import comparators as C
+    from sesam_duke_microservice_tpu.core.config import DukeSchema
+    from sesam_duke_microservice_tpu.core.records import (
+        ID_PROPERTY_NAME,
+        Property,
+    )
+
+    numeric = C.Numeric()
+    numeric.min_ratio = 0.7
+    props = [
+        Property(ID_PROPERTY_NAME, id_property=True),
+        Property("name", C.Levenshtein(), 0.3, 0.88),
+        Property("area", numeric, 0.45, 0.65),
+        Property("ssn", C.Exact(), 0.3, 0.95),
+    ]
+    if with_long:
+        props.append(Property("desc", C.Levenshtein(), 0.45, 0.6))
+    return DukeSchema(threshold=0.9, maybe_threshold=None,
+                      properties=props, data_sources=[])
+
+
+def run(label, corpus_n, queries_n, long_chars):
+    from sesam_duke_microservice_tpu.engine.device_matcher import (
+        DeviceIndex,
+        DeviceProcessor,
+    )
+    from sesam_duke_microservice_tpu.utils.jit_cache import (
+        enable_persistent_cache,
+    )
+
+    enable_persistent_cache()
+    schema = schema_for(long_chars > 0)
+    index = DeviceIndex(schema)
+    proc = DeviceProcessor(schema, index)
+    for r in records_for(corpus_n, 1234, "ds1", long_chars=long_chars):
+        index.index(r)
+    index.commit()
+    # warm: two batches at the timed size (corpus upload + compiles + the
+    # incremental-updater shape), then tombstone the warm rows
+    for seed, ds in ((999, "warm"), (998, "warm2")):
+        warm = records_for(queries_n, seed, ds, long_chars=long_chars)
+        proc.deduplicate(warm)
+        for r in warm:
+            index.delete(r)
+    queries = records_for(queries_n, 5678, "ds2", long_chars=long_chars)
+    stats0 = proc.stats.pairs_compared
+    t0 = time.perf_counter()
+    proc.deduplicate(queries)
+    dt = time.perf_counter() - t0
+    scored = proc.stats.pairs_compared - stats0
+    device_names = sorted(s.name for s in index.plan.device_props)
+    host_names = sorted(p.name for p in index.plan.host_props)
+    print(json.dumps({
+        "config": label,
+        "pairs_per_sec": round(scored / dt, 1),
+        "batch_seconds": round(dt, 3),
+        "device_props": device_names,
+        "host_props": host_names,
+        "char_widths": {s.name: s.chars for s in index.plan.device_props},
+    }), flush=True)
+    return scored / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--corpus", type=int, default=20000)
+    ap.add_argument("--queries", type=int, default=4096)
+    args = ap.parse_args()
+    short = run("short", args.corpus, args.queries, 0)
+    mixed = run("mixed", args.corpus, args.queries, 1000)
+    print(json.dumps({
+        "config": "summary",
+        "mixed_vs_short": round(short / mixed, 2),
+        "within_2x": bool(short / mixed <= 2.0),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -49,6 +49,7 @@ import numpy as np
 from ..core.config import DukeSchema, MatchTunables
 from ..core.records import GROUP_NO_PROPERTY_NAME, Record, SchemaError
 from ..index.base import CandidateIndex
+from ..ops.features import CHARS as _F_CHARS, CHARS_WEIGHTED as _F_CHARS_W
 from .listeners import MatchListener
 from .processor import ProfileStats
 
@@ -87,6 +88,22 @@ _INITIAL_TOP_K = int(os.environ.get("DEVICE_TOP_K", "64"))
 # score their first MAX slots on device (host finalization still sees every
 # value, so only *pruning* can be affected beyond the cap).
 _VALUE_SLOTS_MAX = int(os.environ.get("DEVICE_VALUE_SLOTS_MAX", "8"))
+# Per-property char-width auto-growth (CHARS-kind properties): when
+# DEVICE_MAX_CHARS is NOT pinned, each property's char tensors start at
+# the 32-char Myers width and double to fit the data — so ONE long-text
+# field (a description, an abstract) widens only its own tensors while
+# the other properties stay on the fast single-word path.  Past
+# DEVICE_DEMOTE_CHARS (default = MYERS_MAX_CHARS, the Pallas kernel
+# ceiling) the property DEMOTES to the host-scored path instead: the
+# device keeps pruning on the remaining short properties with the
+# demoted property's maximum contribution folded into the optimistic
+# bound (ops.scoring.host_bound_logit), and survivors host-finalize
+# exactly — one 1000-char field costs host work per SURVIVOR instead of
+# dragging every corpus pair onto the ~86K pairs/s scan-DP kernel.
+# DEVICE_DEMOTE_CHARS=0 disables demotion; widths then grow to
+# DEVICE_MAX_CHARS_CAP and truncate beyond it.
+_CHARS_CAP = int(os.environ.get("DEVICE_MAX_CHARS_CAP", "1024"))
+_DEMOTE_CHARS = int(os.environ.get("DEVICE_DEMOTE_CHARS", "256"))
 
 
 def _bucket_for(n: int) -> int:
@@ -340,6 +357,10 @@ class DeviceIndex(CandidateIndex):
         # DEVICE_VALUE_SLOTS env pins the width instead.
         env_v = os.environ.get("DEVICE_VALUE_SLOTS")
         self._auto_value_slots = values_per_record is None and env_v is None
+        # char widths auto-grow per property unless the operator pinned a
+        # global width (tests pin small shapes; long-text deployments let
+        # the data size each property's tensors)
+        self._auto_chars = os.environ.get("DEVICE_MAX_CHARS") is None
         v = values_per_record or int(env_v or "1")
         self.plan = F.SchemaFeatures.plan(schema, values_per_record=v)
         if not self.plan.device_props:
@@ -537,27 +558,114 @@ class DeviceIndex(CandidateIndex):
 
     # -- value-slot auto-sizing ----------------------------------------------
 
+    def _chars_needed(self, spec, records: Sequence[Record]) -> int:
+        need = 0
+        for r in records:
+            for val in r.get_values(spec.name):
+                if len(val) > need:
+                    need = len(val)
+        return need
+
+    def _sized_chars(self, spec, need: int) -> int:
+        """Power-of-two char width fitting ``need`` codepoints, at least
+        the current width, clamped to DEVICE_MAX_CHARS_CAP (warns once
+        per property on clamp)."""
+        if need > _CHARS_CAP:
+            key = f"chars:{spec.name}"
+            if key not in self._cap_warned:
+                self._cap_warned.add(key)
+                logger.warning(
+                    "property %r has a %d-char value; device pruning sees "
+                    "the first %d chars (DEVICE_MAX_CHARS_CAP; host "
+                    "finalization stays exact)", spec.name, need, _CHARS_CAP,
+                )
+        width = spec.chars
+        while width < need and width < _CHARS_CAP:
+            width *= 2
+        return min(width, _CHARS_CAP)
+
     def _maybe_grow_value_slots(self, records: Sequence[Record]) -> None:
-        """Grow per-property value slots to fit the incoming batch.
+        """Grow per-property value slots AND char widths to fit the batch.
 
         Duke scores the max over *all* value pairs per property
-        (IncrementalDataSource.java:69-73 feeds multi-values); the device
-        tensors bound the value axis for static shapes, so when a batch
-        arrives with more values than the current width the plan widens
-        (power-of-two, capped at DEVICE_VALUE_SLOTS_MAX) and the corpus
-        tensors are rebuilt from the host-resident records.  Growth happens
-        at most O(log max) times per property over a corpus's lifetime.
+        (IncrementalDataSource.java:69-73 feeds multi-values), and its
+        comparators accept arbitrary-length strings
+        (testdukeconfig.xml:25-42 puts no bound on property values); the
+        device tensors bound both axes for static shapes, so when a batch
+        arrives with more values — or longer values — than the current
+        widths, the plan widens (power-of-two, capped) and the corpus
+        tensors rebuild from the host-resident records.  Growth happens
+        at most O(log max) times per axis per property, and widths are
+        PER PROPERTY: one long-text field rides the wide (or scan-DP)
+        kernels alone while short fields keep the one-word Myers path.
         """
-        if not self._auto_value_slots:
-            return
         grew = False
+        demote = []
         for spec in self.plan.device_props:
-            v = self._sized_slots(spec, records)
-            if v > spec.values_per_record:
-                spec.values_per_record = v
-                grew = True
+            if self._auto_value_slots:
+                v = self._sized_slots(spec, records)
+                if v > spec.values_per_record:
+                    spec.values_per_record = v
+                    grew = True
+            if self._auto_chars and spec.kind in (_F_CHARS, _F_CHARS_W):
+                need = self._chars_needed(spec, records)
+                if _DEMOTE_CHARS and need > _DEMOTE_CHARS:
+                    demote.append(spec)
+                    continue
+                width = self._sized_chars(spec, need)
+                if width > spec.chars:
+                    spec.max_chars = width
+                    grew = True
+        if demote and self._demote_to_host(demote):
+            grew = True
         if grew:
             self._rebuild_corpus()
+
+    def _demote_to_host(self, specs) -> bool:
+        """Move long-text CHARS properties to the host-scored side (see
+        the _DEMOTE_CHARS comment).  Never demotes the LAST device
+        property — the scorer needs at least one (that one stays at the
+        cap width, truncating).  Returns True when the plan changed."""
+        changed = False
+        keep_one = len(self.plan.device_props) - len(specs) < 1
+        if keep_one:
+            kept, specs = specs[0], specs[1:]  # first candidate stays
+            width = self._sized_chars(kept, _CHARS_CAP)
+            key = f"keep:{kept.name}"
+            if key not in self._cap_warned:
+                self._cap_warned.add(key)
+                logger.warning(
+                    "property %r is the only device-kernel property, so it "
+                    "stays on device at width %d; longer values truncate "
+                    "for pruning (host finalization stays exact)",
+                    kept.name, width,
+                )
+            if width > kept.chars:
+                kept.max_chars = width
+                changed = True  # caller must rebuild the corpus tensors
+        if not specs:
+            return changed
+        names = {s.name for s in specs}
+        self.plan.device_props[:] = [
+            s for s in self.plan.device_props if s.name not in names
+        ]
+        for prop in self.schema.comparison_properties():
+            if prop.name in names:
+                self.plan.host_props.append(prop)
+        logger.warning(
+            "long-text properties %s demoted to host scoring (values past "
+            "DEVICE_DEMOTE_CHARS=%d; device pruning keeps the remaining "
+            "properties with the demoted ones' max contribution in the "
+            "optimistic bound)", sorted(names), _DEMOTE_CHARS,
+        )
+        # cached scorer builders snapshotted the old device_props list;
+        # drop them (and the warm fingerprint) so the next dispatch
+        # rebuilds from the updated plan
+        cache = self._scorer_cache
+        if cache is not None:
+            cache._scorers.clear()
+            cache._warmed = None
+        return True
 
     def _rebuild_corpus(self) -> None:
         """Re-extract every stored record under the current feature plan.
@@ -682,6 +790,8 @@ class DeviceIndex(CandidateIndex):
             [(s.name, s.kind, s.low, s.high)
              for s in self.plan.device_props],
             os.environ.get("DEVICE_MAX_CHARS", ""),
+            os.environ.get("DEVICE_MAX_CHARS_CAP", ""),
+            os.environ.get("DEVICE_DEMOTE_CHARS", ""),
             os.environ.get("DEVICE_MAX_GRAMS", ""),
             os.environ.get("DEVICE_MAX_TOKENS", ""),
             getattr(self, "dim", None),          # ANN embedding width
@@ -735,6 +845,17 @@ class DeviceIndex(CandidateIndex):
                 __value_slots=np.array(
                     [s.v for s in self.plan.device_props], dtype=np.int64
                 ),
+                __char_widths=np.array(
+                    [s.chars for s in self.plan.device_props],
+                    dtype=np.int64,
+                ),
+                # surviving device properties (r4): a plan that demoted a
+                # long-text property to host scoring persists that choice,
+                # so a restart re-demotes instead of rejecting the
+                # snapshot for a prop-count mismatch and replaying
+                __device_props=np.array(
+                    [s.name for s in self.plan.device_props], dtype=str
+                ),
                 __row_valid=corpus.row_valid[: corpus.size],
                 __row_deleted=corpus.row_deleted[: corpus.size],
                 __row_group=corpus.row_group[: corpus.size],
@@ -779,6 +900,24 @@ class DeviceIndex(CandidateIndex):
                     return False
                 if "__value_slots" not in data.files:
                     return False
+                # re-apply persisted long-text demotions BEFORE the
+                # per-prop list compares (see snapshot_save __device_props)
+                if "__device_props" in data.files and self._auto_chars:
+                    saved = [str(x) for x in data["__device_props"]]
+                    current = [s.name for s in self.plan.device_props]
+                    missing = [
+                        s for s in self.plan.device_props
+                        if s.name not in saved
+                    ]
+                    if missing and set(saved) < set(current):
+                        # applied even if a later check rejects the
+                        # snapshot: the demotion was data-driven, so the
+                        # replay that follows a rejection re-ingests the
+                        # same long values and would re-demote anyway —
+                        # starting demoted is conservative and exact
+                        self._demote_to_host(missing)
+                    if [s.name for s in self.plan.device_props] != saved:
+                        return False
                 slots = [int(x) for x in data["__value_slots"]]
                 if len(slots) != len(self.plan.device_props):
                     return False
@@ -789,6 +928,19 @@ class DeviceIndex(CandidateIndex):
                         return False
                 elif slots != [s.v for s in self.plan.device_props]:
                     return False
+                # per-property char widths (r4): absent key = pre-r4
+                # snapshot, valid only at the plan's default widths
+                if "__char_widths" in data.files:
+                    widths = [int(x) for x in data["__char_widths"]]
+                    if len(widths) != len(self.plan.device_props):
+                        return False
+                    if self._auto_chars:
+                        if any(w > _CHARS_CAP for w in widths):
+                            return False
+                    elif widths != [s.chars for s in self.plan.device_props]:
+                        return False
+                else:
+                    widths = [s.chars for s in self.plan.device_props]
                 # record CONTENT hash, not just the id set: an id-set check
                 # would accept a snapshot predating an in-place record
                 # update that only the store persisted (crash before the
@@ -825,10 +977,14 @@ class DeviceIndex(CandidateIndex):
             return False
 
         # every check passed — only now adopt the snapshot's value-slot
-        # widths (a rejected snapshot must leave the plan untouched)
+        # and char widths (a rejected snapshot must leave the plan
+        # untouched)
         if self._auto_value_slots:
             for spec, v in zip(self.plan.device_props, slots):
                 spec.values_per_record = v
+        if self._auto_chars:
+            for spec, w in zip(self.plan.device_props, widths):
+                spec.max_chars = w
         corpus = self.corpus
         n = len(row_ids)
         rows = corpus.append(
@@ -953,7 +1109,7 @@ class _ScorerCache:
         cap = max(self.index.corpus.capacity, _CHUNK)
         key = (
             cap,
-            tuple(s.v for s in self.index.plan.device_props),
+            tuple((s.v, s.chars) for s in self.index.plan.device_props),
             bool(group_filtering),
         )
         if self._warmed == key:

@@ -197,10 +197,20 @@ class PropertyFeatureSpec:
     high: float
     comparator: object
     values_per_record: int = 1
+    # per-property char-tensor width (CHARS kinds): starts at the global
+    # MAX_CHARS default and auto-grows with the data in
+    # engine.device_matcher, so ONE long-text property widens its own
+    # tensors (and rides the scan-DP fallback past MYERS_MAX_CHARS)
+    # without dragging every short property off the 32-char Myers path
+    max_chars: int = 0
 
     @property
     def v(self) -> int:
         return self.values_per_record
+
+    @property
+    def chars(self) -> int:
+        return self.max_chars or MAX_CHARS
 
 
 @dataclass
@@ -260,10 +270,11 @@ def extract_property(
 
     kind = spec.kind
     if kind in (CHARS, CHARS_WEIGHTED):
-        chars = np.zeros((n, v, MAX_CHARS), dtype=np.int32)
+        L = spec.chars
+        chars = np.zeros((n, v, L), dtype=np.int32)
         length = np.zeros((n, v), dtype=np.int32)
         classes = (
-            np.zeros((n, v, MAX_CHARS), dtype=np.int32)
+            np.zeros((n, v, L), dtype=np.int32)
             if kind == CHARS_WEIGHTED
             else None
         )
@@ -310,23 +321,23 @@ def extract_property(
             # (m, MAX_CHARS) block (row-major mask order == concatenation
             # order), replacing a frombuffer + slice-assign per value
             bufs = [
-                t[2][:MAX_CHARS].encode("utf-32-le", "surrogatepass")
+                t[2][:L].encode("utf-32-le", "surrogatepass")
                 for t in flat
             ]
             m = len(flat)
             lens = np.fromiter((len(b) >> 2 for b in bufs), np.int64,
                                count=m)
-            mat = np.zeros((m, MAX_CHARS), dtype=np.int32)
+            mat = np.zeros((m, L), dtype=np.int32)
             if int(lens.sum()):
                 all_cp = np.frombuffer(b"".join(bufs), dtype="<u4")
-                mat[np.arange(MAX_CHARS)[None, :] < lens[:, None]] = (
+                mat[np.arange(L)[None, :] < lens[:, None]] = (
                     all_cp.astype(np.int32)
                 )
             chars[ii, kk] = mat  # ii/kk from the hash block above
             length[ii, kk] = lens.astype(np.int32)
             if classes is not None:
                 for i, k, value in flat:
-                    for j, ch in enumerate(value[:MAX_CHARS]):
+                    for j, ch in enumerate(value[:L]):
                         classes[i, k, j] = _char_class(ch)
     elif kind == GRAM_SET:
         from .. import native

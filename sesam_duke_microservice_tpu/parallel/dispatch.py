@@ -135,6 +135,8 @@ def _env_fingerprint() -> dict:
         # mismatch here compiles different-shape programs per process and
         # deadlocks the first cross-host collective
         "max_chars": os.environ.get("DEVICE_MAX_CHARS", ""),
+        "max_chars_cap": os.environ.get("DEVICE_MAX_CHARS_CAP", ""),
+        "demote_chars": os.environ.get("DEVICE_DEMOTE_CHARS", ""),
         "max_grams": os.environ.get("DEVICE_MAX_GRAMS", ""),
         "max_tokens": os.environ.get("DEVICE_MAX_TOKENS", ""),
         "value_slots": os.environ.get("DEVICE_VALUE_SLOTS", ""),

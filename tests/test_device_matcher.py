@@ -547,3 +547,249 @@ class TestSnapshot:
         by_id[records[0].record_id] = changed
         index2 = DeviceIndex(schema, tunables=MatchTunables())
         assert index2.snapshot_load(path, by_id) is False
+
+
+def test_per_property_char_width_growth(monkeypatch):
+    """VERDICT r3 #5: one long-text property must widen only its OWN char
+    tensors (riding the wide/scan-DP kernels) while short properties keep
+    the narrow Myers path — and links must equal the host engine's for
+    differences that only appear deep in the long value."""
+    monkeypatch.delenv("DEVICE_MAX_CHARS", raising=False)
+    from sesam_duke_microservice_tpu.core import comparators as C
+    from sesam_duke_microservice_tpu.core.config import DukeSchema
+    from sesam_duke_microservice_tpu.core.records import (
+        ID_PROPERTY_NAME,
+        Property,
+        Record,
+    )
+    from sesam_duke_microservice_tpu.engine.device_matcher import (
+        DeviceIndex,
+        DeviceProcessor,
+    )
+    from sesam_duke_microservice_tpu.engine.processor import Processor
+    from sesam_duke_microservice_tpu.index.inverted import InvertedIndex
+    from sesam_duke_microservice_tpu.core.config import MatchTunables
+
+    schema = DukeSchema(
+        threshold=0.75, maybe_threshold=None,
+        properties=[
+            Property(ID_PROPERTY_NAME, id_property=True),
+            Property("name", C.Levenshtein(), 0.3, 0.9),
+            Property("desc", C.Levenshtein(), 0.35, 0.8),
+            Property("ssn", C.Exact(), 0.4, 0.85),
+        ],
+        data_sources=[],
+    )
+
+    # long descriptions that agree except deep past the default width —
+    # a fixed narrow width would prune on identical prefixes (length kept
+    # under DEVICE_DEMOTE_CHARS so this exercises GROWTH; demotion has
+    # its own test below)
+    base = ("the quick brown fox jumps over the lazy dog again and "
+            "again while the band plays on " * 2)           # ~170 chars
+    variant = base[:-40] + "completely different ending here lately"
+    assert 100 < len(base) <= 256 and 100 < len(variant) <= 256
+
+    def make(rid, name, desc, ssn):
+        r = Record()
+        r.add_value(ID_PROPERTY_NAME, f"d__{rid}")
+        r.add_value("name", name)
+        r.add_value("desc", desc)
+        r.add_value("ssn", ssn)
+        return r
+
+    records = [
+        make("1", "kari nordmann", base, "111"),
+        make("2", "kari nordmann", base, "111"),          # true dup of 1
+        make("3", "ola hansen", variant, "222"),          # deep-tail diff
+        make("4", "ola hansen", variant, "222"),          # true dup of 3
+        make("5", "per olsen", "a genuinely mid length description "
+             "that stays well under the demotion threshold", "333"),
+    ]
+
+    class Collector:
+        def __init__(self):
+            self.pairs = {}
+
+        def batch_ready(self, n):
+            pass
+
+        def matches(self, r1, r2, conf):
+            self.pairs[tuple(sorted((r1.record_id, r2.record_id)))] = round(
+                conf, 9
+            )
+
+        def matches_perhaps(self, r1, r2, conf):
+            pass
+
+        def no_match_for(self, r):
+            pass
+
+        def batch_done(self):
+            pass
+
+    index = DeviceIndex(schema, tunables=MatchTunables())
+    proc = DeviceProcessor(schema, index)
+    dev = Collector()
+    proc.add_match_listener(dev)
+    proc.deduplicate(records)
+
+    widths = {s.name: s.chars for s in index.plan.device_props}
+    # the long property grew; the short ones did not
+    assert widths["desc"] >= len(variant)
+    assert widths["name"] < 100
+    assert widths["desc"] > widths["name"]
+
+    host = Processor(schema, InvertedIndex(schema, MatchTunables()))
+    oracle = Collector()
+    host.add_match_listener(oracle)
+    host.deduplicate(records)
+
+    assert dev.pairs == oracle.pairs
+    assert tuple(sorted(("d__1", "d__2"))) in dev.pairs
+    assert tuple(sorted(("d__3", "d__4"))) in dev.pairs
+
+
+def test_long_text_property_demotes_to_host_path(monkeypatch):
+    """VERDICT r3 #5 (routing half): values past DEVICE_DEMOTE_CHARS move
+    the property to host scoring — the device keeps pruning on the short
+    properties with the demoted property's max contribution in the
+    optimistic bound — and links still equal the host engine's."""
+    monkeypatch.delenv("DEVICE_MAX_CHARS", raising=False)
+    from sesam_duke_microservice_tpu.core import comparators as C
+    from sesam_duke_microservice_tpu.core.config import (
+        DukeSchema,
+        MatchTunables,
+    )
+    from sesam_duke_microservice_tpu.core.records import (
+        ID_PROPERTY_NAME,
+        Property,
+        Record,
+    )
+    from sesam_duke_microservice_tpu.engine.device_matcher import (
+        DeviceIndex,
+        DeviceProcessor,
+    )
+    from sesam_duke_microservice_tpu.engine.processor import Processor
+    from sesam_duke_microservice_tpu.index.inverted import InvertedIndex
+
+    schema = DukeSchema(
+        threshold=0.75, maybe_threshold=None,
+        properties=[
+            Property(ID_PROPERTY_NAME, id_property=True),
+            Property("name", C.Levenshtein(), 0.3, 0.9),
+            Property("desc", C.Levenshtein(), 0.35, 0.8),
+            Property("ssn", C.Exact(), 0.4, 0.85),
+        ],
+        data_sources=[],
+    )
+    long_a = "an extremely long descriptive paragraph " * 30   # ~1200 chars
+    long_b = long_a[:-60] + "with a genuinely different conclusion drawn"
+
+    def make(rid, name, desc, ssn):
+        r = Record()
+        r.add_value(ID_PROPERTY_NAME, f"d__{rid}")
+        r.add_value("name", name)
+        r.add_value("desc", desc)
+        r.add_value("ssn", ssn)
+        return r
+
+    records = [
+        make("1", "kari nordmann", long_a, "111"),
+        make("2", "kari nordmann", long_a, "111"),
+        make("3", "ola hansen", long_b, "222"),
+        make("4", "ola hansen", long_b, "222"),
+        make("5", "per olsen", "short description", "333"),
+    ]
+
+    class Collector:
+        def __init__(self):
+            self.pairs = {}
+
+        def batch_ready(self, n):
+            pass
+
+        def matches(self, r1, r2, conf):
+            self.pairs[tuple(sorted((r1.record_id, r2.record_id)))] = round(
+                conf, 9
+            )
+
+        def matches_perhaps(self, r1, r2, conf):
+            pass
+
+        def no_match_for(self, r):
+            pass
+
+        def batch_done(self):
+            pass
+
+    index = DeviceIndex(schema, tunables=MatchTunables())
+    proc = DeviceProcessor(schema, index)
+    dev = Collector()
+    proc.add_match_listener(dev)
+    proc.deduplicate(records)
+
+    device_names = {s.name for s in index.plan.device_props}
+    host_names = {p.name for p in index.plan.host_props}
+    assert "desc" not in device_names and "desc" in host_names
+    assert "name" in device_names and "ssn" in device_names
+    # the short properties kept their narrow width
+    assert all(s.chars <= 64 for s in index.plan.device_props)
+
+    host = Processor(schema, InvertedIndex(schema, MatchTunables()))
+    oracle = Collector()
+    host.add_match_listener(oracle)
+    host.deduplicate(records)
+    assert dev.pairs == oracle.pairs
+    assert tuple(sorted(("d__1", "d__2"))) in dev.pairs
+
+
+def test_sole_device_property_keeps_device_and_rebuilds(monkeypatch):
+    """Keep-one demotion path (review finding r4): when the ONLY device
+    property sees a >DEVICE_DEMOTE_CHARS value, it must stay on device,
+    widen to the cap, and REBUILD the corpus tensors — a widened plan
+    over old-width tensors crashed the next append."""
+    monkeypatch.delenv("DEVICE_MAX_CHARS", raising=False)
+    from sesam_duke_microservice_tpu.core import comparators as C
+    from sesam_duke_microservice_tpu.core.config import (
+        DukeSchema,
+        MatchTunables,
+    )
+    from sesam_duke_microservice_tpu.core.records import (
+        ID_PROPERTY_NAME,
+        Property,
+        Record,
+    )
+    from sesam_duke_microservice_tpu.engine.device_matcher import (
+        DeviceIndex,
+        DeviceProcessor,
+    )
+
+    schema = DukeSchema(
+        threshold=0.75, maybe_threshold=None,
+        properties=[
+            Property(ID_PROPERTY_NAME, id_property=True),
+            Property("text", C.Levenshtein(), 0.3, 0.9),
+        ],
+        data_sources=[],
+    )
+
+    def make(rid, text):
+        r = Record()
+        r.add_value(ID_PROPERTY_NAME, f"k__{rid}")
+        r.add_value("text", text)
+        return r
+
+    index = DeviceIndex(schema, tunables=MatchTunables())
+    proc = DeviceProcessor(schema, index)
+    # short batch first (narrow tensors), then a long batch that would
+    # demote if any other device property existed
+    proc.deduplicate([make("1", "short one"), make("2", "short two")])
+    long_text = "a very long body of text " * 40   # ~1000 chars
+    proc.deduplicate([make("3", long_text), make("4", long_text)])
+    spec = index.plan.device_props[0]
+    assert spec.name == "text" and spec.chars >= 1024 or spec.chars >= 512
+    assert index.plan.host_props == []
+    # a further append at the widened shapes must not crash
+    proc.deduplicate([make("5", "another short")])
+    assert index.corpus.size >= 5
