@@ -88,6 +88,22 @@ def run(rows: int, folder: str, batch: int = 50_000):
     out = {"rows": rows}
 
     # -- build phase: store puts + index/commit (feature extraction) --------
+    # (skipped when the folder already holds a built corpus — lets the
+    # restart phase re-run without the ~15-minute 10M build)
+    prebuilt = os.path.exists(
+        os.path.join(wc.data_folder, "corpus_snapshot.npz"))
+    if prebuilt:
+        out["build_skipped"] = True
+        snap = os.path.join(wc.data_folder, "corpus_snapshot.npz")
+        out["snapshot_bytes"] = os.path.getsize(snap)
+        return _restart_phase(rows, wc, sc, out)
+    # a half-built folder (no snapshot) would restore + re-index on top of
+    # itself and double the corpus; refuse instead
+    if os.path.exists(os.path.join(wc.data_folder, "records.sqlite")):
+        raise SystemExit(
+            "data folder has a record store but no snapshot; delete it "
+            "or point --folder elsewhere"
+        )
     wl = build_workload(wc, sc, backend="ann", persistent=True)
     ds = wl.datasources["src"]
     t0 = time.perf_counter()
@@ -126,11 +142,28 @@ def run(rows: int, folder: str, batch: int = 50_000):
         os.path.join(wc.data_folder, "records.sqlite")
     )
 
-    # -- restart phase: cold build over the same folder ---------------------
+    return _restart_phase(rows, wc, sc, out)
+
+
+def _restart_phase(rows, wc, sc, out):
+    from sesam_duke_microservice_tpu.engine.workload import build_workload
+
+    # prewarm during the restart leg measured HARMFUL on the
+    # tunnel-attached bench host (remote compiles contend with the
+    # snapshot load: 10M restart 257s -> 1871s); default off, opt in
+    # with RESTART_PREWARM=1 on hosts with local TPU compile
+    os.environ["DEVICE_PREWARM"] = os.environ.get(
+        "RESTART_PREWARM", "0")
     t5 = time.perf_counter()
     wl2 = build_workload(wc, sc, backend="ann", persistent=True)
     out["restart_to_serving_s"] = round(time.perf_counter() - t5, 2)
-    assert wl2.index.corpus.size == rows, wl2.index.corpus.size
+    if out.get("build_skipped"):
+        # prebuilt folder: the corpus defines the row count (a --rows
+        # mismatch would otherwise size capacity wrong and abort the
+        # measurement at the very end)
+        out["rows"] = wl2.index.corpus.size
+    else:
+        assert wl2.index.corpus.size == rows, wl2.index.corpus.size
     out["snapshot_used"] = True
 
     # serving proof: one tiny transform probe end-to-end (also surfaces

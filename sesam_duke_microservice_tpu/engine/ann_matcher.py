@@ -94,17 +94,18 @@ class _AnnScorerCache(_ScorerCache):
     """Caches jitted ANN scorers per (top_c, group_filtering) and runs the
     recall-escalation loop."""
 
-    def _build(self, top_c: int, group_filtering: bool, from_rows: bool):
+    def _build(self, top_c: int, group_filtering: bool, from_rows: bool,
+               plan=None):
         from ..ops import scoring as S
 
         return S.build_ann_scorer(
-            self.index.plan, chunk=_CHUNK, top_c=top_c,
+            plan or self.index.plan, chunk=_CHUNK, top_c=top_c,
             group_filtering=group_filtering, queries_from_rows=from_rows,
         )
 
     def _lower_one(self, row_feats, cap: int, bucket: int,
                    group_filtering: bool, *, from_rows: bool = True,
-                   probe_feats=None):
+                   probe_feats=None, plan=None):
         """ANN pre-warm: the scorer signature carries the embedding matrix
         separately from the feature tree (see dispatch_block).  Covers both
         variants — from_rows=True (indexed batches gather on device) and
@@ -121,7 +122,7 @@ class _AnnScorerCache(_ScorerCache):
         c = min(self.index.initial_top_c, cap)
         # private jit instance via the shared builder — see
         # _ScorerCache._lower_one
-        scorer = self._build(c, group_filtering, from_rows)
+        scorer = self._build(c, group_filtering, from_rows, plan=plan)
         if from_rows:
             q_emb = jax.ShapeDtypeStruct((), np.float32)
             qfeats = {}

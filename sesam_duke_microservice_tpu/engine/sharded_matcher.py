@@ -148,13 +148,14 @@ class _ShardedScorerCache(_ScorerCache):
 
     queries_from_rows = False
 
-    def _build(self, top_k: int, group_filtering: bool, from_rows: bool):
+    def _build(self, top_k: int, group_filtering: bool, from_rows: bool,
+               plan=None):
         from ..parallel.sharded import build_sharded_scorer
 
         # signature matches the single-device from_rows=False scorer:
         # fn(qfeats, cfeats, valid, deleted, group, qgroup, qrow, min_logit)
         return build_sharded_scorer(
-            self.index.plan, self.index.mesh, chunk=_CHUNK, top_k=top_k,
+            plan or self.index.plan, self.index.mesh, chunk=_CHUNK, top_k=top_k,
             group_filtering=group_filtering,
         )
 
@@ -170,11 +171,12 @@ class _ShardedAnnScorerCache(_AnnScorerCache):
 
     queries_from_rows = False
 
-    def _build(self, top_c: int, group_filtering: bool, from_rows: bool):
+    def _build(self, top_c: int, group_filtering: bool, from_rows: bool,
+               plan=None):
         from ..parallel.ann_sharded import build_sharded_ann_scorer
 
         base = build_sharded_ann_scorer(
-            self.index.plan, self.index.mesh, chunk=_CHUNK, top_c=top_c,
+            plan or self.index.plan, self.index.mesh, chunk=_CHUNK, top_c=top_c,
             group_filtering=group_filtering,
         )
 

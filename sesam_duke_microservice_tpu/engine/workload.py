@@ -424,6 +424,17 @@ def build_workload(wc: WorkloadConfig, sc: ServiceConfig, *,
     or 'sharded-brute' (exact brute force over the same mesh).
     """
     group_filtering = wc.is_record_linkage
+    if backend != "host":
+        # device-family backends compile multi-second XLA programs per
+        # (capacity, bucket, K) shape; the persistent cache turns every
+        # restart's first-contact compiles into disk reads.  Enabled here
+        # so EVERY embedder gets it (the service CLI, benches, tests and
+        # direct build_workload callers used to enable it individually —
+        # the restart bench didn't, and its first probe silently paid
+        # ~10-20 s of re-compiles per process)
+        from ..utils.jit_cache import enable_persistent_cache
+
+        enable_persistent_cache()
     if backend == "device":
         from .device_matcher import DeviceIndex, DeviceProcessor
 
@@ -530,6 +541,13 @@ def build_workload(wc: WorkloadConfig, sc: ServiceConfig, *,
             cache = getattr(index, "scorer_cache", None)
             if restored and cache is not None:
                 cache.prewarm_async(group_filtering)
+            if restored and not loaded:
+                # replay path: stream the rebuilt corpus to HBM now (the
+                # snapshot path kicks this inside snapshot_load) so the
+                # first query doesn't pay the full upload
+                warm = getattr(index, "warm_upload_async", None)
+                if warm is not None:
+                    warm()
     except BaseException:
         # a half-built workload never reaches the caller; release whatever
         # opened so a failing hot reload cannot leak handles (quirk Q7)
