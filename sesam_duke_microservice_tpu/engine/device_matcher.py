@@ -1056,7 +1056,9 @@ class DeviceIndex(CandidateIndex):
         query's device_arrays() then finds the mirrors already resident
         (or waits on the upload lock for the in-flight remainder).
         """
-        if self.corpus.size == 0:
+        # Small corpora upload in milliseconds on first query — not worth
+        # a background thread (and its writer-race surface) at all
+        if self.corpus.size < 65536:
             return
         # Default ON: in same-day 10M measurements on the tunnel-attached
         # bench host the background upload cut restart+first-probe 1592s
