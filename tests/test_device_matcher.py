@@ -793,3 +793,52 @@ def test_sole_device_property_keeps_device_and_rebuilds(monkeypatch):
     # a further append at the widened shapes must not crash
     proc.deduplicate([make("5", "another short")])
     assert index.corpus.size >= 5
+
+
+def test_device_arrays_redoes_after_concurrent_mutation():
+    """The warm-upload race guard (review finding r4): when a writer
+    mutates the host mirror while an upload pass is in flight, the
+    generation counter must force a second (incremental) pass so the
+    cleared dirty flags cannot hide rows from the device copy."""
+    from sesam_duke_microservice_tpu.core.config import MatchTunables
+
+    schema = dedup_schema()
+    index = DeviceIndex(schema, tunables=MatchTunables())
+    for r in random_records(8, seed=3):
+        index.index(r)
+    index.commit()
+    corpus = index.corpus
+    corpus.device_arrays()  # settle
+
+    extra = random_records(4, seed=9)
+    for i, r in enumerate(extra):
+        r.set_values(ID_PROPERTY_NAME, [f"x{i}"])
+
+    passes = {"n": 0}
+    real = type(corpus)._device_arrays_locked
+
+    def racy(self):
+        passes["n"] += 1
+        out = real(self)
+        if passes["n"] == 1:
+            # a writer lands mid-upload: append AFTER the pass consumed
+            # the dirty flags (the exact interleaving that silently lost
+            # rows before the generation counter)
+            for r in extra:
+                index.index(r)
+            index.commit()
+        return out
+
+    corpus_cls = type(corpus)
+    orig = corpus_cls._device_arrays_locked
+    corpus_cls._device_arrays_locked = racy
+    try:
+        feats, valid, deleted, group = corpus.device_arrays()
+    finally:
+        corpus_cls._device_arrays_locked = orig
+    assert passes["n"] >= 2, "generation change did not force a re-run"
+    # the appended rows made it to the device copy
+    import numpy as np
+
+    assert int(np.asarray(valid).sum()) == corpus.row_valid.sum()
+    assert bool(np.asarray(valid)[index.id_to_row["x0"]])
