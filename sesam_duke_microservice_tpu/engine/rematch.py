@@ -23,6 +23,13 @@ link database is a no-op for pollers.
 Reachable from the REST surface as ``POST /{kind}/{name}/rematch``
 (admin extension; the reference has no bulk operations) and from Python
 via ``ring_rematch(workload)``.
+
+Multi-host (r4): the frontend broadcasts a ``rematch`` op before running
+(parallel/dispatch.py), follower replicas replay the device-program side
+(placement, ring passes, escalation re-runs) in lockstep, and every
+result fetch goes through ``process_allgather`` — itself a collective all
+processes enter — because the ring outputs are query-sharded and a plain
+``np.asarray`` cannot materialize non-addressable shards.
 """
 
 from __future__ import annotations
@@ -38,6 +45,25 @@ logger = logging.getLogger("ring-rematch")
 _INITIAL_TOP_K = 64
 
 
+def _gather(tree):
+    """Materialize query-sharded ring outputs on every host.
+
+    Single-process: plain transfers.  Multi-process: each host holds only
+    its shards — ``process_allgather`` (a collective every process enters
+    in lockstep, including follower replicas) assembles the full arrays
+    everywhere; ``tiled=True`` because the inputs are sharded GLOBAL
+    arrays (tiled=False would try to stack a per-process leading axis).
+    Callers must invoke this in the same order on every process.
+    """
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(tree, tiled=True)
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
 def ring_rematch(workload, *, query_block_rows: Optional[int] = None,
                  mesh=None) -> Dict:
     """Re-score every live record against the whole corpus via the ring.
@@ -47,18 +73,68 @@ def ring_rematch(workload, *, query_block_rows: Optional[int] = None,
     corpus and the query features); the host backend has no feature
     tensors to ride the mesh.
     """
-    from ..ops import scoring as S
-    from ..parallel.ring import RingQueryPlacer, build_ring_scorer
-    from ..parallel.sharded import ShardedCorpus
-    from .device_matcher import _CHUNK
+    from ..parallel import dispatch
 
     index = workload.index
-    corpus = getattr(index, "corpus", None)
-    if corpus is None:
+    if getattr(index, "corpus", None) is None:
         raise ValueError(
             "ring re-match needs a device-family backend (device/ann/"
             "sharded); the host backend has no corpus tensors"
         )
+    d = dispatch.current()
+    key = getattr(index, "_dispatch_key", None)
+    if d is not None and key is not None:
+        if mesh is not None and mesh is not getattr(index, "mesh", None):
+            # a custom mesh would compile different collective programs
+            # than the followers' (they use the index mesh) — deadlock,
+            # not divergence, so refuse up front
+            raise ValueError(
+                "ring_rematch(mesh=...) cannot override the serving mesh "
+                "in a multi-host job"
+            )
+        # multi-host: followers replay the device-program side of this
+        # exact run (same block bounds, same escalation decisions from
+        # the gathered counts) so the ring collectives rendezvous
+        with d.op_lock:
+            d.broadcast(("rematch", key, query_block_rows))
+            try:
+                return _ring_rematch_impl(
+                    index, workload.processor, index.schema,
+                    query_block_rows=query_block_rows, mesh=mesh,
+                    finalize=True,
+                )
+            except Exception as e:
+                # the followers were already told to run the full pass; a
+                # frontend abort mid-run (host finalization error) leaves
+                # them ahead on the op stream — refuse further mesh ops
+                # loudly instead of hanging the next one on a desynced
+                # collective (dispatch module invariant 2).
+                # Deterministic pre-device failures raise symmetrically on
+                # the followers too, but distinguishing them from a
+                # mid-run abort is not worth serving a wedged mesh.
+                d.mark_failed(f"frontend rematch aborted mid-run: {e!r}")
+                raise
+    return _ring_rematch_impl(
+        index, workload.processor, index.schema,
+        query_block_rows=query_block_rows, mesh=mesh, finalize=True,
+    )
+
+
+def replay_rematch(index, processor, query_block_rows=None) -> None:
+    """Follower-side replay (parallel.dispatch op ``rematch``): the same
+    device-program sequence with host finalization off."""
+    _ring_rematch_impl(index, processor, index.schema,
+                       query_block_rows=query_block_rows,
+                       mesh=getattr(index, "mesh", None), finalize=False)
+
+
+def _ring_rematch_impl(index, processor, schema, *, query_block_rows,
+                       mesh, finalize: bool) -> Dict:
+    from ..parallel.ring import RingQueryPlacer, build_ring_scorer
+    from ..parallel.sharded import ShardedCorpus
+    from .device_matcher import _CHUNK
+
+    corpus = index.corpus
     if mesh is None:
         mesh = getattr(index, "mesh", None)
     if mesh is None:
@@ -66,7 +142,6 @@ def ring_rematch(workload, *, query_block_rows: Optional[int] = None,
 
         mesh = serving_mesh()
 
-    processor = workload.processor
     group_filtering = processor.group_filtering
     plan = index.plan
     t0 = time.perf_counter()
@@ -109,11 +184,11 @@ def ring_rematch(workload, *, query_block_rows: Optional[int] = None,
             )
         return scorers[k]
 
-    listeners = processor.listeners
+    listeners = processor.listeners if finalize else []
     for listener in listeners:
         listener.batch_ready(n)
-    threshold = workload.config.duke.threshold
-    maybe = workload.config.duke.maybe_threshold
+    threshold = schema.threshold
+    maybe = schema.maybe_threshold
     row_ids = corpus.row_ids
     records = index.records
 
@@ -136,17 +211,25 @@ def ring_rematch(workload, *, query_block_rows: Optional[int] = None,
                     rqf, sfeats, svalid, sdeleted, sgroup, rqg, rqr,
                     jnp.float32(min_logit),
                 )
-                cnt_np = np.asarray(cnt)[: rows.size]
-                cmax = int(cnt_np.max(initial=0))
+                # only cnt drives the widening decision — gather it alone
+                # per iteration (tl/ti would be megabytes of discarded
+                # cross-host transfer per widening step); every process
+                # runs this same sequence, so the collective order is
+                # identical (parallel/dispatch.py invariant 2)
+                cnt_np = _gather(cnt)
+                cmax = int(cnt_np[: rows.size].max(initial=0))
                 if cmax <= k or k >= placer.padded_capacity(size):
                     break
                 k = min(k * 2, placer.padded_capacity(size))
                 logger.info("ring escalation: %d at the bound, width=%d",
                             cmax, k)
-            top_logit = np.asarray(tl)[: rows.size]
-            top_index = np.asarray(ti)[: rows.size]
+            top_logit, top_index = _gather((tl, ti))
+            top_logit = top_logit[: rows.size]
+            top_index = top_index[: rows.size]
             stats["pairs_ranked"] += int(rows.size) * n
 
+            if not finalize:
+                continue
             # host finalization: each unordered pair is ranked from both
             # sides; keep the (qrow < crow) orientation so events emit once
             for qi in range(rows.size):

@@ -44,9 +44,10 @@ path never rides the coordinator).  A dead follower surfaces as a hung
 collective, the standard JAX multi-controller failure mode; the service
 logs the follower set at startup so operators can correlate.
 
-Not supported in multi-host mode (clear errors, see service/app.py):
-``POST /{kind}/{name}/rematch`` — the ring layout's query-sharded result
-fetch needs a cross-host gather that is not wired yet.
+Every REST operation is supported multi-host, including the ring
+re-match (r4): its query-sharded outputs materialize through
+``process_allgather`` — a collective the follower replay enters in
+lockstep (engine/rematch.py).
 """
 
 from __future__ import annotations
@@ -271,6 +272,17 @@ class Dispatcher:
                         f"multi-host dispatch broadcast failed: {e}"
                     ) from e
 
+    def mark_failed(self, reason: str) -> None:
+        """Latch the dispatcher down after an op-stream desync the sender
+        detected OUTSIDE broadcast() (e.g. the frontend aborted mid-run
+        after telling followers to run a full pass): every further mesh
+        op raises instead of hanging on a desynced collective."""
+        if self._failed is None:
+            self._failed = reason
+            logger.error(
+                "dispatch: halting mesh ops (%s) — restart the job", reason
+            )
+
     def on_reload(self, sc, new_dedups: Dict, new_linkages: Dict) -> None:
         """Called by DukeApp.apply_config after building the replacement
         workloads (old locks held, nothing in flight): re-tags the new
@@ -457,12 +469,34 @@ def follower_main(poll_timeout_ms: int = None) -> None:
             elif tag == "commit":
                 _, key, records = op
                 replica = replicas[key]
-                for record in records:
-                    replica.index.index(record)
-                replica.index.commit()
+                try:
+                    for record in records:
+                        replica.index.index(record)
+                    replica.index.commit()
+                except Exception:
+                    # deterministic engine errors raise SYMMETRICALLY on
+                    # the frontend (same code, same inputs), so surviving
+                    # them keeps the mirrors consistent; dying here would
+                    # let one bad request wedge the whole mesh.  An
+                    # asymmetric (hardware) failure resurfaces on the next
+                    # op and the job restarts per the module's stance.
+                    logger.exception("follower: commit replay failed")
             elif tag == "score":
                 _, key, records = op
-                replicas[key].processor.score(records)
+                try:
+                    replicas[key].processor.score(records)
+                except Exception:
+                    logger.exception("follower: score replay failed")
+            elif tag == "rematch":
+                _, key, block_rows = op
+                from ..engine.rematch import replay_rematch
+
+                replica = replicas[key]
+                try:
+                    replay_rematch(replica.index, replica.processor._proc,
+                                   query_block_rows=block_rows)
+                except Exception:
+                    logger.exception("follower: rematch replay failed")
             elif tag == "shutdown":
                 logger.info("follower: shutdown op received; exiting")
                 return

@@ -576,17 +576,7 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                 f"in the configuration)",
             )
         from ..engine.rematch import ring_rematch
-        from ..parallel import dispatch
 
-        if dispatch.current() is not None:
-            # the ring layout's query-sharded result fetch needs a
-            # cross-host gather that is not wired into the follower op
-            # stream yet (parallel/dispatch.py module docs)
-            raise _HttpError(
-                501,
-                "Ring re-match is not yet supported in multi-host serving; "
-                "run it from a single-host mesh deployment.",
-            )
         with workload.lock:
             if workload.closed:
                 raise _HttpError(503, _BUSY_TEMPLATE.format(kind=label))
