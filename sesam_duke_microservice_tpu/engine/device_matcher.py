@@ -497,29 +497,34 @@ class DeviceIndex(CandidateIndex):
         # the identical mutation (parallel.dispatch invariant 1).  The key
         # is tagged by the dispatcher on the frontend only; followers and
         # single-process runs skip.
-        key = getattr(self, "_dispatch_key", None)
-        if key is not None:
-            from ..parallel import dispatch
+        from ..parallel import dispatch
 
-            d = dispatch.current()
-            if d is not None:
-                d.broadcast(("commit", key, pending))
-        # last write per ID wins within a batch (Duke re-index semantics)
-        by_id: Dict[str, Record] = {}
-        for r in pending:
-            by_id[r.record_id] = r
-        records = list(by_id.values())
-        # capture pre-batch liveness BEFORE any value-slot rebuild: a lazy
-        # rebuild streams record state from the STORE, which the workload
-        # already updated with this batch — rows rebuilt from it reflect
-        # the new state, so liveness read after the rebuild would be wrong
-        old_live = self._old_liveness(records)
-        self._maybe_grow_value_slots(records)
-        for r in records:
-            old = self.id_to_row.get(r.record_id)
-            if old is not None:
-                self.corpus.tombstone(old)
-        self._append_records(records, old_live=old_live)
+        key = getattr(self, "_dispatch_key", None)
+        d = dispatch.current() if key is not None else None
+        if d is not None:
+            d.broadcast(("commit", key, pending))
+        # once broadcast, a local failure leaves followers one commit
+        # AHEAD (permanent mirror divergence) — latch before propagating
+        with dispatch.latch_on_failure(
+            d, "frontend commit failed after broadcast"
+        ):
+            # last write per ID wins within a batch (Duke re-index semantics)
+            by_id: Dict[str, Record] = {}
+            for r in pending:
+                by_id[r.record_id] = r
+            records = list(by_id.values())
+            # capture pre-batch liveness BEFORE any value-slot rebuild: a
+            # lazy rebuild streams record state from the STORE, which the
+            # workload already updated with this batch — rows rebuilt from
+            # it reflect the new state, so liveness read after the rebuild
+            # would be wrong
+            old_live = self._old_liveness(records)
+            self._maybe_grow_value_slots(records)
+            for r in records:
+                old = self.id_to_row.get(r.record_id)
+                if old is not None:
+                    self.corpus.tombstone(old)
+            self._append_records(records, old_live=old_live)
 
     def _append_rows_only(self, records: Sequence[Record]) -> np.ndarray:
         """Extract + corpus append + row mapping — no record-mirror, hash,
@@ -1072,10 +1077,15 @@ class DeviceIndex(CandidateIndex):
 
         def _upload():
             try:
-                with self.corpus._upload_lock:
-                    feats, valid, deleted, group = (
-                        self.corpus._device_arrays_locked()
-                    )
+                # MUST go through the retrying entry point: writers run
+                # under the workload lock, which this thread is outside of,
+                # so the generation check in device_arrays() is the only
+                # guard against a commit/tombstone landing mid-upload and
+                # having its dirty flags consumed against torn reads (a
+                # direct _device_arrays_locked() call here could clear
+                # _pending_update/_dirty_* for rows it never uploaded,
+                # silently hiding committed rows from scoring)
+                feats, valid, deleted, group = self.corpus.device_arrays()
                 # block on completion INSIDE the thread so the upload is
                 # actually done (not merely enqueued) before we log
                 import jax
@@ -1573,15 +1583,19 @@ class DeviceProcessor:
         # same query records (the corpus mutation already broadcast from
         # commit()); must precede _score_blocks so every process enqueues
         # the block programs in the same global order
+        from ..parallel import dispatch
+
         key = getattr(self.database, "_dispatch_key", None)
-        if key is not None:
-            from ..parallel import dispatch
-
-            d = dispatch.current()
-            if d is not None:
-                d.broadcast(("score", key, list(records)))
-
-        self._score_blocks(records)
+        d = dispatch.current() if key is not None else None
+        if d is not None:
+            d.broadcast(("score", key, list(records)))
+        # a frontend that aborts mid-pass (listener exception, OOM) has
+        # entered fewer collective programs than the followers it just
+        # instructed — latch before propagating (advisor r4 medium)
+        with dispatch.latch_on_failure(
+            d, "frontend scoring pass aborted after broadcast"
+        ):
+            self._score_blocks(records)
 
         self.stats.batches += 1
         for listener in self.listeners:

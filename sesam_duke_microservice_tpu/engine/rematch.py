@@ -95,25 +95,20 @@ def ring_rematch(workload, *, query_block_rows: Optional[int] = None,
         # multi-host: followers replay the device-program side of this
         # exact run (same block bounds, same escalation decisions from
         # the gathered counts) so the ring collectives rendezvous
+        # Deterministic pre-device failures raise symmetrically on the
+        # followers too, but distinguishing them from a mid-run abort is
+        # not worth serving a wedged mesh — latch on any failure
+        # (dispatch.latch_on_failure, shared with commit/score).
         with d.op_lock:
             d.broadcast(("rematch", key, query_block_rows))
-            try:
+            with dispatch.latch_on_failure(
+                d, "frontend rematch aborted mid-run"
+            ):
                 return _ring_rematch_impl(
                     index, workload.processor, index.schema,
                     query_block_rows=query_block_rows, mesh=mesh,
                     finalize=True,
                 )
-            except Exception as e:
-                # the followers were already told to run the full pass; a
-                # frontend abort mid-run (host finalization error) leaves
-                # them ahead on the op stream — refuse further mesh ops
-                # loudly instead of hanging the next one on a desynced
-                # collective (dispatch module invariant 2).
-                # Deterministic pre-device failures raise symmetrically on
-                # the followers too, but distinguishing them from a
-                # mid-run abort is not worth serving a wedged mesh.
-                d.mark_failed(f"frontend rematch aborted mid-run: {e!r}")
-                raise
     return _ring_rematch_impl(
         index, workload.processor, index.schema,
         query_block_rows=query_block_rows, mesh=mesh, finalize=True,

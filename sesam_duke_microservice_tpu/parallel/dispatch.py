@@ -38,9 +38,10 @@ Correctness rests on two invariants:
      loop structure via ``_score_blocks``).
 
 The op channel is a plain length-prefixed-pickle TCP stream from the
-frontend to each follower; the frontend's address is published through
-the jax.distributed coordination KV store (rendezvous only — the data
-path never rides the coordinator).  A dead follower surfaces as a hung
+frontend to each follower, opened only after a fixed-format raw-bytes
+join handshake (no pickle ever touches unauthenticated bytes); the
+frontend's address is published through the jax.distributed coordination
+KV store (rendezvous only — the data path never rides the coordinator).  A dead follower surfaces as a hung
 collective, the standard JAX multi-controller failure mode; the service
 logs the follower set at startup so operators can correlate.
 
@@ -77,7 +78,54 @@ def current() -> Optional["Dispatcher"]:
     return _DISPATCHER
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def latch_on_failure(d: Optional["Dispatcher"], reason_prefix: str):
+    """THE post-broadcast execution guard: once an op has been broadcast,
+    a frontend that fails to execute it locally leaves followers ahead on
+    the op stream (mirror divergence, or un-matched collective programs)
+    — so any exception latches the dispatcher before propagating, and
+    every further mesh op refuses loudly instead of hanging a desynced
+    collective.  ``d=None`` (single-process) passes exceptions through
+    untouched.  One helper, used by every broadcast site (commit / score
+    / rematch), so the invariant cannot drift between them."""
+    if d is None:
+        yield
+        return
+    try:
+        yield
+    except BaseException as e:
+        d.mark_failed(f"{reason_prefix}: {e!r}")
+        raise
+
+
 # -- wire format -------------------------------------------------------------
+
+# Join handshake: a FIXED-FORMAT raw-bytes frame — magic + sha256 hexdigest
+# of the join token — sent by the follower before anything else.  The
+# frontend authenticates this frame with hmac.compare_digest BEFORE any
+# pickle ever touches bytes from the socket: unpickling attacker bytes is
+# arbitrary code execution, so the pickle op stream begins strictly after
+# authentication (advisor r4).  Hashing the token keeps the frame
+# fixed-length for any operator-chosen DUKE_DISPATCH_TOKEN.
+_HELLO_MAGIC = b"SDMT1"
+_HELLO_LEN = len(_HELLO_MAGIC) + 64  # magic + sha256 hexdigest (ascii)
+
+
+def _hello_frame(token: str) -> bytes:
+    import hashlib
+
+    return _HELLO_MAGIC + hashlib.sha256(token.encode()).hexdigest().encode()
+
+
+def _join_token() -> Optional[str]:
+    """Operator-provided pre-shared secret, if any.  Set on BOTH sides it
+    replaces the per-run random token, which is what makes the
+    DUKE_DISPATCH_ADDR rendezvous bypass actually usable (a follower
+    outside the coordination service can never learn a random token)."""
+    return os.environ.get("DUKE_DISPATCH_TOKEN") or None
 
 
 def _send_msg(sock: socket.socket, obj) -> None:
@@ -183,24 +231,51 @@ class Dispatcher:
         actual_port = self._server.getsockname()[1]
         if advertise is None:
             advertise = socket.gethostname()
-        # join token: published only through the coordination-service KV
-        # store, so a follower slot requires coordination-service access —
-        # an arbitrary process that can reach the TCP port cannot claim a
-        # slot (and receive the bootstrap's record payload) or starve the
-        # real followers out of theirs
-        token = secrets.token_hex(16)
+        # join token: a pre-shared DUKE_DISPATCH_TOKEN when the operator
+        # set one, else per-run random, published only through the
+        # coordination-service KV store — so a follower slot requires the
+        # secret or coordination-service access; an arbitrary process that
+        # can reach the TCP port cannot claim a slot (and receive the
+        # bootstrap's record payload) or starve the real followers out of
+        # theirs.  The handshake is raw bytes (_hello_frame): nothing from
+        # an unauthenticated socket is ever unpickled.
+        psk = _join_token()
+        token = psk or secrets.token_hex(16)
         addr = f"{advertise}:{actual_port}"
-        _kv_client().key_value_set(_KV_ADDR_KEY, f"{addr}/{token}")
+        # a pre-shared secret is long-lived (reused across runs), so it
+        # must never widen into the KV store's trust boundary — publish
+        # the address alone and let followers supply the secret from
+        # their own env (a per-run random token, by contrast, is exactly
+        # the thing the KV rendezvous exists to distribute)
+        _kv_client().key_value_set(
+            _KV_ADDR_KEY, addr if psk else f"{addr}/{token}"
+        )
         logger.info(
             "dispatch: waiting for %d follower(s) on %s", n_followers, addr
         )
+        self._accept_followers(n_followers, token)
+        self._tag_workloads(self.app.deduplications, self.app.record_linkages)
+        self._bootstrap_followers()
+        global _DISPATCHER
+        _DISPATCHER = self
+
+    def _accept_followers(self, n_followers: int, token: str) -> None:
+        """Accept exactly ``n_followers`` authenticated connections.
+
+        Authentication reads a FIXED-LENGTH raw frame and compares it in
+        constant time — pickle.loads never sees bytes from a socket that
+        has not presented the join token (unpickling attacker-controlled
+        bytes is arbitrary code execution, advisor r4 high)."""
+        import hmac
+
+        expected_hello = _hello_frame(token)
         self._server.settimeout(_CONNECT_TIMEOUT_S)
         while len(self._conns) < n_followers:
             conn, peer = self._server.accept()
             try:
                 conn.settimeout(30.0)
-                hello = _recv_msg(conn)
-                if hello != ("hello", token):
+                hello = _recv_exact(conn, _HELLO_LEN)
+                if not hmac.compare_digest(hello, expected_hello):
                     raise ValueError("bad join token")
                 conn.settimeout(None)
             except Exception as e:
@@ -212,7 +287,8 @@ class Dispatcher:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._conns.append(conn)
             logger.info("dispatch: follower connected from %s", peer)
-        self._tag_workloads(self.app.deduplications, self.app.record_linkages)
+
+    def _bootstrap_followers(self) -> None:
         self.broadcast((
             "bootstrap",
             self.app.backend,
@@ -220,8 +296,6 @@ class Dispatcher:
             self._capture_states(),
             _env_fingerprint(),
         ))
-        global _DISPATCHER
-        _DISPATCHER = self
 
     def close(self) -> None:
         global _DISPATCHER
@@ -419,16 +493,29 @@ def follower_main(poll_timeout_ms: int = None) -> None:
 
     enable_persistent_cache()
     addr = os.environ.get("DUKE_DISPATCH_ADDR")
+    via_addr_env = addr is not None
     if addr is None:
         timeout = poll_timeout_ms or int(_CONNECT_TIMEOUT_S * 1000)
         addr = _kv_client().blocking_key_value_get(_KV_ADDR_KEY, timeout)
     addr, _, token = addr.partition("/")
+    # a pre-shared secret wins over the KV-published token; it is also the
+    # ONLY way the DUKE_DISPATCH_ADDR bypass can authenticate (a follower
+    # configured by address alone never sees the frontend's random token)
+    token = _join_token() or token
+    if not token:
+        raise RuntimeError(
+            "no join token is available — set DUKE_DISPATCH_TOKEN on this "
+            "follower"
+            + (" (required with DUKE_DISPATCH_ADDR)" if via_addr_env else
+               " (the frontend published a bare address, meaning it runs "
+               "with DUKE_DISPATCH_TOKEN set)")
+        )
     host, _, port = addr.rpartition(":")
     logger.info("follower: connecting to dispatch stream at %s", addr)
     sock = socket.create_connection((host, int(port)),
                                     timeout=_CONNECT_TIMEOUT_S)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-    _send_msg(sock, ("hello", token))  # join token (Dispatcher.start)
+    sock.sendall(_hello_frame(token))  # raw-bytes join (Dispatcher.start)
     sock.settimeout(None)  # ops arrive whenever the frontend has work
 
     replicas: Dict[Tuple[str, str], _Replica] = {}

@@ -1,0 +1,188 @@
+"""Dispatch join-handshake hardening (advisor r4).
+
+The frontend must authenticate a fixed-format raw-bytes frame BEFORE any
+pickle touches socket bytes: unpickling attacker-controlled bytes is
+arbitrary code execution.  These tests drive ``Dispatcher._accept_followers``
+directly over loopback sockets — no jax.distributed job needed.
+"""
+
+import pickle
+import socket
+import struct
+import threading
+
+import pytest
+
+from sesam_duke_microservice_tpu.parallel import dispatch
+
+
+PWNED = {"hit": False}
+
+
+def _set_pwned():
+    PWNED["hit"] = True
+    return ()
+
+
+class _Evil:
+    """Pickle payload that executes on load (the pre-fix attack shape)."""
+
+    def __reduce__(self):
+        return (_set_pwned, ())
+
+
+def _accept_in_thread(n, token):
+    d = dispatch.Dispatcher(app=None)
+    d._server = socket.create_server(("127.0.0.1", 0))
+    port = d._server.getsockname()[1]
+    t = threading.Thread(
+        target=d._accept_followers, args=(n, token), daemon=True
+    )
+    t.start()
+    return d, port, t
+
+
+def test_crafted_pickle_rejected_without_execution(monkeypatch):
+    monkeypatch.setattr(dispatch, "_CONNECT_TIMEOUT_S", 10.0)
+    PWNED["hit"] = False
+    d, port, t = _accept_in_thread(1, "secret-token")
+    try:
+        # attacker: the old wire format — length-prefixed pickle hello.
+        # With the raw handshake this must neither authenticate nor ever
+        # reach pickle.loads.
+        evil = pickle.dumps(("hello", _Evil()))
+        attacker = socket.create_connection(("127.0.0.1", port), timeout=5)
+        attacker.sendall(struct.pack(">Q", len(evil)) + evil)
+        # half-close so the server's fixed-length read sees EOF even when
+        # the crafted frame is shorter than _HELLO_LEN
+        attacker.shutdown(socket.SHUT_WR)
+        # server should reject; our read then sees EOF
+        attacker.settimeout(5)
+        assert attacker.recv(1) == b""
+        attacker.close()
+        assert not PWNED["hit"], "crafted pickle was executed before auth"
+        assert d._conns == []
+        # the real follower still gets its slot afterwards
+        good = socket.create_connection(("127.0.0.1", port), timeout=5)
+        good.sendall(dispatch._hello_frame("secret-token"))
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert len(d._conns) == 1
+        good.close()
+    finally:
+        d._server.close()
+        for c in d._conns:
+            c.close()
+
+
+def test_wrong_token_rejected_right_token_accepted(monkeypatch):
+    monkeypatch.setattr(dispatch, "_CONNECT_TIMEOUT_S", 10.0)
+    d, port, t = _accept_in_thread(1, "right")
+    try:
+        bad = socket.create_connection(("127.0.0.1", port), timeout=5)
+        bad.sendall(dispatch._hello_frame("wrong"))
+        bad.settimeout(5)
+        assert bad.recv(1) == b""  # rejected: server closed the socket
+        bad.close()
+        good = socket.create_connection(("127.0.0.1", port), timeout=5)
+        good.sendall(dispatch._hello_frame("right"))
+        t.join(timeout=10)
+        assert len(d._conns) == 1
+        good.close()
+    finally:
+        d._server.close()
+        for c in d._conns:
+            c.close()
+
+
+class _StubDispatcher:
+    """Records broadcasts + the failure latch (no sockets)."""
+
+    def __init__(self):
+        self.ops = []
+        self.failed = None
+
+    def broadcast(self, op):
+        self.ops.append(op[0])
+
+    def mark_failed(self, reason):
+        self.failed = reason
+
+
+def _tiny_index():
+    from sesam_duke_microservice_tpu.core import comparators as C
+    from sesam_duke_microservice_tpu.core.config import DukeSchema
+    from sesam_duke_microservice_tpu.core.records import (
+        ID_PROPERTY_NAME, Property, Record,
+    )
+    from sesam_duke_microservice_tpu.engine.device_matcher import DeviceIndex
+
+    schema = DukeSchema(
+        threshold=0.8, maybe_threshold=None,
+        properties=[
+            Property(ID_PROPERTY_NAME, id_property=True),
+            Property("name", C.Levenshtein(), 0.3, 0.9),
+        ],
+        data_sources=[],
+    )
+    idx = DeviceIndex(schema)
+
+    def rec(rid, name):
+        r = Record()
+        r.add_value(ID_PROPERTY_NAME, rid)
+        r.add_value("name", name)
+        return r
+
+    return idx, schema, rec
+
+
+def test_frontend_commit_failure_latches_dispatcher(monkeypatch):
+    """A frontend that fails to apply a commit it already broadcast must
+    latch the dispatcher (followers are one op ahead — advisor r4)."""
+    idx, _schema, rec = _tiny_index()
+    idx._dispatch_key = ("deduplication", "t")
+    stub = _StubDispatcher()
+    monkeypatch.setattr(dispatch, "_DISPATCHER", stub)
+    idx.index(rec("a", "acme"))
+    monkeypatch.setattr(
+        idx, "_append_records",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")),
+    )
+    with pytest.raises(RuntimeError, match="boom"):
+        idx.commit()
+    assert stub.ops == ["commit"]
+    assert stub.failed is not None and "commit failed" in stub.failed
+
+
+def test_frontend_scoring_abort_latches_dispatcher(monkeypatch):
+    """A frontend scoring pass that aborts after the 'score' broadcast must
+    latch (followers entered collective programs it never will)."""
+    from sesam_duke_microservice_tpu.engine.device_matcher import (
+        DeviceProcessor,
+    )
+
+    idx, schema, rec = _tiny_index()
+    idx._dispatch_key = ("deduplication", "t")
+    stub = _StubDispatcher()
+    monkeypatch.setattr(dispatch, "_DISPATCHER", stub)
+    proc = DeviceProcessor(schema, idx)
+    monkeypatch.setattr(
+        proc, "_score_blocks",
+        lambda records: (_ for _ in ()).throw(RuntimeError("listener died")),
+    )
+    with pytest.raises(RuntimeError, match="listener died"):
+        proc.deduplicate([rec("a", "acme"), rec("b", "acme")])
+    assert stub.ops == ["commit", "score"]
+    assert stub.failed is not None and "scoring pass aborted" in stub.failed
+
+
+def test_preshared_token_env(monkeypatch):
+    """DUKE_DISPATCH_TOKEN is honored on both sides (advisor r4 low: the
+    DUKE_DISPATCH_ADDR bypass needs a pre-shared secret to ever work)."""
+    monkeypatch.setenv("DUKE_DISPATCH_TOKEN", "psk")
+    assert dispatch._join_token() == "psk"
+    monkeypatch.delenv("DUKE_DISPATCH_TOKEN")
+    assert dispatch._join_token() is None
+    # hello frames are fixed-length for any secret length
+    assert len(dispatch._hello_frame("x")) == dispatch._HELLO_LEN
+    assert len(dispatch._hello_frame("x" * 500)) == dispatch._HELLO_LEN
