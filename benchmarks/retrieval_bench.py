@@ -1,0 +1,145 @@
+"""ANN retrieval-scan roofline benchmark: exact vs approx top-C merge.
+
+Isolates the candidate-retrieval stage (``ops.encoder.retrieval_scan``) on
+synthetic embeddings and reports achieved MFU against the v5e bf16 matmul
+roofline plus HBM-bandwidth bound.  This is the stage the r4 verdict
+measured at ~0.4% MFU with the per-step full-sort ``lax.top_k`` merge —
+the TPU analogue of the reference's candidate-search limit, "the single
+biggest influence on search performance"
+(IncrementalLuceneDatabase.java:349-358).
+
+Usage::
+
+    python benchmarks/retrieval_bench.py [--rows 10027008] [--queries 1024]
+        [--top-c 64] [--chunks 16384,65536,131072] [--exact-too]
+
+Prints one JSON line per (mode, chunk) configuration.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+# v5e-1 peak: ~197 TFLOP/s bf16, ~819 GB/s HBM
+V5E_BF16_FLOPS = 197e12
+V5E_HBM_BPS = 819e9
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=10_027_008)
+    ap.add_argument("--queries", type=int, default=1024)
+    ap.add_argument("--dim", type=int, default=256)
+    ap.add_argument("--top-c", type=int, default=64)
+    ap.add_argument("--chunks", type=str, default="16384,65536,131072")
+    ap.add_argument("--segs", type=str, default="64",
+                    help="DEVICE_ANN_SEG values for fused mode")
+    ap.add_argument("--exact-too", action="store_true")
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from sesam_duke_microservice_tpu.ops import encoder as E
+
+    rows, q, dim, c = args.rows, args.queries, args.dim, args.top_c
+    rng = np.random.default_rng(0)
+    # generate in f32 then store bf16 (the corpus-resident dtype)
+    corpus = rng.standard_normal((rows, dim), dtype=np.float32)
+    corpus /= np.linalg.norm(corpus, axis=1, keepdims=True)
+    corpus_emb = jax.device_put(corpus.astype(E.STORAGE_DTYPE))
+    del corpus
+    queries = rng.standard_normal((q, dim), dtype=np.float32)
+    queries /= np.linalg.norm(queries, axis=1, keepdims=True)
+    q_emb = jax.device_put(queries.astype(np.float32))
+
+    cvalid = jax.device_put(np.ones(rows, dtype=bool))
+    cdel = jax.device_put(np.zeros(rows, dtype=bool))
+    cgroup = jax.device_put(np.zeros(rows, dtype=np.int32))
+    qgroup = jax.device_put(np.zeros(q, dtype=np.int32))
+    qrow = jax.device_put(np.full(q, -1, dtype=np.int32))
+
+    flops = 2.0 * q * rows * dim
+    hbm_bytes = rows * dim * 2.0  # bf16 corpus read dominates
+
+    # mode -> (DEVICE_ANN_EXACT_TOPK, DEVICE_ANN_FUSED)
+    modes = [("fused", ("0", "1")), ("approx", ("0", "0"))]
+    if args.exact_too:
+        modes.append(("exact", ("1", "0")))
+
+    def scan_fn(chunk):
+        # arrays ride as jit ARGUMENTS — a zero-arg closure would inline
+        # the multi-GB corpus as an XLA constant and stall compilation
+        @jax.jit
+        def fn(q_emb, corpus_emb, cvalid, cdel, cgroup, qgroup, qrow):
+            return E.retrieval_scan(
+                q_emb, corpus_emb, cvalid, cdel, cgroup, qgroup, qrow,
+                chunk=chunk, top_c=c, group_filtering=False,
+            )
+
+        return fn
+
+    # exact reference for recall measurement
+    os.environ["DEVICE_ANN_EXACT_TOPK"] = "1"
+    ref_sim, ref_idx = jax.block_until_ready(scan_fn(16384)(
+        q_emb, corpus_emb, cvalid, cdel, cgroup, qgroup, qrow
+    ))
+    ref_sets = [set(np.asarray(r).tolist()) - {-1} for r in np.asarray(ref_idx)]
+
+    def run_one(mode, chunk, seg):
+        if rows % chunk:
+            return
+        os.environ["DEVICE_ANN_RETRIEVAL_CHUNK"] = str(chunk)
+        fn = scan_fn(chunk)
+        sim, idx = jax.block_until_ready(fn(
+            q_emb, corpus_emb, cvalid, cdel, cgroup, qgroup, qrow
+        ))  # compile
+        times = []
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(
+                q_emb, corpus_emb, cvalid, cdel, cgroup, qgroup, qrow
+            ))
+            times.append(time.perf_counter() - t0)
+        t = float(np.median(times))
+        got = np.asarray(idx)
+        recall = float(np.mean([
+            len(ref_sets[i] & (set(got[i].tolist()) - {-1}))
+            / max(1, len(ref_sets[i]))
+            for i in range(q)
+        ]))
+        print(json.dumps({
+            "mode": mode, "chunk": chunk, "seg": seg, "rows": rows,
+            "queries": q, "top_c": c, "seconds": round(t, 4),
+            "mfu": round(flops / t / V5E_BF16_FLOPS, 4),
+            "hbm_frac": round(hbm_bytes / t / V5E_HBM_BPS, 4),
+            "recall_vs_exact": round(recall, 4),
+            "pairs_per_sec": round(q * rows / t, 1),
+        }), flush=True)
+
+    chunks = [int(x) for x in args.chunks.split(",")]
+    for mode, (exact_flag, fused_flag) in modes:
+        os.environ["DEVICE_ANN_EXACT_TOPK"] = exact_flag
+        os.environ["DEVICE_ANN_FUSED"] = fused_flag
+        if mode == "fused":
+            # the fused kernel tiles internally; chunk is moot — sweep
+            # the recall knob (segment width) instead
+            for seg in (int(s) for s in args.segs.split(",")):
+                os.environ["DEVICE_ANN_SEG"] = str(seg)
+                run_one(mode, chunks[0], seg)
+        else:
+            for chunk in chunks:
+                run_one(mode, chunk, None)
+
+
+if __name__ == "__main__":
+    main()

@@ -180,6 +180,90 @@ class RecordEncoder:
         )
 
 
+def _fused_retrieval(q_emb, corpus_emb, corpus_valid, corpus_deleted,
+                     corpus_group, query_group, query_row, *,
+                     top_c: int, group_filtering: bool, row_offset,
+                     recall_target: float):
+    """The Pallas fast path of ``retrieval_scan``: fused matmul + mask +
+    segment-max in VMEM (ops.pallas_kernels.retrieval_segmax), then an
+    approximate top-C over the SEG-x-smaller segment winners.  Returns
+    (top_sim, top_idx) or None when the shapes don't fit the kernel
+    (caller falls back to the XLA scan)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from . import pallas_kernels as pk
+
+    n, d = corpus_emb.shape
+    q = q_emb.shape[0]
+    seg = int(os.environ.get("DEVICE_ANN_SEG", "64"))
+    if d % 128 != 0 or seg <= 0 or seg & (seg - 1) or n < 2 * seg:
+        return None
+    # corpus tile: sized so the (TC, QP) f32 score tile stays ~<=8 MB of
+    # VMEM; a power of two >= 1024 (the mask operand's (TC, 128) int8
+    # block needs TC/128 >= 8 sublanes) that divides the capacity
+    qp = -(-q // 128) * 128
+    tc = n & -n  # largest power-of-2 divisor of the capacity
+    tc = min(tc, 2048, (1 << 21) // qp)  # tc*qp*4B <= 8 MB of VMEM
+    nbins = n // seg
+    # nbins >= 4*top_c: an escalated C that approaches the bin count means
+    # the query saturated its candidate budget — drop to the (adjacency-
+    # safe, exact-per-bin-free) approx scan rather than retrieve whole
+    # bins.  Duplicate clusters wider than a tile's stride (tc/seg) also
+    # resolve there via count saturation -> escalation -> this fallback.
+    if tc < max(1024, seg * 8) or n % tc or nbins < 4 * top_c:
+        return None
+
+    if qp != q:
+        pad = qp - q
+        q_emb = jnp.pad(q_emb, ((0, pad), (0, 0)))
+        # padded queries: no self-row; their outputs are sliced away
+        # below, so their group value only needs to be well-formed (it
+        # clips to -1, the dedup no-group encoding)
+        query_row = jnp.pad(query_row, (0, pad), constant_values=-1)
+        query_group = jnp.pad(query_group, (0, pad),
+                              constant_values=-1)
+
+    qT = q_emb.astype(jnp.bfloat16).T
+    # Encoded int8 mask broadcast across a 128-lane axis — tile-native,
+    # where an (N, 1) int32 column operand would T(8,128)-pad 128x into
+    # a multi-GB temp at the flagship scale (see pk.GROUP_OFFSET note).
+    # POLICY: this encodes exactly scoring.candidate_mask (the one-place
+    # eligibility policy — keep the two in sync): live & not tombstoned,
+    # group exclusion, self-row exclusion.  int8 range is safe because
+    # group ids are the <group> element ordinals 1..2 (core/config.py
+    # enforces exactly two groups) or -1; both sides clip identically so
+    # the compare could only coarsen together, never diverge.
+    live = corpus_valid & ~corpus_deleted
+    enc_col = jnp.where(
+        live,
+        (jnp.clip(corpus_group, -1, 100)
+         + jnp.int32(pk.GROUP_OFFSET)).astype(jnp.int8),
+        jnp.int8(0),
+    )
+    enc = jnp.broadcast_to(enc_col[:, None], (n, 128))
+    # the kernel masks in LOCAL row coordinates; shift the query's own
+    # global row down (negative stays impossible-to-match)
+    qrow_local = (query_row - row_offset)[None, :].astype(jnp.int32)
+    qgroup_enc = (jnp.clip(query_group, -1, 100)
+                  + pk.GROUP_OFFSET)[None, :].astype(jnp.int32)
+
+    seg_max, seg_arg = pk.retrieval_segmax(
+        qT, corpus_emb.astype(jnp.bfloat16), enc, qrow_local,
+        qgroup_enc, tc=tc, seg=seg, group_filtering=group_filtering,
+    )
+    smax = seg_max.T[:q]                                  # (Q, nbins)
+    sarg = seg_arg.T[:q]
+    top_sim, bin_sel = lax.approx_max_k(
+        smax, top_c, recall_target=recall_target
+    )
+    local = jnp.take_along_axis(sarg, bin_sel, axis=1)
+    top_idx = jnp.where(
+        top_sim < jnp.float32(-1e30), jnp.int32(-1), local + row_offset
+    )
+    return top_sim, top_idx
+
+
 def retrieval_scan(q_emb, corpus_emb, corpus_valid, corpus_deleted,
                    corpus_group, query_group, query_row, *,
                    chunk: int, top_c: int, group_filtering: bool,
@@ -192,13 +276,29 @@ def retrieval_scan(q_emb, corpus_emb, corpus_valid, corpus_deleted,
     row indices (``row_offset`` as in scan_topk for sharded use).
 
     The scan chunk is widened to ``DEVICE_ANN_RETRIEVAL_CHUNK`` (default
-    16384, measured optimum at 1M rows on v5e: 3.45 s -> 2.19 s per
-    1024-query batch) when the corpus allows: the matmul is so cheap per
-    row that per-step overhead (top_k merge, scan bookkeeping) dominates
-    with small chunks.  Capacities are power-of-2 multiples of the base
-    chunk, so any power-of-2 widening divides evenly.
+    65536; see BASELINE.md r5 retrieval table) when the corpus allows:
+    the matmul is so cheap per row that per-step overhead (top-C merge,
+    scan bookkeeping) dominates with small chunks.  Capacities are
+    power-of-2 multiples of the base chunk, so any power-of-2 widening
+    divides evenly.
+
+    Per-chunk top-C uses ``lax.approx_max_k`` — the TPU-native
+    PartialReduce op (Chern et al. 2022): instead of fully sorting the
+    (Q, chunk) similarity tile each step (a vector-unit sort that left
+    the r4 scan ~0.4% MFU, two orders of magnitude off the matmul+HBM
+    roofline), the chunk is reduced bin-wise to ~C survivors at a
+    configurable expected recall, and only the (Q, 2C) merge with the
+    running carry is sorted exactly.  Recall loss only ever shrinks the
+    candidate *set* (never corrupts a score — candidates are rescored
+    exactly either way), the escalation loop still widens C on
+    saturation, and ``DEVICE_ANN_RECALL_TARGET`` / ``DEVICE_ANN_EXACT_TOPK=1``
+    restore tighter or exact semantics.  This is the TPU answer to the
+    reference's "single biggest influence on search performance" knob —
+    its Lucene candidate-search limits (IncrementalLuceneDatabase.java:
+    349-358 ``max_search_hits``): both trade bounded blocking recall for
+    retrieval speed, and both rescore survivors exactly.
     """
-    wide = int(os.environ.get("DEVICE_ANN_RETRIEVAL_CHUNK", "16384"))
+    wide = int(os.environ.get("DEVICE_ANN_RETRIEVAL_CHUNK", "65536"))
     cap_total = corpus_valid.shape[0]
     while chunk < wide and chunk * 2 <= cap_total and cap_total % (chunk * 2) == 0:
         chunk *= 2
@@ -216,6 +316,33 @@ def retrieval_scan(q_emb, corpus_emb, corpus_valid, corpus_deleted,
     neg = jnp.float32(scoring.NEG_INF)
     init_sim = jnp.full((q, top_c), neg, jnp.float32)
     init_idx = jnp.full((q, top_c), -1, jnp.int32)
+
+    # exact full-sort merge when forced, or when the chunk is so narrow
+    # (escalated C approaching chunk width) that the bin reduction cannot
+    # shrink anything worth the second merge step
+    exact = (
+        os.environ.get("DEVICE_ANN_EXACT_TOPK", "0") == "1"
+        or top_c * 4 >= chunk
+    )
+    recall_target = float(
+        os.environ.get("DEVICE_ANN_RECALL_TARGET", "0.95")
+    )
+
+    from . import pallas_kernels as pk
+
+    if (
+        not exact
+        and os.environ.get("DEVICE_ANN_FUSED", "1") != "0"
+        and pk.pallas_enabled()
+    ):
+        fused = _fused_retrieval(
+            q_emb, corpus_emb, corpus_valid, corpus_deleted, corpus_group,
+            query_group, query_row, top_c=top_c,
+            group_filtering=group_filtering, row_offset=row_offset,
+            recall_target=recall_target,
+        )
+        if fused is not None:
+            return fused
 
     def body(carry, ci):
         top_sim, top_idx = carry
@@ -238,10 +365,26 @@ def retrieval_scan(q_emb, corpus_emb, corpus_valid, corpus_deleted,
         )
         sims = jnp.where(mask, sims, neg)
 
-        merged_sim = jnp.concatenate([top_sim, sims], axis=1)
-        merged_idx = jnp.concatenate(
-            [top_idx, jnp.broadcast_to(cidx[None, :], (q, chunk))], axis=1
-        )
+        if exact:
+            merged_sim = jnp.concatenate([top_sim, sims], axis=1)
+            merged_idx = jnp.concatenate(
+                [top_idx, jnp.broadcast_to(cidx[None, :], (q, chunk))],
+                axis=1,
+            )
+        else:
+            chunk_sim, chunk_arg = lax.approx_max_k(
+                sims, top_c, recall_target=recall_target
+            )
+            merged_sim = jnp.concatenate([top_sim, chunk_sim], axis=1)
+            # carry entries come FIRST in the concat: lax.top_k breaks
+            # ties by position, so all-masked (NEG_INF) chunk survivors
+            # can never displace the carry's -1 "empty slot" sentinels —
+            # the invariant build_ann_scorer's `retrieved` mask rests on
+            merged_idx = jnp.concatenate(
+                [top_idx,
+                 row_offset + start + chunk_arg.astype(jnp.int32)],
+                axis=1,
+            )
         top_sim, sel = lax.top_k(merged_sim, top_c)
         top_idx = jnp.take_along_axis(merged_idx, sel, axis=1)
         return (top_sim, top_idx), None

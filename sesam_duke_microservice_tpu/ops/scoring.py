@@ -316,6 +316,12 @@ def candidate_mask(cvalid, cdeleted, cgroup, cidx, query_group, query_row,
     live non-tombstoned rows only; linkage excludes same-group rows
     (IncrementalLuceneDatabase.java:467-475); a query never matches its own
     corpus row.
+
+    One other site encodes this same policy and must stay in sync: the
+    fused Pallas retrieval mask (ops.encoder._fused_retrieval /
+    ops.pallas_kernels._retrieval_segmax_kernel), which packs it into an
+    int8 per-row encoding because a Mosaic kernel cannot consume the
+    boolean columns directly.
     """
     mask = cvalid & ~cdeleted
     if group_filtering:
