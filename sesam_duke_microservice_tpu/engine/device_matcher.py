@@ -409,6 +409,17 @@ class DeviceIndex(CandidateIndex):
 
         self._content_hash = EMPTY_CONTENT_HASH
         self._store_synced_hash: Optional[str] = None
+        # multi-host mirror-consistency digest: a sha256 CHAIN over every
+        # committed batch (record content + assigned row), maintained by
+        # the shared commit() path so frontend and follower replicas fold
+        # identically when — and only when — they applied the same
+        # mutations in the same order with the same row layout.  Chained
+        # (not XOR-folded) on purpose: a missed or doubled batch must
+        # change the digest, not cancel out.  Compared frontend-vs-
+        # follower after every multi-host commit (parallel.dispatch
+        # digest handshake); orthogonal to _content_hash, which guards
+        # snapshot/store staleness across restarts.
+        self._mirror_digest = EMPTY_CONTENT_HASH
         # O(1) live count (non-dukeDeleted records) for /stats — counting
         # by iterating ``records`` would need the workload lock for the
         # whole scan (seconds at 10M rows)
@@ -525,6 +536,32 @@ class DeviceIndex(CandidateIndex):
                 if old is not None:
                     self.corpus.tombstone(old)
             self._append_records(records, old_live=old_live)
+            self._fold_mirror_digest(records)
+        # loud mirror verification (multi-host only): every follower just
+        # replayed this exact batch through this exact code — compare the
+        # resulting chained digests so an asymmetric failure (a swallowed
+        # replay exception, OOM, nondeterminism) halts the job here
+        # instead of hanging a later collective or finalizing wrong links
+        if d is not None:
+            d.verify_mirror_digest(key, self._mirror_digest)
+
+    def _fold_mirror_digest(self, records: Sequence[Record]) -> None:
+        """Chain one committed batch into the mirror-consistency digest:
+        per record, its canonical content digest plus the corpus row it
+        landed on (row layout is what the collective programs actually
+        consume, so layout divergence must change the digest too)."""
+        import hashlib
+        import struct as _struct
+
+        from ..store.records import record_digest
+
+        h = hashlib.sha256(self._mirror_digest)
+        for r in records:
+            h.update(record_digest(r))
+            h.update(_struct.pack(
+                "<q", self.id_to_row.get(r.record_id, -1)
+            ))
+        self._mirror_digest = h.digest()
 
     def _append_rows_only(self, records: Sequence[Record]) -> np.ndarray:
         """Extract + corpus append + row mapping — no record-mirror, hash,

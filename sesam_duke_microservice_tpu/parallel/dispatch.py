@@ -113,6 +113,33 @@ def latch_on_failure(d: Optional["Dispatcher"], reason_prefix: str):
 _HELLO_MAGIC = b"SDMT1"
 _HELLO_LEN = len(_HELLO_MAGIC) + 64  # magic + sha256 hexdigest (ascii)
 
+# Commit digest handshake: after replaying each ("commit", ...) op the
+# follower answers with ONE fixed-format raw frame — magic + ok byte +
+# its 32-byte chained mirror digest (DeviceIndex._mirror_digest) — and
+# the frontend compares against its own before releasing the op lock.
+# This makes asymmetric commit failures (a swallowed replay exception,
+# follower OOM, a nondeterministic bug) halt the job at the very commit
+# that diverged, instead of hanging a later collective or finalizing
+# wrong top-K links off a stale mirror.  Raw bytes, not pickle, so the
+# response path stays as dumb as the hello frame.
+_DIGEST_MAGIC = b"SDMD1"
+_DIGEST_LEN = len(_DIGEST_MAGIC) + 1 + 32
+
+# Streamed bootstrap granularity: snapshot bytes per message / records per
+# message.  Bounds BOTH sides' transient memory (frontend pickle frame,
+# follower assembly) to O(chunk) regardless of corpus scale.
+_SNAP_CHUNK = int(os.environ.get("DUKE_DISPATCH_SNAP_CHUNK", str(16 << 20)))
+_REC_BATCH = int(os.environ.get("DUKE_DISPATCH_REC_BATCH", "2048"))
+
+
+def _digest_frame(ok: bool, digest: bytes) -> bytes:
+    payload = digest if len(digest) == 32 else bytes(32)
+    return _DIGEST_MAGIC + (b"\x01" if ok else b"\x00") + payload
+
+
+def _verify_enabled() -> bool:
+    return os.environ.get("DUKE_DISPATCH_VERIFY", "1") != "0"
+
 
 def _hello_frame(token: str) -> bytes:
     import hashlib
@@ -180,6 +207,14 @@ def _env_fingerprint() -> dict:
         "initial_top_k": DM._INITIAL_TOP_K,
         "ann_dim": os.environ.get("DEVICE_ANN_DIM", "256"),
         "ann_c": os.environ.get("DEVICE_ANN_CANDIDATES", "64"),
+        # retrieval-program knobs: one-sided settings lower DIFFERENT
+        # shard_map programs (fused Pallas kernel vs XLA scan, different
+        # bin/recall shapes) whose cross-host all_gather would deadlock
+        "ann_fused": os.environ.get("DEVICE_ANN_FUSED", "1"),
+        "ann_seg": os.environ.get("DEVICE_ANN_SEG", "64"),
+        "ann_exact": os.environ.get("DEVICE_ANN_EXACT_TOPK", "0"),
+        "ann_recall": os.environ.get("DEVICE_ANN_RECALL_TARGET", "0.95"),
+        "ann_chunk": os.environ.get("DEVICE_ANN_RETRIEVAL_CHUNK", "65536"),
         # every env knob that sizes a feature tensor (ops.features): a
         # mismatch here compiles different-shape programs per process and
         # deadlocks the first cross-host collective
@@ -189,6 +224,10 @@ def _env_fingerprint() -> dict:
         "max_grams": os.environ.get("DEVICE_MAX_GRAMS", ""),
         "max_tokens": os.environ.get("DEVICE_MAX_TOKENS", ""),
         "value_slots": os.environ.get("DEVICE_VALUE_SLOTS", ""),
+        # not shape-relevant, but a one-sided setting deadlocks the
+        # digest handshake (unread frames fill the follower's send
+        # buffer), so enforce agreement at bootstrap
+        "verify": _verify_enabled(),
     }
 
 
@@ -290,12 +329,13 @@ class Dispatcher:
 
     def _bootstrap_followers(self) -> None:
         self.broadcast((
-            "bootstrap",
+            "bootstrap_begin",
             self.app.backend,
             self.app.config_string,
-            self._capture_states(),
             _env_fingerprint(),
         ))
+        self._stream_states(self.app.deduplications, self.app.record_linkages)
+        self.broadcast(("bootstrap_end",))
 
     def close(self) -> None:
         global _DISPATCHER
@@ -346,6 +386,46 @@ class Dispatcher:
                         f"multi-host dispatch broadcast failed: {e}"
                     ) from e
 
+    def verify_mirror_digest(self, key, digest: bytes) -> None:
+        """Read one digest frame per follower for the commit just applied
+        and compare against the frontend's own chained mirror digest
+        (``DeviceIndex._fold_mirror_digest``).  Any mismatch, replay
+        failure, or dead/slow follower latches the dispatcher and raises —
+        mirror divergence is permanent, so serving past it would be
+        silent corruption.  Called with ``op_lock`` held (commit runs
+        inside the broadcast+execute section), so frames can never
+        interleave across commits."""
+        if not _verify_enabled():
+            return
+        for i, conn in enumerate(self._conns):
+            try:
+                conn.settimeout(_CONNECT_TIMEOUT_S)
+                frame = _recv_exact(conn, _DIGEST_LEN)
+            except (OSError, EOFError) as e:
+                self.mark_failed(
+                    f"no commit digest from follower {i} for {key}: {e!r}"
+                )
+                raise RuntimeError(
+                    f"multi-host commit digest handshake failed "
+                    f"(follower {i}): {e}"
+                ) from e
+            finally:
+                try:
+                    conn.settimeout(None)
+                except OSError:
+                    pass
+            ok = frame[: len(_DIGEST_MAGIC)] == _DIGEST_MAGIC and \
+                frame[len(_DIGEST_MAGIC)] == 1
+            theirs = frame[len(_DIGEST_MAGIC) + 1:]
+            if not ok or theirs != digest:
+                reason = (
+                    f"follower {i} mirror diverged on commit for {key}: "
+                    + ("replay failed" if not ok else
+                       f"digest {theirs.hex()} != {digest.hex()}")
+                )
+                self.mark_failed(reason)
+                raise RuntimeError(f"multi-host mirror divergence: {reason}")
+
     def mark_failed(self, reason: str) -> None:
         """Latch the dispatcher down after an op-stream desync the sender
         detected OUTSIDE broadcast() (e.g. the frontend aborted mid-run
@@ -360,10 +440,11 @@ class Dispatcher:
     def on_reload(self, sc, new_dedups: Dict, new_linkages: Dict) -> None:
         """Called by DukeApp.apply_config after building the replacement
         workloads (old locks held, nothing in flight): re-tags the new
-        indexes and ships followers the new config + corpus states."""
+        indexes and streams followers the new config + corpus states."""
         self._tag_workloads(new_dedups, new_linkages)
-        states = self._capture_states(new_dedups, new_linkages)
-        self.broadcast(("reload", self.app.backend, sc.config_string, states))
+        self.broadcast(("reload_begin", self.app.backend, sc.config_string))
+        self._stream_states(new_dedups, new_linkages)
+        self.broadcast(("bootstrap_end",))
 
     # - helpers -
 
@@ -373,39 +454,57 @@ class Dispatcher:
             for name, wl in registry.items():
                 wl.index._dispatch_key = (kind, name)
 
-    def _capture_states(self, dedups=None, linkages=None) -> Dict:
-        dedups = self.app.deduplications if dedups is None else dedups
-        linkages = self.app.record_linkages if linkages is None else linkages
-        states = {}
+    def _stream_states(self, dedups: Dict, linkages: Dict) -> None:
         for kind, registry in (("deduplication", dedups),
                                ("recordlinkage", linkages)):
             for name, wl in registry.items():
-                states[(kind, name)] = _capture_state(wl.index)
-        return states
+                self._stream_state((kind, name), wl.index)
 
-
-def _capture_state(index) -> dict:
-    """Corpus bootstrap payload for one workload: the snapshot wire format
-    (feature tensors, masks, row ids, value-slot widths — row layout
-    preserved exactly, which invariant 1 requires) plus the record mirror
-    the follower needs for value-slot rebuilds and snapshot adoption."""
-    snapshot = None
-    if getattr(index, "corpus", None) is not None and index.corpus.size > 0:
-        fd, tmp = tempfile.mkstemp(suffix=".npz")
-        os.close(fd)
-        try:
-            index.snapshot_save(tmp)
-            with open(tmp, "rb") as f:
-                snapshot = f.read()
-        finally:
+    def _stream_state(self, key, index) -> None:
+        """Stream one workload's corpus bootstrap in O(chunk)-bounded
+        messages: the snapshot wire format file-chunked, the record
+        mirror in batches — never a whole-corpus pickle (the r4 payload
+        was one message holding snapshot bytes + every Record; at the 10M
+        flagship scale that is a ~10+ GB frame).  Bounded-memory resume
+        is the reference's own stance — its restart is an on-disk index
+        open (IncrementalLuceneDatabase.java:233-244)."""
+        has_snapshot = (getattr(index, "corpus", None) is not None
+                        and index.corpus.size > 0)
+        self.broadcast(("state_begin", key, {
+            "has_snapshot": has_snapshot,
+            # followers chain their commit digests from the frontend's
+            # captured point, so the handshake compares equal iff every
+            # post-bootstrap commit applied identically on both sides
+            "mirror_digest": index._mirror_digest,
+        }))
+        if has_snapshot:
+            fd, tmp = tempfile.mkstemp(suffix=".npz")
+            os.close(fd)
             try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-    return {
-        "snapshot": snapshot,
-        "records": list(index.records.values()),
-    }
+                index.snapshot_save(tmp)
+                with open(tmp, "rb") as f:
+                    while True:
+                        chunk = f.read(_SNAP_CHUNK)
+                        if not chunk:
+                            break
+                        self.broadcast(("snap", key, chunk))
+            finally:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+            batch: List = []
+            # LazyRecordMap.values() streams store rows through a bounded
+            # LRU, so this loop holds O(_REC_BATCH) records at the 10M
+            # scale, not the corpus
+            for record in index.records.values():
+                batch.append(record)
+                if len(batch) >= _REC_BATCH:
+                    self.broadcast(("recs", key, batch))
+                    batch = []
+            if batch:
+                self.broadcast(("recs", key, batch))
+        self.broadcast(("state_end", key))
 
 
 # -- follower ----------------------------------------------------------------
@@ -430,10 +529,63 @@ class FollowerProcessor:
         self._proc._score_blocks(records)
 
 
-class _Replica:
-    """One workload's follower-side state: sharded index + processor."""
+class _StateAssembly:
+    """Follower-side accumulator for one workload's streamed bootstrap:
+    snapshot chunks append to a temp file, record batches land in a local
+    SQLite store — O(chunk) transient memory at any corpus scale."""
 
-    def __init__(self, sc, kind: str, name: str, backend: str, state: dict):
+    def __init__(self, key, meta: dict):
+        import shutil
+
+        self.key = key
+        self.meta = meta
+        self.dir = tempfile.mkdtemp(prefix="duke-follower-")
+        self._rm = shutil.rmtree
+        self.snap_path = os.path.join(self.dir, "bootstrap.npz")
+        self._snap_f = (open(self.snap_path, "wb")
+                        if meta["has_snapshot"] else None)
+        if meta["has_snapshot"]:
+            from ..store.records import SqliteRecordStore
+
+            self.store = SqliteRecordStore(
+                os.path.join(self.dir, "records.db")
+            )
+        else:
+            self.store = None
+
+    def add_snapshot_chunk(self, data: bytes) -> None:
+        self._snap_f.write(data)
+
+    def add_records(self, records) -> None:
+        self.store.put_many(records)
+
+    def finish(self) -> None:
+        if self._snap_f is not None:
+            self._snap_f.close()
+            self._snap_f = None
+
+    def discard(self) -> None:
+        self.finish()
+        if self.store is not None:
+            self.store.close()
+            self.store = None
+        self._rm(self.dir, ignore_errors=True)
+
+
+class _Replica:
+    """One workload's follower-side state: sharded index + processor.
+
+    The record mirror is a ``LazyRecordMap`` over the assembly's local
+    SQLite store — the same bounded-memory mirror the frontend itself
+    uses at the flagship scale, so neither side materializes the corpus.
+    Commit replay keeps that store current (``apply_commit`` writes the
+    batch store-first, mirroring Workload's persist-before-index order)
+    — a LazyRecordMap write lands only in its bounded LRU, so skipping
+    the store write would silently resurrect stale rows after eviction.
+    """
+
+    def __init__(self, sc, kind: str, name: str, backend: str,
+                 asm: _StateAssembly):
         registry = (sc.deduplications if kind == "deduplication"
                     else sc.record_linkages)
         wc = registry[name]
@@ -448,39 +600,175 @@ class _Replica:
         self.processor = FollowerProcessor(
             wc.duke, self.index, group_filtering=wc.is_record_linkage
         )
-        if state["snapshot"]:
-            self._adopt(state)
+        self._asm = asm
+        if asm.meta["has_snapshot"]:
+            self._adopt(asm)
+        # AFTER adoption: snapshot_load replays nothing through commit(),
+        # so the digest chain starts exactly at the frontend's captured
+        # point regardless of how the frontend's corpus got here
+        self.index._mirror_digest = asm.meta["mirror_digest"]
 
-    def _adopt(self, state: dict) -> None:
+    def _adopt(self, asm: _StateAssembly) -> None:
         import numpy as np
 
-        fd, tmp = tempfile.mkstemp(suffix=".npz")
-        os.close(fd)
+        from ..store.records import LazyRecordMap
+
+        # trusted bootstrap from the live frontend: the content compare
+        # is satisfied by the snapshot's own stamp (the staleness guard
+        # protects restarts from DISK state; this state was streamed
+        # from a quiesced live corpus seconds ago)
+        with np.load(asm.snap_path) as data:
+            content = str(data["__content"])
+        if not self.index.snapshot_load(
+            asm.snap_path, LazyRecordMap(asm.store), content_hash=content
+        ):
+            raise RuntimeError(
+                "follower bootstrap: corpus state rejected (plan/env "
+                "mismatch with the frontend?)"
+            )
+        # the snapshot served its one purpose; at the flagship scale it
+        # is multi-GB per workload, so don't pin it for the replica's
+        # lifetime (records.db stays — the lazy mirror reads through it)
         try:
-            with open(tmp, "wb") as f:
-                f.write(state["snapshot"])
-            # trusted bootstrap from the live frontend: the content compare
-            # is satisfied by the snapshot's own stamp (the staleness guard
-            # protects restarts from DISK state; this state was captured
-            # from a quiesced live corpus seconds ago)
-            with np.load(tmp) as data:
-                content = str(data["__content"])
-            records_by_id = {r.record_id: r for r in state["records"]}
-            if not self.index.snapshot_load(
-                tmp, records_by_id, content_hash=content
-            ):
-                raise RuntimeError(
-                    "follower bootstrap: corpus state rejected (plan/env "
-                    "mismatch with the frontend?)"
-                )
-        finally:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
+            os.unlink(asm.snap_path)
+        except OSError:
+            pass
+
+    def apply_commit(self, records) -> None:
+        """Replay one commit op: local store first (the mirror reads
+        through to it), then index + commit — the frontend's own order."""
+        if self._asm.store is not None:
+            self._asm.store.put_many(records)
+        for record in records:
+            self.index.index(record)
+        self.index.commit()
 
     def close(self) -> None:
         self.index.close()
+        self._asm.discard()
+
+
+class _FollowerSession:
+    """The follower's op-stream state machine, socket-free so tests can
+    drive it op by op: ``handle(op)`` returns False on shutdown.
+    ``send`` is the response channel (digest handshake frames)."""
+
+    def __init__(self, send):
+        from ..core.config import parse_config
+
+        self._parse_config = parse_config
+        self._send = send
+        self.replicas: Dict[Tuple[str, str], _Replica] = {}
+        self._pending: Dict[Tuple[str, str], _StateAssembly] = {}
+        self._incoming: Optional[Tuple[str, str]] = None  # (backend, cfg)
+
+    def close(self) -> None:
+        for replica in self.replicas.values():
+            try:
+                replica.close()
+            except Exception:
+                pass
+        self.replicas.clear()
+        for asm in self._pending.values():
+            asm.discard()
+        self._pending.clear()
+
+    def _begin(self, backend: str, config_string: str) -> None:
+        # release old replicas (device memory) before new states stream
+        for replica in self.replicas.values():
+            replica.close()
+        self.replicas.clear()
+        self._incoming = (backend, config_string)
+
+    def handle(self, op: tuple) -> bool:
+        tag = op[0]
+        if tag == "bootstrap_begin":
+            _, backend, config_string, fingerprint = op
+            mine = _env_fingerprint()
+            if fingerprint != mine:
+                raise RuntimeError(
+                    "follower env/shape fingerprint mismatch vs "
+                    f"frontend: {fingerprint} != {mine} — all processes "
+                    "must run identical DEVICE_*/schema configuration"
+                )
+            self._begin(backend, config_string)
+        elif tag == "reload_begin":
+            _, backend, config_string = op
+            self._begin(backend, config_string)
+        elif tag == "state_begin":
+            _, key, meta = op
+            self._pending[key] = _StateAssembly(key, meta)
+        elif tag == "snap":
+            _, key, data = op
+            self._pending[key].add_snapshot_chunk(data)
+        elif tag == "recs":
+            _, key, records = op
+            self._pending[key].add_records(records)
+        elif tag == "state_end":
+            _, key = op
+            asm = self._pending.pop(key)
+            asm.finish()
+            backend, config_string = self._incoming
+            sc = self._parse_config(config_string)
+            kind, name = key
+            try:
+                self.replicas[key] = _Replica(sc, kind, name, backend, asm)
+            except BaseException:
+                # the assembly left _pending but no replica owns it — a
+                # rejected bootstrap must not leak its multi-GB temp dir
+                # across a restart loop
+                asm.discard()
+                raise
+        elif tag == "bootstrap_end":
+            logger.info(
+                "follower: %d workload replica(s) ready", len(self.replicas)
+            )
+        elif tag == "commit":
+            _, key, records = op
+            try:
+                self.replicas[key].apply_commit(records)
+            except Exception:
+                # deterministic engine errors raise SYMMETRICALLY on the
+                # frontend (same code, same inputs), so surviving them
+                # keeps the mirrors consistent; dying here would let one
+                # bad request wedge the whole mesh.  An asymmetric
+                # (hardware) failure is caught by the digest handshake:
+                # ok=False halts the frontend at this very commit.
+                logger.exception("follower: commit replay failed")
+                if _verify_enabled():
+                    self._send(_digest_frame(False, b""))
+            else:
+                # answer the frontend's digest handshake (one frame per
+                # commit, read under the frontend's op lock).  Gated on
+                # the SAME env flag the frontend reads (fingerprint-
+                # checked at bootstrap): an unread frame per commit would
+                # eventually fill the TCP buffer and deadlock the loop.
+                if _verify_enabled():
+                    self._send(_digest_frame(
+                        True, self.replicas[key].index._mirror_digest
+                    ))
+        elif tag == "score":
+            _, key, records = op
+            try:
+                self.replicas[key].processor.score(records)
+            except Exception:
+                logger.exception("follower: score replay failed")
+        elif tag == "rematch":
+            _, key, block_rows = op
+            from ..engine.rematch import replay_rematch
+
+            replica = self.replicas[key]
+            try:
+                replay_rematch(replica.index, replica.processor._proc,
+                               query_block_rows=block_rows)
+            except Exception:
+                logger.exception("follower: rematch replay failed")
+        elif tag == "shutdown":
+            logger.info("follower: shutdown op received; exiting")
+            return False
+        else:
+            raise RuntimeError(f"unknown dispatch op {tag!r}")
+        return True
 
 
 def follower_main(poll_timeout_ms: int = None) -> None:
@@ -488,7 +776,6 @@ def follower_main(poll_timeout_ms: int = None) -> None:
     stream and replay mesh ops until shutdown/EOF.  Call after
     ``multihost.initialize()`` in a process with ``jax.process_index() >
     0``; never returns until the job ends."""
-    from ..core.config import parse_config
     from ..utils.jit_cache import enable_persistent_cache
 
     enable_persistent_cache()
@@ -518,83 +805,33 @@ def follower_main(poll_timeout_ms: int = None) -> None:
     sock.sendall(_hello_frame(token))  # raw-bytes join (Dispatcher.start)
     sock.settimeout(None)  # ops arrive whenever the frontend has work
 
-    replicas: Dict[Tuple[str, str], _Replica] = {}
-
-    def rebuild(backend: str, config_string: str, states: dict) -> None:
-        for replica in replicas.values():
-            replica.close()
-        replicas.clear()
-        sc = parse_config(config_string)
-        for (kind, name), state in states.items():
-            replicas[(kind, name)] = _Replica(sc, kind, name, backend, state)
-        logger.info(
-            "follower: %d workload replica(s) ready (%s)",
-            len(replicas), backend,
-        )
-
+    session = _FollowerSession(sock.sendall)
+    any_op = False
     try:
         while True:
             try:
                 op = _recv_msg(sock)
             except EOFError:
+                if not any_op:
+                    # EOF before the first op means the frontend dropped
+                    # us at the handshake — almost always a join-token
+                    # mismatch (one-sided DUKE_DISPATCH_TOKEN).  Exiting
+                    # cleanly here would hide the misconfiguration from
+                    # orchestrators while the frontend blocks out its
+                    # whole accept timeout.
+                    raise RuntimeError(
+                        "dispatch stream closed before any op arrived — "
+                        "the frontend likely rejected this follower's "
+                        "join token (is DUKE_DISPATCH_TOKEN set "
+                        "identically on both sides?)"
+                    )
                 logger.info("follower: dispatch stream closed; exiting")
                 return
-            tag = op[0]
-            if tag == "bootstrap":
-                _, backend, config_string, states, fingerprint = op
-                mine = _env_fingerprint()
-                if fingerprint != mine:
-                    raise RuntimeError(
-                        "follower env/shape fingerprint mismatch vs "
-                        f"frontend: {fingerprint} != {mine} — all processes "
-                        "must run identical DEVICE_*/schema configuration"
-                    )
-                rebuild(backend, config_string, states)
-            elif tag == "reload":
-                _, backend, config_string, states = op
-                rebuild(backend, config_string, states)
-            elif tag == "commit":
-                _, key, records = op
-                replica = replicas[key]
-                try:
-                    for record in records:
-                        replica.index.index(record)
-                    replica.index.commit()
-                except Exception:
-                    # deterministic engine errors raise SYMMETRICALLY on
-                    # the frontend (same code, same inputs), so surviving
-                    # them keeps the mirrors consistent; dying here would
-                    # let one bad request wedge the whole mesh.  An
-                    # asymmetric (hardware) failure resurfaces on the next
-                    # op and the job restarts per the module's stance.
-                    logger.exception("follower: commit replay failed")
-            elif tag == "score":
-                _, key, records = op
-                try:
-                    replicas[key].processor.score(records)
-                except Exception:
-                    logger.exception("follower: score replay failed")
-            elif tag == "rematch":
-                _, key, block_rows = op
-                from ..engine.rematch import replay_rematch
-
-                replica = replicas[key]
-                try:
-                    replay_rematch(replica.index, replica.processor._proc,
-                                   query_block_rows=block_rows)
-                except Exception:
-                    logger.exception("follower: rematch replay failed")
-            elif tag == "shutdown":
-                logger.info("follower: shutdown op received; exiting")
+            any_op = True
+            if not session.handle(op):
                 return
-            else:
-                raise RuntimeError(f"unknown dispatch op {tag!r}")
     finally:
-        for replica in replicas.values():
-            try:
-                replica.close()
-            except Exception:
-                pass
+        session.close()
         sock.close()
 
 

@@ -101,12 +101,16 @@ class _StubDispatcher:
     def __init__(self):
         self.ops = []
         self.failed = None
+        self.verified = []
 
     def broadcast(self, op):
         self.ops.append(op[0])
 
     def mark_failed(self, reason):
         self.failed = reason
+
+    def verify_mirror_digest(self, key, digest):
+        self.verified.append((key, digest))
 
 
 def _tiny_index():
