@@ -32,14 +32,14 @@ the single-chip backends (differential-tested in
 Deployment: single-host this shards over every local device — the
 flagship v5e-8 configuration (BASELINE configs[4]) runs one process
 driving all 8 chips, full REST surface included.  Multi-host meshes
-(``parallel.multihost.initialize()``) are supported by the scoring
-programs themselves (the collectives ride ICI within a slice and DCN
-across — exercised by tests/test_multihost.py), but the HTTP frontend is
-a single-controller: in a multi-process job the follower processes must
-run the same jitted programs in lockstep, which needs a follower dispatch
-loop (frontend broadcasts each batch's shapes over DCN) that is not built
-yet — multi-host serving is the one remaining step between "collective
-stack works multi-host" and "service scales past one host".
+(``parallel.multihost.initialize()``) work end to end: the HTTP frontend
+is a single-controller and follower processes replay every corpus
+mutation and scoring pass in lockstep through ``parallel/dispatch.py``
+(token-authenticated op broadcast over DCN; see that module for the
+ordering/failure invariants).  Exercised by
+``tests/test_multihost_serving.py`` — two OS processes, real HTTP, the
+same link set as a single-process run — and by the driver dryrun's
+two-process smoke.
 """
 
 from __future__ import annotations
