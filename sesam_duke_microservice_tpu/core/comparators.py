@@ -97,6 +97,24 @@ def levenshtein_distance(s1: str, s2: str, limit: Optional[int] = None) -> int:
     return prev[n2]
 
 
+def _utf16_expand(s: str) -> str:
+    """Java parity for char-based comparators: Duke measures edit
+    distance over java.lang.String CHAR UNITS, so a non-BMP character
+    (surrogate pair in Java) counts as TWO positions.  The device path
+    stores UTF-16 code units outright (ops.features.CHAR_DTYPE); this
+    expansion keeps the host comparators bit-identical to it.  BMP-only
+    strings (the overwhelmingly common case) return unchanged."""
+    if s.isascii():  # O(1) flag check covers the hot loop's usual case
+        return s
+    for ch in s:
+        if ord(ch) > 0xFFFF:
+            return "".join(
+                chr(u) for u in
+                memoryview(s.encode("utf-16-le", "surrogatepass")).cast("H")
+            )
+    return s
+
+
 class Levenshtein(Comparator):
     """Edit-distance similarity, Duke semantics.
 
@@ -113,6 +131,7 @@ class Levenshtein(Comparator):
     def compare(self, v1: str, v2: str) -> float:
         if v1 == v2:
             return 1.0
+        v1, v2 = _utf16_expand(v1), _utf16_expand(v2)
         shorter = min(len(v1), len(v2))
         longer = max(len(v1), len(v2))
         if shorter == 0:
@@ -168,6 +187,7 @@ class WeightedLevenshtein(Comparator):
     def compare(self, v1: str, v2: str) -> float:
         if v1 == v2:
             return 1.0
+        v1, v2 = _utf16_expand(v1), _utf16_expand(v2)
         shorter = min(len(v1), len(v2))
         if shorter == 0:
             return 0.0
@@ -221,6 +241,7 @@ class JaroWinkler(Comparator):
     def compare(self, v1: str, v2: str) -> float:
         if v1 == v2:
             return 1.0
+        v1, v2 = _utf16_expand(v1), _utf16_expand(v2)
         native = _native_module()
         if native is not None:
             return native.jaro_winkler(v1, v2, self.prefix_scale,

@@ -49,6 +49,7 @@ import numpy as np
 from ..core.config import DukeSchema, MatchTunables
 from ..core.records import GROUP_NO_PROPERTY_NAME, Record, SchemaError
 from ..index.base import CandidateIndex
+from ..ops import features as F
 from ..ops.features import CHARS as _F_CHARS, CHARS_WEIGHTED as _F_CHARS_W
 from .listeners import MatchListener
 from .processor import ProfileStats
@@ -624,11 +625,19 @@ class DeviceIndex(CandidateIndex):
     # -- value-slot auto-sizing ----------------------------------------------
 
     def _chars_needed(self, spec, records: Sequence[Record]) -> int:
+        from ..ops.features import char_units
+
         need = 0
         for r in records:
             for val in r.get_values(spec.name):
-                if len(val) > need:
-                    need = len(val)
+                # width in UTF-16 code units — the char-axis unit
+                # (ops.features.CHAR_DTYPE); len() undercounts non-BMP
+                if len(val) * 2 < need:
+                    continue  # cannot beat the running max even if all
+                              # chars were surrogate pairs
+                n = char_units(val)
+                if n > need:
+                    need = n
         return need
 
     def _sized_chars(self, spec, need: int) -> int:
@@ -861,6 +870,10 @@ class DeviceIndex(CandidateIndex):
             os.environ.get("DEVICE_MAX_TOKENS", ""),
             getattr(self, "dim", None),          # ANN embedding width
             getattr(self, "emb_storage", None),  # ANN embedding dtype
+            # char-tensor storage dtype (r5: uint16 UTF-16 code units) —
+            # a pre-r5 int32-codepoint snapshot must be rejected into a
+            # replay, not silently adopted with the wrong text model
+            str(np.dtype(F.CHAR_DTYPE)),
         ))
         return hashlib.sha256(spec.encode()).hexdigest()
 

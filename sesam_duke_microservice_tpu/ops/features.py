@@ -49,6 +49,24 @@ _BATCH_HASH_MAX_BYTES = 4096
 # Sentinel for empty sorted-set slots: int32 max sorts last.
 SET_PAD = np.int32(2**31 - 1)
 
+# Char tensors hold UTF-16 CODE UNITS in uint16 (r5) — not uint32
+# codepoints.  Halves the dominant HBM/row term, the restart upload, the
+# snapshot, and the bootstrap payload at once, and it is the reference's
+# own text model: Duke comparators run on java.lang.String char units,
+# so a surrogate pair counts as TWO units there too (e.g.
+# Levenshtein.java operates per char).  The host comparators apply the
+# same expansion for non-BMP text (core.comparators._utf16_expand), so
+# host and device distances stay bit-identical.
+CHAR_DTYPE = np.uint16
+
+
+def char_units(value: str) -> int:
+    """Length of ``value`` in UTF-16 code units (the char-axis unit)."""
+    if value.isascii():  # O(1) flag check — the ingest hot path's case
+        return len(value)
+    # C-speed for the non-ASCII remainder (no Python per-char loop)
+    return len(value.encode("utf-16-le", "surrogatepass")) >> 1
+
 
 def fnv1a64(value: str) -> int:
     h = _FNV_OFFSET
@@ -271,7 +289,7 @@ def extract_property(
     kind = spec.kind
     if kind in (CHARS, CHARS_WEIGHTED):
         L = spec.chars
-        chars = np.zeros((n, v, L), dtype=np.int32)
+        chars = np.zeros((n, v, L), dtype=CHAR_DTYPE)
         length = np.zeros((n, v), dtype=np.int32)
         classes = (
             np.zeros((n, v, L), dtype=np.int32)
@@ -315,30 +333,53 @@ def extract_property(
 
     if kind in (CHARS, CHARS_WEIGHTED):
         if flat:
-            # utf-32-le round-trips every codepoint (incl. lone
-            # surrogates) as one uint32 — encode per value, then ONE
-            # concatenated buffer + boolean-mask scatter fills the whole
-            # (m, MAX_CHARS) block (row-major mask order == concatenation
-            # order), replacing a frombuffer + slice-assign per value
+            # utf-16-le: text rides the device as UTF-16 CODE UNITS in
+            # uint16 — half the HBM/row, upload, snapshot, and bootstrap
+            # bytes of the old uint32 codepoints, and EXACT parity with
+            # the reference, whose comparators run on java.lang.String
+            # char units (Duke Levenshtein.distance etc. count a
+            # surrogate PAIR as two units).  surrogatepass round-trips
+            # lone surrogates; slicing the byte buffer at 2*L may split
+            # a pair, which is precisely Java's substring-on-code-units
+            # behavior.  One concatenated buffer + boolean-mask scatter
+            # fills the whole (m, L) block (row-major mask order ==
+            # concatenation order).
+            # slice to L CHARS first so a multi-KB value pays O(L), not
+            # O(len), per extraction; L chars cover >= L code units, so
+            # the byte cap after encoding is exact
             bufs = [
-                t[2][:L].encode("utf-32-le", "surrogatepass")
+                t[2][:L].encode("utf-16-le", "surrogatepass")[: 2 * L]
                 for t in flat
             ]
             m = len(flat)
-            lens = np.fromiter((len(b) >> 2 for b in bufs), np.int64,
+            lens = np.fromiter((len(b) >> 1 for b in bufs), np.int64,
                                count=m)
-            mat = np.zeros((m, L), dtype=np.int32)
+            mat = np.zeros((m, L), dtype=CHAR_DTYPE)
             if int(lens.sum()):
-                all_cp = np.frombuffer(b"".join(bufs), dtype="<u4")
-                mat[np.arange(L)[None, :] < lens[:, None]] = (
-                    all_cp.astype(np.int32)
-                )
+                all_cu = np.frombuffer(b"".join(bufs), dtype="<u2")
+                mat[np.arange(L)[None, :] < lens[:, None]] = all_cu
             chars[ii, kk] = mat  # ii/kk from the hash block above
             length[ii, kk] = lens.astype(np.int32)
             if classes is not None:
+                # per-UNIT character classes.  Surrogate units class as
+                # "other" (0): Java's Character.isDigit/isLetter on a
+                # lone surrogate char is false, and the host path sees
+                # the same after _utf16_expand — all three agree.
                 for i, k, value in flat:
-                    for j, ch in enumerate(value[:L]):
-                        classes[i, k, j] = _char_class(ch)
+                    j = 0
+                    for ch in value:
+                        if ord(ch) > 0xFFFF:
+                            if j < L:
+                                classes[i, k, j] = 0
+                            if j + 1 < L:
+                                classes[i, k, j + 1] = 0
+                            j += 2
+                        else:
+                            if j < L:
+                                classes[i, k, j] = _char_class(ch)
+                            j += 1
+                        if j >= L:
+                            break
     elif kind == GRAM_SET:
         from .. import native
 
@@ -438,18 +479,27 @@ def extract_batch(
     ``encoder`` is given (the ANN backend), the embedding rides in the
     result under its pseudo-property.
 
-    Deliberately serial.  Parallel variants were built and measured
-    (r4): a thread fan-out gains nothing because the remaining per-value
+    Serial below a slab threshold.  Parallel variants were measured in
+    r4: a thread fan-out gains nothing because the remaining per-value
     glue (string encode, flat-list construction, embedding packing) is
     GIL-bound Python — the C/numpy bulk passes it feeds already release
-    the GIL but no longer dominate; a spawn process pool LOSES 3-5x
-    because the result tensors (~1 KB/row) pay pickling + IPC both ways.
-    The wins that stuck are in the serial path itself: bulk C FNV
-    hashing and q-gram set extraction (native.duke_fnv1a64_batch /
-    duke_gram_set_batch), one-pass codepoint scatter, and no-copy record
-    reads — see BASELINE.md "Ingest".
+    the GIL but no longer dominate; a spawn process pool returning
+    tensors LOSES 3-5x to pickling + IPC of ~1 KB/row both ways.  r5
+    adds the fix that analysis pointed at: bulk slabs fan out to a
+    process pool whose workers write tensors straight into shared
+    memory (ops.parallel_extract) — only the much smaller record values
+    ride the task pickle.  The serial-path wins (bulk C FNV hashing,
+    q-gram set extraction, one-pass scatter) apply inside each worker.
     """
     from . import encoder as E
+
+    if len(records) >= 1:
+        from . import parallel_extract as PX
+
+        if PX.enabled(len(records)):
+            out = PX.extract_batch_parallel(plan, records, encoder=encoder)
+            if out is not None:
+                return out
 
     out = _extract_serial(plan, records)
     if encoder is not None:
