@@ -39,24 +39,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("DEVICE_PREWARM", "0")
 
-SCHEMA_XML = """<?xml version="1.0" encoding="utf-8"?>
+SCHEMA_XML = """
 <DukeMicroService>
-  <deduplication name="people">
+  <Deduplication name="people" link-database-type="in-memory">
     <duke>
       <schema>
         <threshold>0.8</threshold>
-        <property type="id"><name>ID</name></property>
-        <property><name>name</name>
-          <comparator>no.priv.garshol.duke.comparators.LevenshteinDistanceComparator</comparator>
-          <low>0.3</low><high>0.9</high></property>
-        <property><name>city</name>
-          <comparator>no.priv.garshol.duke.comparators.ExactComparator</comparator>
-          <low>0.4</low><high>0.85</high></property>
+        <property><name>NAME</name><comparator>levenshtein</comparator><low>0.3</low><high>0.9</high></property>
+        <property><name>CITY</name><comparator>exact</comparator><low>0.4</low><high>0.85</high></property>
       </schema>
-      <database class="no.priv.garshol.duke.databases.LuceneDatabase"/>
+      <data-source class="io.sesam.dukemicroservice.IncrementalDeduplicationDataSource">
+        <param name="dataset-id" value="crm"/>
+        <column name="name" property="NAME"/>
+        <column name="city" property="CITY"/>
+      </data-source>
     </duke>
-    <datasets><dataset id="crm"/></datasets>
-  </deduplication>
+  </Deduplication>
 </DukeMicroService>
 """
 
@@ -74,8 +72,8 @@ def _make_records(start: int, n: int):
     for i in range(start, start + n):
         r = Record()
         r.add_value(ID_PROPERTY_NAME, f"crm__crm__r{i}")
-        r.add_value("name", f"person {i % 97} no {i}")
-        r.add_value("city", f"city-{i % 1024}")
+        r.add_value("NAME", f"person {i % 97} no {i}")
+        r.add_value("CITY", f"city-{i % 1024}")
         out.append(r)
     return out
 
@@ -114,7 +112,7 @@ def main() -> None:
     ap.add_argument("--batch", type=int, default=8192)
     ap.add_argument("--_child-port", type=int, default=0)
     args = ap.parse_args()
-    if args.ch if False else args._child_port:
+    if args._child_port:
         follower_child(args._child_port)
         return
 

@@ -211,6 +211,17 @@ class LazyRecordMap:
             if record is not None:
                 yield record
 
+    def bulk_values(self):
+        """Corpus-scale streaming walk via the store's bulk cursor —
+        ``values()`` pays one point SELECT per id, which turns a 10M-row
+        walk (multi-host bootstrap streaming) into hours.  Rows whose id
+        has been popped from the membership authority are skipped; the
+        store is never BEHIND the map for live ids (the workload persists
+        before indexing), so the cursor view is current."""
+        for record in self._store.all_records():
+            if record.record_id in self._ids:
+                yield record
+
     def prefetch(self, rids) -> None:
         """Warm the LRU with a batch of ids in few store round trips —
         page-sized feed resolution would otherwise pay one SELECT per
