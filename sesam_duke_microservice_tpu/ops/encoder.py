@@ -206,12 +206,18 @@ def _fused_retrieval(q_emb, corpus_emb, corpus_valid, corpus_deleted,
     tc = n & -n  # largest power-of-2 divisor of the capacity
     tc = min(tc, 2048, (1 << 21) // qp)  # tc*qp*4B <= 8 MB of VMEM
     nbins = n // seg
-    # nbins >= 4*top_c: an escalated C that approaches the bin count means
-    # the query saturated its candidate budget — drop to the (adjacency-
-    # safe, exact-per-bin-free) approx scan rather than retrieve whole
-    # bins.  Duplicate clusters wider than a tile's stride (tc/seg) also
-    # resolve there via count saturation -> escalation -> this fallback.
-    if tc < max(1024, seg * 8) or n % tc or nbins < 4 * top_c:
+    # Bin-count floor: expected segment-phase recall of the true top-C is
+    # ~1 - C/(2*nbins) (birthday collisions into nbins strided bins), so
+    # honoring recall_target needs nbins >= C / (1 - target) — with slack
+    # for the approx-over-bins second stage, which carries its own
+    # recall_target reduction.  Below the floor (small corpora, or an
+    # escalated C approaching the bin count = a saturated candidate
+    # budget) drop to the per-chunk approx scan, whose reduction adapts
+    # to its input size.  Empirically this floor is what separates the
+    # 10M run's 0.975 measured recall from the 10k-corpus case that
+    # silently lost 0.989-confidence pairs at 256 bins (r5 bringup).
+    min_bins = int(top_c / max(1e-3, 1.0 - recall_target))
+    if tc < max(1024, seg * 8) or n % tc or nbins < min_bins:
         return None
 
     if qp != q:
@@ -325,7 +331,7 @@ def retrieval_scan(q_emb, corpus_emb, corpus_valid, corpus_deleted,
         or top_c * 4 >= chunk
     )
     recall_target = float(
-        os.environ.get("DEVICE_ANN_RECALL_TARGET", "0.95")
+        os.environ.get("DEVICE_ANN_RECALL_TARGET", "0.99")
     )
 
     from . import pallas_kernels as pk

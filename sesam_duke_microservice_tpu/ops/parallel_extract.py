@@ -183,12 +183,24 @@ def extract_batch_parallel(plan, records, *, encoder=None
         layout[key] = (shm.name, shape, str(np.dtype(dtype)))
 
     try:
-        for spec in plan.device_props:
-            proto = F.extract_property(spec, [])
-            for tname, arr in proto.items():
-                alloc((spec.name, tname), (n,) + arr.shape[1:], arr.dtype)
-        if encoder is not None:
-            alloc(("__ann__", "emb_f32"), (n, encoder.dim), np.float32)
+        try:
+            for spec in plan.device_props:
+                proto = F.extract_property(spec, [])
+                for tname, arr in proto.items():
+                    alloc((spec.name, tname), (n,) + arr.shape[1:],
+                          arr.dtype)
+            if encoder is not None:
+                alloc(("__ann__", "emb_f32"), (n, encoder.dim), np.float32)
+        except OSError:
+            # /dev/shm too small for the slab (Docker defaults to 64 MB)
+            # — the contract is a transparent serial fallback, never a
+            # failed ingest request
+            import logging
+
+            logging.getLogger("parallel-extract").exception(
+                "shared-memory allocation failed; falling back to serial"
+            )
+            return None
 
         tasks = []
         for w in range(nw):

@@ -213,7 +213,7 @@ def _env_fingerprint() -> dict:
         "ann_fused": os.environ.get("DEVICE_ANN_FUSED", "1"),
         "ann_seg": os.environ.get("DEVICE_ANN_SEG", "64"),
         "ann_exact": os.environ.get("DEVICE_ANN_EXACT_TOPK", "0"),
-        "ann_recall": os.environ.get("DEVICE_ANN_RECALL_TARGET", "0.95"),
+        "ann_recall": os.environ.get("DEVICE_ANN_RECALL_TARGET", "0.99"),
         "ann_chunk": os.environ.get("DEVICE_ANN_RETRIEVAL_CHUNK", "65536"),
         # every env knob that sizes a feature tensor (ops.features): a
         # mismatch here compiles different-shape programs per process and
@@ -494,10 +494,12 @@ class Dispatcher:
                 except OSError:
                     pass
             batch: List = []
-            # LazyRecordMap.values() streams store rows through a bounded
-            # LRU, so this loop holds O(_REC_BATCH) records at the 10M
-            # scale, not the corpus
-            for record in index.records.values():
+            # bulk_values streams the store's cursor directly (bounded
+            # memory AND no per-id SELECT); plain-dict mirrors walk
+            # values() — either way this loop holds O(_REC_BATCH) records
+            values = getattr(index.records, "bulk_values",
+                             index.records.values)
+            for record in values():
                 batch.append(record)
                 if len(batch) >= _REC_BATCH:
                     self.broadcast(("recs", key, batch))

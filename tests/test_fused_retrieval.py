@@ -43,6 +43,11 @@ def _run(monkeypatch, args, *, fused, seg=64, top_c=16, gf=False,
     monkeypatch.setenv("DEVICE_ANN_FUSED", "1" if fused else "0")
     monkeypatch.setenv("DEVICE_ANN_EXACT_TOPK", "0" if fused else "1")
     monkeypatch.setenv("DEVICE_ANN_SEG", str(seg))
+    # test corpora are tiny; loosen the bin-count floor (top_c/(1-r)) so
+    # the kernel path actually engages (recall is exact on CPU anyway —
+    # approx_max_k falls back to a sort).  test_small_corpus_falls_back
+    # covers the floor itself.
+    monkeypatch.setenv("DEVICE_ANN_RECALL_TARGET", "0.8")
     q, c, cv, cd, cg, qg, qr = args
     if offset:
         qr = jnp.where(qr >= 0, qr + offset, qr)
@@ -150,3 +155,40 @@ def test_adjacent_duplicate_cluster_not_collapsed(monkeypatch):
             f"cluster collapsed: only {len(got & cluster)}/{top_c} "
             "retrieved candidates are cluster rows"
         )
+
+
+def test_small_corpus_falls_back_on_bin_floor(monkeypatch):
+    """The bin-count floor (top_c / (1 - recall_target)): a corpus whose
+    bin count cannot carry the recall target must use the scan path —
+    at 256 bins for C=64 the 10k stresstest silently lost
+    0.989-confidence pairs (r5 bringup)."""
+    monkeypatch.setenv("DUKE_TPU_PALLAS", "1")
+    monkeypatch.setenv("DEVICE_ANN_FUSED", "1")
+    monkeypatch.setenv("DEVICE_ANN_SEG", "64")
+    monkeypatch.setenv("DEVICE_ANN_RECALL_TARGET", "0.95")
+    args = _random_problem(n=16384, q=96, seed=9)
+    # nbins = 256 < 64/0.05 = 1280 -> must return None (scan fallback)
+    assert E._fused_retrieval(
+        *args, top_c=64, group_filtering=False, row_offset=0,
+        recall_target=0.95,
+    ) is None
+    # with a loose target the same shape engages the kernel
+    got = E._fused_retrieval(
+        *args, top_c=16, group_filtering=False, row_offset=0,
+        recall_target=0.8,
+    )
+    assert got is not None
+
+
+def test_kernel_path_engages_in_run_config(monkeypatch):
+    """Guard against the differential tests silently testing the scan
+    fallback: the shared _run() config must reach the Pallas kernel."""
+    args = _random_problem(seed=3)
+    monkeypatch.setenv("DUKE_TPU_PALLAS", "1")
+    monkeypatch.setenv("DEVICE_ANN_SEG", "8")
+    monkeypatch.setenv("DEVICE_ANN_RECALL_TARGET", "0.8")
+    got = E._fused_retrieval(
+        *args, top_c=16, group_filtering=False, row_offset=0,
+        recall_target=0.8,
+    )
+    assert got is not None
