@@ -263,17 +263,23 @@ def main():
         r.set_values("ID", [f"warm__{r.record_id}"])
     proc.deduplicate(warm)
 
-    # steady-state incremental batches
-    times = []
+    # steady-state incremental batches; per-phase split from the
+    # processor's own stats so regressions name their phase (r5)
+    times, splits = [], []
     for i in range(args.measure_batches):
         qrows, _ = generate(args.batch, args.dup_rate, 8000 + i)
         batch = to_records(qrows)
         for r in batch:
             r.set_values("ID", [f"q{i}__{r.record_id}"])
+        r0 = proc.stats.retrieval_seconds
+        c0 = proc.stats.compare_seconds
         t0 = time.perf_counter()
         proc.deduplicate(batch)
         times.append(time.perf_counter() - t0)
+        splits.append((proc.stats.retrieval_seconds - r0,
+                       proc.stats.compare_seconds - c0))
     best = min(times)
+    score_s, finalize_s = splits[times.index(best)]
     corpus_rows = index.corpus.size
 
     # device bytes per corpus row (features + embedding + masks)
@@ -292,6 +298,12 @@ def main():
         "effective_pairs_per_sec": round(args.batch * corpus_rows / best, 1),
         "hbm_bytes_per_row": per_row,
         "batch_seconds": round(best, 3),
+        # device scoring wait (dispatch->resolve) vs host finalization;
+        # the remainder of batch_seconds is ingest-side (extract, commit,
+        # incremental device update)
+        "score_seconds": round(score_s, 3),
+        "finalize_seconds": round(finalize_s, 3),
+        "ingest_side_seconds": round(best - score_s - finalize_s, 3),
     }))
 
 

@@ -138,8 +138,16 @@ class DeviceCorpus:
         self.row_ids: List[Optional[str]] = []
         self._device = None           # cached jnp feature mirrors
         self._dirty_full = True       # capacity changed -> full re-upload
-        self._dirty_masks = True      # valid/deleted/group changed (small)
+        # masks: _dirty_masks forces a FULL (cap,)-sized refresh (growth,
+        # snapshot restore, external mutation); steady-state commits ride
+        # the incremental trackers instead — at the 10M flagship scale a
+        # wholesale mask refresh is ~60 MB over the device link PER
+        # COMMIT (r5 measured it dominating the serve batch), while the
+        # appended-slice + tombstone-scatter updates are O(batch)
+        self._dirty_masks = True
         self._pending_update: Optional[Tuple[int, int]] = None  # appended rows
+        self._mask_slice: Optional[Tuple[int, int]] = None  # appended masks
+        self._mask_rows: List[int] = []                     # tombstones
         self._mask_device = None
         # serializes device_arrays between the restart warm-upload thread
         # (DeviceIndex.warm_upload_async) and the scoring path; the
@@ -208,7 +216,6 @@ class DeviceCorpus:
         self.row_group[lo:hi] = group
         self.row_ids.extend(ids)
         old_size, self.size = self.size, self.size + n
-        self._dirty_masks = True
         self._mutation_gen += 1
         if not self._dirty_full:
             # track the appended range for an incremental device update;
@@ -218,11 +225,16 @@ class DeviceCorpus:
             else:
                 s, c = self._pending_update
                 self._pending_update = (s, old_size + n - s)
+            if self._mask_slice is None:
+                self._mask_slice = (old_size, n)
+            else:
+                s, c = self._mask_slice
+                self._mask_slice = (s, old_size + n - s)
         return rows
 
     def tombstone(self, row: int) -> None:
         self.row_valid[row] = False
-        self._dirty_masks = True
+        self._mask_rows.append(int(row))
         self._mutation_gen += 1
 
     # -- device mirror -------------------------------------------------------
@@ -245,9 +257,13 @@ class DeviceCorpus:
         Steady-state incremental batches update the device copy in place
         (one ``dynamic_update_slice`` per feature tensor, O(batch) transfer)
         instead of re-uploading the whole corpus; a full upload happens only
-        on capacity growth.  The three O(capacity)-byte mask arrays are
-        always refreshed wholesale — tombstones touch arbitrary rows and
-        the arrays are tiny next to the feature tensors.
+        on capacity growth.  The three mask arrays are ALSO incremental
+        (r5): appended ranges ride a slice update and tombstones a
+        bucketed scatter — at the 10M flagship scale a wholesale mask
+        refresh is ~60 MB over the device link per commit, which
+        dominated the serve batch.  External code that mutates
+        ``row_valid``/``row_deleted`` outside ``append``/``tombstone``
+        MUST set ``_dirty_masks = True`` (snapshot_load does).
         """
         with self._upload_lock:
             while True:
@@ -260,7 +276,22 @@ class DeviceCorpus:
                 # were consumed against possibly-torn reads — redo; the
                 # second pass is incremental and cheap
 
+    def _bucketed_slice(self, start: int, count: int) -> Tuple[int, int]:
+        """ONE copy of the update-slice bucketing policy (features and
+        masks): pow2 lengths from ``_UPDATE_SLICE`` to limit updater
+        recompiles, clamped into the capacity."""
+        bucket = _UPDATE_SLICE
+        while bucket < count:
+            bucket *= 2
+        bucket = min(bucket, self.capacity)
+        return min(start, self.capacity - bucket), bucket
+
     def _device_arrays_locked(self):
+        # DETACH-then-consume everywhere below: trackers are swapped out
+        # before any host array is read, so a writer racing the
+        # background warm thread lands its entry in a FRESH tracker (and
+        # bumps _mutation_gen) — the retry loop in device_arrays then
+        # applies it, instead of a post-read clear() silently eating it.
         if self._device is None or self._dirty_full:
             self._device = {
                 prop: {name: self._place(arr) for name, arr in tensors.items()}
@@ -269,16 +300,14 @@ class DeviceCorpus:
             self._pending_update = None
             self._dirty_full = False
         elif self._pending_update is not None:
-            start, count = self._pending_update
-            # bucket the update length to limit updater recompiles
-            bucket = _UPDATE_SLICE
-            while bucket < count:
-                bucket *= 2
-            bucket = min(bucket, self.capacity)
-            start = min(start, self.capacity - bucket)
+            (start, count), self._pending_update = self._pending_update, None
+            start, bucket = self._bucketed_slice(start, count)
             # ONE jitted call updates the whole tree (donated buffers):
             # per-tensor dispatch would pay the device-link round-trip
-            # once per tensor per commit
+            # once per tensor per commit.  (The mask slice below is a
+            # second dispatch covering the same range; folding masks into
+            # this tree would save it, at the cost of merging the mask
+            # and feature mirrors' storage — noted, not yet taken.)
             upd = {
                 prop: {
                     name: arr[start:start + bucket]
@@ -289,16 +318,97 @@ class DeviceCorpus:
             self._device = self._updater()(
                 self._device, upd, np.int32(start)
             )
-            self._pending_update = None
-        if self._mask_device is None or self._dirty_masks:
+        # masks: full refresh only when forced (growth/restore/external
+        # mutation) or when the scattered-row set got so large the
+        # wholesale upload is cheaper; otherwise O(batch) updates
+        if (
+            self._mask_device is None
+            or self._dirty_masks
+            or len(self._mask_rows) > max(4096, self.capacity >> 4)
+        ):
+            self._mask_slice = None
+            self._mask_rows = []
+            self._dirty_masks = False
             self._mask_device = (
                 self._place(self.row_valid),
                 self._place(self.row_deleted),
                 self._place(self.row_group),
             )
-            self._dirty_masks = False
+        else:
+            if self._mask_slice is not None:
+                (start, count), self._mask_slice = self._mask_slice, None
+                start, bucket = self._bucketed_slice(start, count)
+                self._mask_device = self._mask_updater()(
+                    self._mask_device,
+                    (self.row_valid[start:start + bucket],
+                     self.row_deleted[start:start + bucket],
+                     self.row_group[start:start + bucket]),
+                    np.int32(start),
+                )
+            if self._mask_rows:
+                rows, self._mask_rows = self._mask_rows, []
+                # bucketed scatter: every update SETS the host mirror's
+                # current value, so duplicate/padded indices and any
+                # ordering vs the slice update are idempotent
+                idx = np.asarray(rows, dtype=np.int32)
+                bucket = 256
+                while bucket < idx.size:
+                    bucket *= 2
+                pad = np.full(bucket - idx.size, idx[0], dtype=np.int32)
+                idx = np.concatenate([idx, pad])
+                self._mask_device = self._mask_scatter()(
+                    self._mask_device, idx,
+                    self.row_valid[idx], self.row_deleted[idx],
+                )
         valid, deleted, group = self._mask_device
         return self._device, valid, deleted, group
+
+    def _mask_updater(self):
+        """Jitted mask-slice updater (the sharded corpus overrides with a
+        sharding-constrained variant)."""
+        return _mask_slice_updater()
+
+    def _mask_scatter(self):
+        """Jitted tombstone scatter (sharded corpus overrides)."""
+        return _mask_scatter_updater()
+
+
+_MASK_UPDATER = None
+_MASK_SCATTER = None
+
+
+def _mask_slice_updater():
+    """One jitted call updating (valid, deleted, group) for a contiguous
+    appended range — O(batch) transfer instead of O(capacity)."""
+    global _MASK_UPDATER
+    if _MASK_UPDATER is None:
+        import jax
+        from jax import lax
+
+        _MASK_UPDATER = jax.jit(
+            lambda masks, upd, start: tuple(
+                lax.dynamic_update_slice_in_dim(m, u, start, axis=0)
+                for m, u in zip(masks, upd)
+            ),
+            donate_argnums=(0,),
+        )
+    return _MASK_UPDATER
+
+
+def _mask_scatter_updater():
+    """One jitted call applying scattered tombstone/liveness updates at
+    ``idx`` (group is immutable after append, so only valid/deleted)."""
+    global _MASK_SCATTER
+    if _MASK_SCATTER is None:
+        import jax
+
+        def scatter(masks, idx, vvals, dvals):
+            valid, deleted, group = masks
+            return (valid.at[idx].set(vvals),
+                    deleted.at[idx].set(dvals), group)
+
+        _MASK_SCATTER = jax.jit(scatter, donate_argnums=(0,))
+    return _MASK_SCATTER
 
 
 _TREE_UPDATER = None

@@ -107,6 +107,8 @@ class ShardedDeviceCorpus(DeviceCorpus):
         self._placer = LeadingAxisPlacer(mesh, mesh.size * _CHUNK)
         self.granule = self._placer.granule
         self._updater_fn = None
+        self._mask_updater_fn = None
+        self._mask_scatter_fn = None
 
     def _sharding(self, ndim: int):
         return self._placer._sharding(ndim)
@@ -141,6 +143,46 @@ class ShardedDeviceCorpus(DeviceCorpus):
 
             self._updater_fn = jax.jit(update_tree, donate_argnums=(0,))
         return self._updater_fn
+
+    def _mask_updater(self):
+        """Sharding-constrained mask-slice updater (see _updater)."""
+        if self._mask_updater_fn is None:
+            import jax
+            from jax import lax
+
+            def update_masks(masks, upd, start):
+                out = tuple(
+                    lax.dynamic_update_slice_in_dim(m, u, start, axis=0)
+                    for m, u in zip(masks, upd)
+                )
+                return tuple(
+                    lax.with_sharding_constraint(m, self._sharding(1))
+                    for m in out
+                )
+
+            self._mask_updater_fn = jax.jit(
+                update_masks, donate_argnums=(0,)
+            )
+        return self._mask_updater_fn
+
+    def _mask_scatter(self):
+        """Sharding-constrained tombstone scatter (see _updater)."""
+        if self._mask_scatter_fn is None:
+            import jax
+            from jax import lax
+
+            def scatter(masks, idx, vvals, dvals):
+                valid, deleted, group = masks
+                out = (valid.at[idx].set(vvals),
+                       deleted.at[idx].set(dvals))
+                out = tuple(
+                    lax.with_sharding_constraint(m, self._sharding(1))
+                    for m in out
+                )
+                return out + (group,)
+
+            self._mask_scatter_fn = jax.jit(scatter, donate_argnums=(0,))
+        return self._mask_scatter_fn
 
 
 class _ShardedScorerCache(_ScorerCache):
