@@ -35,17 +35,22 @@ CORPUS = int(os.environ.get("BENCH_CORPUS", "20000"))
 # a single-sample bench cannot distinguish a 10% regression from the
 # documented host/tunnel variance); per-run rates ride the stderr line
 BENCH_RUNS = int(os.environ.get("BENCH_RUNS", "3"))
-# pre-size the corpus so the three timed batches (appended then
-# tombstoned) never cross a capacity doubling — growth inside the timed
-# region would recompile the scorer mid-measurement
-os.environ.setdefault("DEVICE_INITIAL_CAPACITY", "131072")
+QUERIES = int(os.environ.get("BENCH_QUERIES", "8192"))
+# pre-size the corpus so the warm-up and timed batches (appended then
+# tombstoned — tombstones still occupy rows) never cross a capacity
+# doubling: growth inside the timed region re-uploads the corpus and
+# recompiles the scorer mid-measurement (observed as run 1 fast, runs
+# 2-3 slow at BENCH_CORPUS=100000)
+os.environ.setdefault(
+    "DEVICE_INITIAL_CAPACITY",
+    str(max(131072, CORPUS + (2 + BENCH_RUNS + 1) * QUERIES)),
+)
 # BENCH_BACKEND selects the scoring backend: "device" (single-chip brute
 # force, the default/headline), "sharded-brute" (the same exact scoring
 # over a jax.sharding.Mesh — on a 1-device mesh this measures the
 # shard_map dispatch overhead of the flagship serving configuration), or
 # "ann"/"sharded" (embedding-ANN blocking, single-chip / mesh)
 BACKEND = os.environ.get("BENCH_BACKEND", "device")
-QUERIES = int(os.environ.get("BENCH_QUERIES", "8192"))
 CPU_SAMPLE_PAIRS = int(os.environ.get("BENCH_CPU_PAIRS", "20000"))
 
 
