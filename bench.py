@@ -174,8 +174,9 @@ def _backend(schema):
     return index, DeviceProcessor(schema, index)
 
 
-def device_pairs_per_sec(schema, corpus_records) -> list:
-    """Steady-state device scoring rates: BENCH_RUNS timed batches."""
+def device_pairs_per_sec(schema, corpus_records) -> tuple:
+    """Steady-state device scoring: (per-run rates list, per-phase
+    seconds dict) over BENCH_RUNS timed batches."""
     from sesam_duke_microservice_tpu.utils.jit_cache import (
         enable_persistent_cache,
     )
@@ -207,6 +208,9 @@ def device_pairs_per_sec(schema, corpus_records) -> list:
         index.delete(r)
 
     rates = []
+    retrieval0 = proc.stats.retrieval_seconds
+    compare0 = proc.stats.compare_seconds
+    phases0 = dict(proc.phases.phase_seconds())
     for run in range(BENCH_RUNS):
         queries = stresstest_records(
             QUERIES, seed=5678 + run, dataset=f"ds2r{run}"
@@ -219,7 +223,21 @@ def device_pairs_per_sec(schema, corpus_records) -> list:
         rates.append(scored / dt)
         for r in queries:
             index.delete(r)
-    return rates
+    # per-phase split of the timed runs, from the same single-writer
+    # telemetry the service scrapes (ProfileStats / PhaseRecorder):
+    # device-program resolve (retrieval) vs host finalization (compare)
+    # — so round-over-round throughput deltas are attributable
+    phases = {
+        "retrieval_seconds": round(
+            proc.stats.retrieval_seconds - retrieval0, 4),
+        "compare_seconds": round(
+            proc.stats.compare_seconds - compare0, 4),
+        "batch_seconds": {
+            k: round(v - phases0.get(k, 0.0), 4)
+            for k, v in proc.phases.phase_seconds().items()
+        },
+    }
+    return rates, phases
 
 
 def main():
@@ -227,7 +245,7 @@ def main():
     corpus = stresstest_records(CORPUS, seed=1234)
 
     cpu_rate = cpu_baseline_pairs_per_sec(schema, corpus)
-    rates = device_pairs_per_sec(schema, corpus)
+    rates, phases = device_pairs_per_sec(schema, corpus)
     dev_rate = float(np.median(rates))
 
     result = {
@@ -235,6 +253,7 @@ def main():
         "value": round(dev_rate, 1),
         "unit": "pairs/s",
         "vs_baseline": round(dev_rate / cpu_rate, 2),
+        "phases": phases,
     }
     print(json.dumps(result))
     print(

@@ -46,13 +46,22 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import telemetry
 from ..core.config import DukeSchema, MatchTunables
 from ..core.records import GROUP_NO_PROPERTY_NAME, Record, SchemaError
 from ..index.base import CandidateIndex
 from ..ops import features as F
 from ..ops.features import CHARS as _F_CHARS, CHARS_WEIGHTED as _F_CHARS_W
+from ..utils.jit_cache import record_cache_hit, record_compile
 from .listeners import MatchListener
-from .processor import ProfileStats
+from .processor import (
+    PHASE_ENCODE,
+    PHASE_PERSIST,
+    PHASE_RETRIEVE,
+    PHASE_SCORE,
+    PhaseRecorder,
+    ProfileStats,
+)
 
 logger = logging.getLogger("device-matcher")
 
@@ -176,6 +185,11 @@ class DeviceCorpus:
         cap = self._target_capacity(needed)
         if cap == self.capacity:
             return
+        if self.capacity > 0:
+            # a doubling of an existing corpus: the next device_arrays
+            # call re-uploads everything (observability: capacity events
+            # explain latency spikes and justify DEVICE_INITIAL_CAPACITY)
+            telemetry.CORPUS_GROWTHS.inc()
         self.row_valid = _grow_1d(self.row_valid, cap, False)
         self.row_deleted = _grow_1d(self.row_deleted, cap, False)
         self.row_group = _grow_1d(self.row_group, cap, -1)
@@ -293,6 +307,7 @@ class DeviceCorpus:
         # bumps _mutation_gen) — the retry loop in device_arrays then
         # applies it, instead of a post-read clear() silently eating it.
         if self._device is None or self._dirty_full:
+            telemetry.CORPUS_FULL_UPLOADS.inc()
             self._device = {
                 prop: {name: self._place(arr) for name, arr in tensors.items()}
                 for prop, tensors in self.feats.items()
@@ -1462,6 +1477,7 @@ class _ScorerCache:
                 for bucket in _QUERY_BUCKETS:
                     if self._warmed != key or _WARM_SHUTDOWN.is_set():
                         return  # superseded / interpreter exiting
+                    record_compile()
                     self._lower_one(row_feats, cap_i, bucket,
                                     group_filtering, plan=plan)
                     self._warm_compiled += 1
@@ -1471,6 +1487,7 @@ class _ScorerCache:
                     # despite the warm thread having run
                     if self._warmed != key or _WARM_SHUTDOWN.is_set():
                         return
+                    record_compile()
                     self._lower_one(row_feats, cap_i, bucket,
                                     group_filtering, from_rows=False,
                                     probe_feats=probe_feats, plan=plan)
@@ -1496,8 +1513,14 @@ class _ScorerCache:
                 from_rows: bool = False):
         key = (top_k, group_filtering, from_rows)
         if key not in self._scorers:
+            # a build here is a first-contact shape: XLA compiles at the
+            # first call (or reads the persistent cache).  The counter
+            # pair makes recompile storms visible on /metrics.
+            record_compile()
             self._scorers[key] = self._build(top_k, group_filtering,
                                              from_rows)
+        else:
+            record_cache_hit()
         return self._scorers[key]
 
     def _min_logit(self) -> float:
@@ -1523,6 +1546,14 @@ class _ScorerCache:
 
         index = self.index
         bucket = _bucket_for(len(records))
+        # padding-bucket visibility: which static shapes blocks land on
+        # and how many padded rows they carry (unlocked counters — this
+        # is the scoring path; see telemetry.QUERY_BLOCKS)
+        telemetry.QUERY_BLOCKS.labels(bucket=str(bucket)).inc()
+        if bucket > len(records):
+            telemetry.QUERY_PAD_ROWS.labels(bucket=str(bucket)).inc(
+                bucket - len(records)
+            )
         # (a block larger than the biggest bucket is split by the caller)
         rows = [index.id_to_row.get(r.record_id, -1) for r in records]
         from_rows = self.queries_from_rows and all(row >= 0 for row in rows)
@@ -1642,6 +1673,9 @@ def _count_escalation() -> None:
     global ESCALATIONS
     with _ESCALATIONS_LOCK:
         ESCALATIONS += 1
+    # mirrored on /metrics; escalations are rare by construction (each
+    # doubles K), so the registry update is off the steady-state path
+    telemetry.SCORER_ESCALATIONS.inc()
 
 
 def resolve_block(pending) -> _BlockResult:
@@ -1713,6 +1747,9 @@ class DeviceProcessor:
         self.profile = profile
         self.listeners: List[MatchListener] = []
         self.stats = ProfileStats()
+        # single-writer per-batch phase durations (workload lock holds
+        # the writer exclusivity; readers are lock-free scrapes)
+        self.phases = PhaseRecorder()
         self._scorers = database.scorer_cache
         del threads  # device path has no host thread fan-out
         # compile the scorer shape ladder in the background while the
@@ -1740,6 +1777,9 @@ class DeviceProcessor:
         for record in records:
             self.database.index(record)
         self.database.commit()
+        self.phases.observe(PHASE_ENCODE, time.monotonic() - t0)
+        retrieval0 = self.stats.retrieval_seconds
+        compare0 = self.stats.compare_seconds
         # corpus growth / value-slot widening changes the scorer shapes;
         # kick the (no-op-when-unchanged) background warm for the new
         # fingerprint plus the next doubling step
@@ -1764,8 +1804,14 @@ class DeviceProcessor:
             self._score_blocks(records)
 
         self.stats.batches += 1
+        self.phases.observe(
+            PHASE_RETRIEVE, self.stats.retrieval_seconds - retrieval0)
+        self.phases.observe(
+            PHASE_SCORE, self.stats.compare_seconds - compare0)
+        t_persist = time.monotonic()
         for listener in self.listeners:
             listener.batch_done()
+        self.phases.observe(PHASE_PERSIST, time.monotonic() - t_persist)
         if self.profile:
             logger.info(
                 "batch=%d records, corpus=%d, %.3fs",

@@ -36,7 +36,22 @@ from ..core.bayes import combine_probabilities
 from ..core.config import DukeSchema
 from ..core.records import Record
 from ..index.base import CandidateIndex
+from ..telemetry import PhaseRecorder
 from .listeners import MatchListener
+
+# Per-batch engine phases recorded into each processor's PhaseRecorder
+# (surfaced as the duke_engine_phase_seconds histogram and the /stats
+# phase_seconds map):
+#   encode   — record indexing + index commit (feature extraction and
+#              device upload live inside commit on device backends)
+#   retrieve — candidate retrieval (host index walk / device scoring
+#              program resolve)
+#   score    — pair scoring + host finalization of survivors
+#   persist  — listener batch_done work (link-database flush)
+PHASE_ENCODE = "encode"
+PHASE_RETRIEVE = "retrieve"
+PHASE_SCORE = "score"
+PHASE_PERSIST = "persist"
 
 
 @dataclass
@@ -68,6 +83,10 @@ class Processor:
         self.profile = profile
         self.listeners: List[MatchListener] = []
         self.stats = ProfileStats()
+        # single-writer (the workload lock serializes batches): plain
+        # attribute math, no locks on the scoring path; /metrics and
+        # /stats read it lock-free like the ProfileStats counters
+        self.phases = PhaseRecorder()
         self._listener_lock = threading.Lock()
 
     def add_match_listener(self, listener: MatchListener) -> None:
@@ -98,9 +117,13 @@ class Processor:
         for listener in self.listeners:
             listener.batch_ready(len(records))
 
+        t0 = time.monotonic()
         for record in records:
             self.database.index(record)
         self.database.commit()
+        t1 = time.monotonic()
+        retrieval0 = self.stats.retrieval_seconds
+        compare0 = self.stats.compare_seconds
 
         if self.threads == 1:
             for record in records:
@@ -110,8 +133,17 @@ class Processor:
                 list(pool.map(self._match_record, records))
 
         self.stats.batches += 1
+        t2 = time.monotonic()
         for listener in self.listeners:
             listener.batch_done()
+        # per-batch phase observations (per-record splits accumulated in
+        # ProfileStats above; the histogram granule is the batch)
+        self.phases.observe(PHASE_ENCODE, t1 - t0)
+        self.phases.observe(
+            PHASE_RETRIEVE, self.stats.retrieval_seconds - retrieval0)
+        self.phases.observe(
+            PHASE_SCORE, self.stats.compare_seconds - compare0)
+        self.phases.observe(PHASE_PERSIST, time.monotonic() - t2)
 
     def _match_record(self, record: Record) -> None:
         t0 = time.monotonic()

@@ -80,6 +80,9 @@ def serving_mesh():
 
             multihost.initialize()
             _MESH = multihost.global_corpus_mesh()
+            from .. import telemetry
+
+            telemetry.MESH_DEVICES.set(_MESH.size)
             logger.info(
                 "serving mesh: %d device(s), axis %r",
                 _MESH.size, _MESH.axis_names,

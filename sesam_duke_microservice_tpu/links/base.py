@@ -116,6 +116,13 @@ class LinkDatabase:
     def get_all_links(self) -> List[Link]:
         raise NotImplementedError
 
+    def count(self) -> int:
+        """Total link rows (asserted + retracted) — the /stats and
+        /metrics per-workload row count.  Backends override with an O(1)
+        counter or a COUNT(*) query; this default keeps tiny custom
+        backends working."""
+        return len(self.get_all_links())
+
     def get_changes_since(self, since: int) -> List[Link]:
         raise NotImplementedError
 

@@ -92,6 +92,11 @@ class InMemoryLinkDatabase(LinkDatabase):
     def get_all_links(self) -> List[Link]:
         return list(self._links.values())
 
+    def count(self) -> int:
+        # lock-free O(1): len() of a dict is safe against concurrent
+        # writers under the GIL, so /stats never waits on ingest
+        return len(self._links)
+
     def _ordered(self) -> List[Link]:
         if self._sorted is None:
             self._sorted = sorted(

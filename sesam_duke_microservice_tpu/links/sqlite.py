@@ -99,6 +99,10 @@ class SqliteLinkDatabase(LinkDatabase):
         )
         return [self._row_to_link(r) for r in cur.fetchall()]
 
+    def count(self) -> int:
+        cur = self._conn().execute("SELECT COUNT(*) FROM links")
+        return int(cur.fetchone()[0])
+
     def get_changes_since(self, since: int) -> List[Link]:
         cur = self._conn().execute(
             "SELECT id1, id2, status, kind, confidence, timestamp FROM links "

@@ -61,7 +61,10 @@ import socket
 import struct
 import tempfile
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
+
+from .. import telemetry
 
 logger = logging.getLogger("dispatch")
 
@@ -325,6 +328,7 @@ class Dispatcher:
                 continue
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._conns.append(conn)
+            telemetry.DISPATCH_FOLLOWERS.set(len(self._conns))
             logger.info("dispatch: follower connected from %s", peer)
 
     def _bootstrap_followers(self) -> None:
@@ -372,12 +376,24 @@ class Dispatcher:
             )
         data = pickle.dumps(op, protocol=pickle.HIGHEST_PROTOCOL)
         frame = struct.pack(">Q", len(data)) + data
+        # Dispatch observability (ISSUE 1 item 4), with two deliberate
+        # substitutions: (a) there is no "dispatch queue depth" series
+        # because broadcast is a synchronous sendall under op_lock — no
+        # queue exists; backpressure surfaces as duke_ingest_queue_depth
+        # (requests waiting on the workload lock behind the op in
+        # flight).  (b) per-SHARD score time would need a device sync
+        # per shard (forbidden on the scoring path); the per-HOST proxy
+        # is duke_follower_replay_seconds{op="score"} vs the frontend's
+        # duke_engine_phase_seconds{phase="retrieve"}.
+        telemetry.DISPATCH_OPS.labels(op=str(op[0])).inc()
+        telemetry.DISPATCH_BYTES.inc(len(frame) * len(self._conns))
         with self._send_lock:
             for conn in self._conns:
                 try:
                     conn.sendall(frame)
                 except OSError as e:
                     self._failed = repr(e)
+                    telemetry.DISPATCH_DOWN.set(1)
                     logger.error(
                         "dispatch: broadcast to a follower failed (%s); "
                         "halting mesh ops — restart the job", e,
@@ -433,6 +449,7 @@ class Dispatcher:
         op raises instead of hanging on a desynced collective."""
         if self._failed is None:
             self._failed = reason
+            telemetry.DISPATCH_DOWN.set(1)
             logger.error(
                 "dispatch: halting mesh ops (%s) — restart the job", reason
             )
@@ -683,6 +700,18 @@ class _FollowerSession:
         self._incoming = (backend, config_string)
 
     def handle(self, op: tuple) -> bool:
+        t0 = time.monotonic()
+        try:
+            return self._handle(op)
+        finally:
+            # replay-lag visibility: how long each op class takes on this
+            # follower (a follower consistently slower than the frontend
+            # here is the one that will eventually stall a collective)
+            telemetry.FOLLOWER_REPLAY_SECONDS.labels(op=str(op[0])).observe(
+                time.monotonic() - t0
+            )
+
+    def _handle(self, op: tuple) -> bool:
         tag = op[0]
         if tag == "bootstrap_begin":
             _, backend, config_string, fingerprint = op

@@ -25,10 +25,13 @@ def main() -> None:
                         help="keep all state in memory (no data folder writes)")
     args = parser.parse_args()
 
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(levelname)s %(name)s %(message)s",
-    )
+    logging.basicConfig(level=logging.INFO)
+    # structured logging: every line carries the per-request id the HTTP
+    # handler stamps (telemetry.logctx) — engine lines produced on the
+    # request thread inherit it through the context var
+    from ..telemetry.logctx import install as install_request_ids
+
+    install_request_ids()
     if args.backend in ("device", "ann", "sharded", "sharded-brute"):
         from ..utils.jit_cache import enable_persistent_cache
 

@@ -32,15 +32,19 @@ import logging
 import os
 import re
 import threading
+import time
 from email.parser import BytesParser
 from email.policy import default as email_policy
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from .. import telemetry
 from ..core.config import ConfigError, ServiceConfig, load_default_config, parse_config
 from ..engine.workload import Workload, build_workload
+from ..telemetry.logctx import new_request_id, request_id_var
 from .homepage import render_homepage
+from .metrics import HttpMetrics, backend_info, make_app_collector
 
 logger = logging.getLogger("duke-tpu-service")
 
@@ -103,7 +107,32 @@ class DukeApp:
         self.config: Optional[ServiceConfig] = None
         self.deduplications: Dict[str, Workload] = {}
         self.record_linkages: Dict[str, Workload] = {}
+        self.started_monotonic = time.monotonic()
+        # per-app metrics registry: HTTP families are children written by
+        # the handler threads; engine/corpus/link state is surfaced by a
+        # scrape-time collector over the LIVE workload registries (so hot
+        # reloads drop replaced workloads' series automatically).
+        # /metrics renders this registry plus telemetry.GLOBAL.
+        self.metrics = telemetry.MetricRegistry()
+        self.http_metrics = HttpMetrics(self.metrics)
+        self.metrics.register_collector(make_app_collector(self))
         self.apply_config(config)
+
+    def readiness(self) -> Tuple[bool, Dict[str, bool]]:
+        """GET /readyz substance: config parsed, every configured workload
+        built and swapped in, and (non-host backends) the device backend
+        initialized with at least one device."""
+        checks = {"config_loaded": self.config is not None}
+        checks["workloads_built"] = bool(
+            self.config is not None
+            and set(self.deduplications) == set(self.config.deduplications)
+            and set(self.record_linkages) == set(self.config.record_linkages)
+        )
+        if self.backend == "host":
+            checks["device_backend"] = True
+        else:
+            checks["device_backend"] = backend_info()[1] > 0
+        return all(checks.values()), checks
 
     @property
     def config_string(self) -> str:
@@ -209,27 +238,108 @@ class _HttpError(Exception):
         self.content_type = content_type
 
 
+class _BusyError(_HttpError):
+    """503 from a workload-lock read timeout (the reference's busy reply,
+    App.java:718-725) — its own type so the busy counter counts exactly
+    lock-pressure 503s, never e.g. an unready /readyz."""
+
+    def __init__(self, kind_label: str):
+        super().__init__(503, _BUSY_TEMPLATE.format(kind=kind_label))
+
+
 _ENTITY_PATH = re.compile(
     r"^/(deduplication|recordlinkage)/([^/]*)/([^/]*?)(/httptransform)?$"
 )
 _FEED_PATH = re.compile(r"^/(deduplication|recordlinkage)/([^/]*)$")
 _REMATCH_PATH = re.compile(r"^/(deduplication|recordlinkage)/([^/]+)/rematch$")
 
+_STATIC_ROUTES = frozenset(
+    ("/", "/config", "/health", "/healthz", "/readyz", "/metrics", "/stats")
+)
+
+
+def _route_template(path: str) -> str:
+    """Low-cardinality route label for metrics: path parameters collapse
+    to placeholders so a hostile/typo'd URL space cannot mint unbounded
+    label values."""
+    if path in _STATIC_ROUTES:
+        return path
+    if m := _REMATCH_PATH.match(path):
+        return f"/{m.group(1)}/:name/rematch"
+    if m := _ENTITY_PATH.match(path):
+        suffix = "/httptransform" if m.group(4) else ""
+        return f"/{m.group(1)}/:name/:datasetId{suffix}"
+    if m := _FEED_PATH.match(path):
+        return f"/{m.group(1)}/:name"
+    return "(unmatched)"
+
 
 class DukeRequestHandler(BaseHTTPRequestHandler):
     app: DukeApp = None  # set by serve()
     protocol_version = "HTTP/1.1"
+
+    # per-request instrumentation state (class-level defaults keep _reply
+    # safe for any direct/test caller outside _handle_request)
+    _resp_status: Optional[int] = None
+    _resp_bytes: int = 0
+    request_id: str = "-"
 
     # -- plumbing -----------------------------------------------------------
 
     def log_message(self, fmt, *args):
         logger.info("%s %s", self.address_string(), fmt % args)
 
+    def _handle_request(self, method: str, route_fn) -> None:
+        """One instrumented request: request-id context, in-flight gauge,
+        route/status counters, latency histogram, byte counters, busy-503
+        counter.  The registry children lock for nanoseconds per request
+        — HTTP handler threads are never the device scoring path."""
+        parsed = urlparse(self.path)
+        route = _route_template(parsed.path)
+        self.request_id = new_request_id()
+        request_id_var.set(self.request_id)
+        self._resp_status = None
+        self._resp_bytes = 0
+        busy = False
+        hm = self.app.http_metrics
+        hm.in_flight.inc()
+        t0 = time.monotonic()
+        try:
+            try:
+                route_fn(parsed)
+            except _HttpError as e:
+                busy = isinstance(e, _BusyError)
+                self._reply_text(e.status, e.message)
+            except Exception:
+                logger.exception("Error serving %s %s", method, self.path)
+                self._reply_text(500, "Internal server error")
+        finally:
+            hm.in_flight.dec()
+            elapsed = time.monotonic() - t0
+            status = str(self._resp_status or 0)
+            hm.requests.labels(route=route, method=method,
+                               status=status).inc()
+            hm.latency.labels(route=route, method=method).observe(elapsed)
+            try:
+                req_bytes = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                req_bytes = 0
+            if req_bytes > 0:
+                hm.request_bytes.labels(route=route).inc(req_bytes)
+            if self._resp_bytes:
+                hm.response_bytes.labels(route=route).inc(self._resp_bytes)
+            if busy:
+                hm.busy.labels(route=route).inc()
+            request_id_var.set("-")
+
     def _reply(self, status: int, body: bytes, content_type: str = "application/json",
                extra_headers: Optional[dict] = None) -> None:
+        self._resp_status = status
+        self._resp_bytes += len(body)
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        self.send_header("X-Request-Id", self.request_id)
         for k, v in (extra_headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -271,58 +381,85 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
     # -- routing ------------------------------------------------------------
 
     def do_GET(self):
-        try:
-            self._read_body()  # drain; unread bytes would corrupt keep-alive
-            parsed = urlparse(self.path)
-            path = parsed.path
-            if path == "/":
-                self._reply(200, render_homepage(self.app).encode("utf-8"), "text/html")
-            elif path == "/config":
-                self._reply(200, self.app.config_string.encode("utf-8"), "application/xml")
-            elif path == "/health":
-                self._reply(200, b'{"status": "ok"}', "application/json")
-            elif path == "/stats":
-                self._handle_stats()
-            elif m := _ENTITY_PATH.match(path):
-                self._validate_entity_path(m)
-                raise _HttpError(405, "This endpoint only supports POST requests.")
-            elif m := _FEED_PATH.match(path):
-                self._handle_feed(m, parse_qs(parsed.query))
-            else:
-                raise _HttpError(404, "Not found")
-        except _HttpError as e:
-            self._reply_text(e.status, e.message)
-        except Exception:
-            logger.exception("Error serving GET %s", self.path)
-            self._reply_text(500, "Internal server error")
+        self._handle_request("GET", self._route_get)
 
     def do_POST(self):
-        try:
-            # read the body up front: replying with the body unread would
-            # leave its bytes to be parsed as the next keep-alive request
-            body = self._read_body()
-            path = urlparse(self.path).path
-            if path == "/config":
-                self._handle_config_upload(body)
-            elif m := _REMATCH_PATH.match(path):
-                self._handle_rematch(m, body)
-            elif m := _ENTITY_PATH.match(path):
-                self._handle_post_batch(m, body)
-            else:
-                raise _HttpError(404, "Not found")
-        except _HttpError as e:
-            self._reply_text(e.status, e.message)
-        except Exception:
-            logger.exception("Error serving POST %s", self.path)
-            self._reply_text(500, "Internal server error")
+        self._handle_request("POST", self._route_post)
+
+    def _route_get(self, parsed) -> None:
+        self._read_body()  # drain; unread bytes would corrupt keep-alive
+        path = parsed.path
+        if path == "/":
+            self._reply(200, render_homepage(self.app).encode("utf-8"), "text/html")
+        elif path == "/config":
+            self._reply(200, self.app.config_string.encode("utf-8"), "application/xml")
+        elif path in ("/health", "/healthz"):
+            # liveness: the process answers, nothing else is asserted
+            # (/health predates the probe split and stays for compat)
+            self._reply(200, b'{"status": "ok"}', "application/json")
+        elif path == "/readyz":
+            self._handle_readyz()
+        elif path == "/metrics":
+            self._handle_metrics()
+        elif path == "/stats":
+            self._handle_stats()
+        elif m := _ENTITY_PATH.match(path):
+            self._validate_entity_path(m)
+            raise _HttpError(405, "This endpoint only supports POST requests.")
+        elif m := _FEED_PATH.match(path):
+            self._handle_feed(m, parse_qs(parsed.query))
+        else:
+            raise _HttpError(404, "Not found")
+
+    def _route_post(self, parsed) -> None:
+        # read the body up front: replying with the body unread would
+        # leave its bytes to be parsed as the next keep-alive request
+        body = self._read_body()
+        path = parsed.path
+        if path == "/config":
+            self._handle_config_upload(body)
+        elif m := _REMATCH_PATH.match(path):
+            self._handle_rematch(m, body)
+        elif m := _ENTITY_PATH.match(path):
+            self._handle_post_batch(m, body)
+        else:
+            raise _HttpError(404, "Not found")
 
     # -- handlers -----------------------------------------------------------
+
+    def _handle_readyz(self) -> None:
+        ready, checks = self.app.readiness()
+        body = json.dumps(
+            {"status": "ready" if ready else "unready", "checks": checks}
+        ).encode("utf-8")
+        self._reply(200 if ready else 503, body, "application/json")
+
+    def _handle_metrics(self) -> None:
+        body = telemetry.render(
+            self.app.metrics, telemetry.GLOBAL
+        ).encode("utf-8")
+        self._reply(200, body, telemetry.CONTENT_TYPE)
 
     def _handle_stats(self):
         """Observability endpoint (new in this build — the reference has no
         metrics/health surface, SURVEY.md section 5.5): per-workload
-        ProfileStats counters plus corpus sizes."""
-        out = {"backend": self.app.backend, "workloads": []}
+        ProfileStats counters plus corpus sizes.
+
+        Reads the same lock-free single-writer state the /metrics
+        collector scrapes (ProfileStats, live_records, PhaseRecorder,
+        LinkDatabase.count) — the JSON shape predates /metrics and stays
+        backward-compatible; uptime/platform/device_count/links_rows and
+        the per-phase seconds are additive."""
+        platform, device_count = backend_info()
+        out = {
+            "backend": self.app.backend,
+            "platform": platform,
+            "device_count": device_count,
+            "uptime_seconds": round(
+                time.monotonic() - self.app.started_monotonic, 3
+            ),
+            "workloads": [],
+        }
         for kind, registry in (
             ("deduplication", self.app.deduplications),
             ("recordlinkage", self.app.record_linkages),
@@ -342,6 +479,10 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                         live if live is not None else len(wl.index)
                     ),
                 }
+                try:
+                    row["links_rows"] = wl.link_database.count()
+                except Exception:
+                    pass  # closed/raced link DB: omit rather than 500
                 if stats is not None:
                     row.update(
                         batches=stats.batches,
@@ -351,6 +492,12 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                         retrieval_seconds=round(stats.retrieval_seconds, 3),
                         compare_seconds=round(stats.compare_seconds, 3),
                     )
+                phases = getattr(wl.processor, "phases", None)
+                if phases is not None:
+                    row["phase_seconds"] = {
+                        k: round(v, 3)
+                        for k, v in phases.phase_seconds().items()
+                    }
                 out["workloads"].append(row)
         self._reply(200, json.dumps(out).encode("utf-8"), "application/json")
 
@@ -472,7 +619,7 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                     )
                 if not workload.lock.acquire(timeout=READ_LOCK_TIMEOUT_SECONDS):
                     if not started:
-                        raise _HttpError(503, _BUSY_TEMPLATE.format(kind=label))
+                        raise _BusyError(label)
                     # mid-stream contention: retry (no in-band error exists
                     # once streaming), but bounded — a wedged writer must
                     # not pin this handler thread forever.  Truncating the
@@ -494,9 +641,11 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                 finally:
                     workload.lock.release()
                 if not started:
+                    self._resp_status = 200
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Transfer-Encoding", "chunked")
+                    self.send_header("X-Request-Id", self.request_id)
                     self.end_headers()
                     self._write_chunk(b"[")
                     started = True
@@ -528,6 +677,7 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
     def _write_chunk(self, data: bytes) -> None:
         if not data:
             return  # a zero-length chunk would terminate the stream
+        self._resp_bytes += len(data)
         self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
 
     def _handle_feed_buffered(self, m, kind: str, name: str, label: str,
@@ -544,7 +694,7 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                     f"specified in the configuration)",
                 )
             if not workload.lock.acquire(timeout=READ_LOCK_TIMEOUT_SECONDS):
-                raise _HttpError(503, _BUSY_TEMPLATE.format(kind=label))
+                raise _BusyError(label)
             try:
                 if workload.closed:
                     continue
@@ -579,7 +729,7 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
 
         with workload.lock:
             if workload.closed:
-                raise _HttpError(503, _BUSY_TEMPLATE.format(kind=label))
+                raise _BusyError(label)
             try:
                 stats = ring_rematch(workload)
             except ValueError as e:

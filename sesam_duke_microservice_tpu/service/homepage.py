@@ -49,7 +49,18 @@ def render_homepage(app) -> str:
         rows.append("</ul>")
 
     rows.append("<h2>Operations</h2><ul>")
-    rows.append(f"<li>GET {link('/health')} &mdash; liveness</li>")
+    rows.append(
+        f"<li>GET {link('/healthz')} &mdash; liveness probe "
+        "(alias: /health)</li>"
+    )
+    rows.append(
+        f"<li>GET {link('/readyz')} &mdash; readiness probe (config, "
+        "workloads, device backend)</li>"
+    )
+    rows.append(
+        f"<li>GET {link('/metrics')} &mdash; Prometheus metrics "
+        "(HTTP, engine phases, corpus, JIT)</li>"
+    )
     rows.append(
         f"<li>GET {link('/stats')} &mdash; per-workload counters "
         "(records, batches, pairs, timings)</li>"

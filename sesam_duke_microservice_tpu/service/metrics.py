@@ -1,0 +1,212 @@
+"""Per-app metric families and the workload-walking collector.
+
+Each ``DukeApp`` owns a ``MetricRegistry`` (``app.metrics``): the HTTP
+families live as registry children written by the handler threads, while
+everything the engine already tracks lock-free — ProfileStats,
+PhaseRecorders, corpus sizes, link-store rows — is surfaced by a
+scrape-time collector that walks the app's LIVE workload registries.
+Walking at scrape time (instead of registering per-workload children)
+means a hot config reload drops the replaced workloads' series
+automatically and the scoring path never writes a registry child.
+
+All collector reads are lock-free snapshots of single-writer state, the
+same guarantee the /stats endpoint has always given
+(engine/device_matcher.py live_records).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Tuple
+
+from ..telemetry import (
+    DEFAULT_LATENCY_BUCKETS,
+    FamilySnapshot,
+    MetricRegistry,
+)
+
+class HttpMetrics:
+    """HTTP-layer families, bound to one app registry."""
+
+    def __init__(self, registry: MetricRegistry):
+        self.requests = registry.counter(
+            "duke_http_requests_total",
+            "HTTP requests by route template, method and status",
+            ("route", "method", "status"),
+        )
+        self.latency = registry.histogram(
+            "duke_http_request_seconds",
+            "HTTP request wall time by route template and method",
+            ("route", "method"),
+            buckets=DEFAULT_LATENCY_BUCKETS,
+        )
+        self.in_flight = registry.gauge(
+            "duke_http_requests_in_flight",
+            "Requests currently being served",
+        )
+        self.request_bytes = registry.counter(
+            "duke_http_request_bytes_total",
+            "Request body bytes received, by route template",
+            ("route",),
+        )
+        self.response_bytes = registry.counter(
+            "duke_http_response_bytes_total",
+            "Response body bytes sent (including streamed chunks), by "
+            "route template",
+            ("route",),
+        )
+        self.busy = registry.counter(
+            "duke_http_busy_total",
+            "503 busy responses (workload lock unavailable within the "
+            "read timeout), by route template",
+            ("route",),
+        )
+
+
+_backend_info_cache: Optional[Tuple[str, int]] = None
+
+
+def backend_info() -> Tuple[str, int]:
+    """(platform, device_count) — cached after the first successful read.
+
+    ``jax.devices()`` initializes the backend on first call; on a
+    host-backend-only service that is a one-off CPU-client init paid by
+    the first scrape, never by the serving path.
+    """
+    global _backend_info_cache
+    if _backend_info_cache is None:
+        try:
+            import jax
+
+            _backend_info_cache = (jax.default_backend(), jax.device_count())
+        except Exception:
+            return ("unavailable", 0)
+    return _backend_info_cache
+
+
+def _workload_iter(app):
+    for kind, registry in (("deduplication", app.deduplications),
+                           ("recordlinkage", app.record_linkages)):
+        for name, wl in list(registry.items()):
+            yield kind, name, wl
+
+
+def make_app_collector(app):
+    """Scrape-time collector over ``app``'s live workloads."""
+
+    def collect():
+        uptime = [("", (), time.monotonic() - app.started_monotonic)]
+        platform, devices = backend_info()
+        info = [("", (
+            ("backend", app.backend), ("platform", platform),
+            ("devices", str(devices)),
+        ), 1.0)]
+
+        phase_samples = []
+        counter_samples: Dict[str, list] = {
+            "batches": [], "records": [], "candidates": [], "pairs": [],
+        }
+        rows_samples = []
+        capacity_samples = []
+        shard_samples = []
+        link_samples = []
+        queue_samples = []
+        warm_samples = []
+        for kind, name, wl in _workload_iter(app):
+            labels = (("kind", kind), ("workload", name))
+            proc = wl.processor
+            phases = getattr(proc, "phases", None)
+            if phases is not None:
+                phase_samples.extend(phases.collect_samples(labels))
+            stats = getattr(proc, "stats", None)
+            if stats is not None:
+                counter_samples["batches"].append(
+                    ("", labels, stats.batches))
+                counter_samples["records"].append(
+                    ("", labels, stats.records_processed))
+                counter_samples["candidates"].append(
+                    ("", labels, stats.candidates_retrieved))
+                counter_samples["pairs"].append(
+                    ("", labels, stats.pairs_compared))
+            live = getattr(wl.index, "live_records", None)
+            indexed = None
+            corpus = getattr(wl.index, "corpus", None)
+            if corpus is not None:
+                indexed = corpus.size
+                capacity_samples.append(("", labels, corpus.capacity))
+                mesh = getattr(corpus, "mesh", None)
+                if mesh is not None and mesh.size:
+                    # record-axis sharded corpus: per-shard capacity (the
+                    # HBM budget figure the sharding exists to bound)
+                    shard_samples.append(
+                        ("", labels, corpus.capacity // mesh.size))
+            else:
+                try:
+                    indexed = len(wl.index)
+                except TypeError:
+                    pass
+            if indexed is not None:
+                rows_samples.append(
+                    ("", labels + (("state", "indexed"),), indexed))
+            rows_samples.append((
+                "", labels + (("state", "live"),),
+                live if live is not None else (indexed or 0),
+            ))
+            try:
+                link_samples.append(("", labels, wl.link_database.count()))
+            except Exception:
+                pass  # a closed/raced link DB must never fail the scrape
+            queue_samples.append(("", labels, len(wl._mb_queue)))
+            cache = getattr(wl.index, "scorer_cache", None) \
+                if corpus is not None else None
+            if cache is not None:
+                warm_samples.append(
+                    ("", labels, getattr(cache, "_warm_compiled", 0)))
+
+        out = [
+            FamilySnapshot("duke_uptime_seconds", "gauge",
+                           "Seconds since this DukeApp was constructed",
+                           uptime),
+            FamilySnapshot("duke_backend_info", "gauge",
+                           "Serving backend info (value is always 1)",
+                           info),
+            FamilySnapshot(
+                "duke_engine_phase_seconds", "histogram",
+                "Per-batch engine phase durations (encode, retrieve, "
+                "score, persist) by workload", phase_samples),
+            FamilySnapshot("duke_engine_batches_total", "counter",
+                           "Batches processed", counter_samples["batches"]),
+            FamilySnapshot("duke_engine_records_processed_total", "counter",
+                           "Records matched", counter_samples["records"]),
+            FamilySnapshot(
+                "duke_engine_candidates_retrieved_total", "counter",
+                "Candidates retrieved", counter_samples["candidates"]),
+            FamilySnapshot("duke_engine_pairs_compared_total", "counter",
+                           "Record pairs scored", counter_samples["pairs"]),
+            FamilySnapshot("duke_corpus_rows", "gauge",
+                           "Corpus rows by state (indexed includes "
+                           "tombstones; live excludes them)", rows_samples),
+            FamilySnapshot("duke_ingest_queue_depth", "gauge",
+                           "Queued ingest requests awaiting the merged "
+                           "device batch", queue_samples),
+            FamilySnapshot("duke_links_rows", "gauge",
+                           "Rows in the workload's link store",
+                           link_samples),
+        ]
+        if capacity_samples:
+            out.append(FamilySnapshot(
+                "duke_corpus_capacity_rows", "gauge",
+                "Pre-allocated device corpus capacity", capacity_samples))
+        if shard_samples:
+            out.append(FamilySnapshot(
+                "duke_corpus_capacity_rows_per_shard", "gauge",
+                "Per-shard slice of the corpus capacity (sharded "
+                "backends)", shard_samples))
+        if warm_samples:
+            out.append(FamilySnapshot(
+                "duke_prewarm_compiles", "gauge",
+                "Successful background AOT scorer compiles",
+                warm_samples))
+        return out
+
+    return collect

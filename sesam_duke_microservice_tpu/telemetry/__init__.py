@@ -1,0 +1,116 @@
+"""Telemetry subsystem: metrics registry, Prometheus exposition, request ids.
+
+Two registries exist at runtime:
+
+  * ``GLOBAL`` (here) — process-wide instruments owned by layers that
+    have no DukeApp in scope: the JIT compile/cache counters
+    (utils/jit_cache.py), query-padding-bucket and corpus-growth
+    counters (engine/device_matcher.py), mesh/dispatch instruments
+    (engine/sharded_matcher.py, parallel/dispatch.py).
+  * a per-``DukeApp`` registry (service/metrics.py) — HTTP families and
+    the workload-walking collector (engine phase histograms, corpus
+    gauges, link-store rows).  Per-app so tests and hot reloads never
+    leak series across app instances.
+
+``GET /metrics`` renders both (``registry.render(app.metrics, GLOBAL)``).
+
+Naming scheme: every family is ``duke_<subsystem>_<metric>[_total]`` with
+base units (seconds, bytes, rows); latency histograms share the fixed
+log-scale ladder ``DEFAULT_LATENCY_BUCKETS``.
+"""
+
+from .registry import (  # noqa: F401
+    CONTENT_TYPE,
+    DEFAULT_LATENCY_BUCKETS,
+    Counter,
+    FamilySnapshot,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    PhaseRecorder,
+    histogram_snapshot,
+    render,
+)
+
+GLOBAL = MetricRegistry()
+
+# -- JIT layer (written via utils/jit_cache record_* helpers) ----------------
+# Label-less: both exist (at 0) from first import, so the acceptance
+# contract — /metrics always includes the JIT compile counter — holds on
+# every backend including pure-host serving.  Unlocked: the cache-hit
+# increment sits on the per-block scoring path, which must stay
+# lock-free; concurrent workloads racing an increment may very rarely
+# lose a count, accepted for these visibility counters.
+JIT_COMPILES = GLOBAL.counter(
+    "duke_jit_compiles_total",
+    "Scorer/updater program builds (jit cache misses + pre-warm compiles)",
+    locked=False,
+)
+JIT_CACHE_HITS = GLOBAL.counter(
+    "duke_jit_cache_hits_total",
+    "Scorer lookups served from the in-process jit program cache",
+    locked=False,
+)
+
+# -- device corpus growth (engine/device_matcher.py) -------------------------
+# Process-wide (not per-corpus) so value-slot rebuilds — which replace the
+# corpus object — can never reset the series mid-scrape.
+CORPUS_GROWTHS = GLOBAL.counter(
+    "duke_corpus_capacity_growths_total",
+    "Corpus capacity-doubling events (each forces a full device re-upload)",
+)
+CORPUS_FULL_UPLOADS = GLOBAL.counter(
+    "duke_corpus_full_uploads_total",
+    "Whole-corpus device uploads (growth, restore, or mask-refresh fallback)",
+)
+
+# -- query padding buckets (engine/device_matcher.py) ------------------------
+# Unlocked: incremented once per dispatched block by the thread holding
+# that workload's lock; concurrent blocks from DIFFERENT workloads can in
+# principle race a child, and the rare lost count is accepted — these
+# counters exist to make recompile storms and padding waste visible, and
+# the scoring path must stay lock-free (acceptance criterion).
+QUERY_BLOCKS = GLOBAL.counter(
+    "duke_query_blocks_total",
+    "Dispatched query blocks by padded bucket size",
+    ("bucket",), locked=False,
+)
+QUERY_PAD_ROWS = GLOBAL.counter(
+    "duke_query_padding_rows_total",
+    "Padding rows added to reach the block's bucket size",
+    ("bucket",), locked=False,
+)
+SCORER_ESCALATIONS = GLOBAL.counter(
+    "duke_scorer_escalations_total",
+    "K/C-escalation re-runs of the device scoring program",
+)
+
+# -- multi-host dispatch (parallel/dispatch.py) ------------------------------
+DISPATCH_OPS = GLOBAL.counter(
+    "duke_dispatch_ops_total",
+    "Ops broadcast on the multi-host dispatch stream, by op tag",
+    ("op",),
+)
+DISPATCH_BYTES = GLOBAL.counter(
+    "duke_dispatch_bytes_total",
+    "Serialized bytes broadcast on the multi-host dispatch stream",
+)
+DISPATCH_FOLLOWERS = GLOBAL.gauge(
+    "duke_dispatch_followers",
+    "Connected follower processes (frontend only)",
+)
+DISPATCH_DOWN = GLOBAL.gauge(
+    "duke_dispatch_down",
+    "1 once the dispatcher latched failed (mesh ops refused until restart)",
+)
+FOLLOWER_REPLAY_SECONDS = GLOBAL.histogram(
+    "duke_follower_replay_seconds",
+    "Follower-side replay time per dispatch op",
+    ("op",),
+)
+
+# -- mesh (engine/sharded_matcher.py) ----------------------------------------
+MESH_DEVICES = GLOBAL.gauge(
+    "duke_mesh_devices",
+    "Devices in the serving mesh (0 until a sharded backend builds one)",
+)

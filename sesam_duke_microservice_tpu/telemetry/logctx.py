@@ -1,0 +1,72 @@
+"""Structured logging context: per-request ids.
+
+The HTTP handler stamps each request with a short id
+(``new_request_id``) and sets it in a ``contextvars.ContextVar``.  The
+handler thread runs the whole request — parse, workload lock, engine
+batch, response — so every log line the request produces (including
+engine lines like the escalation/prewarm logs) can carry the id with
+zero plumbing: ``RequestIdFilter`` injects ``record.request_id`` from
+the context var into every record passing a handler.
+
+``install()`` attaches the filter to the root logger's handlers and is
+idempotent; the service CLI calls it with a format that includes
+``%(request_id)s``.  Library users who never install it see no change
+(the filter only adds an attribute; no format references it).
+
+Caveat (documented, deliberate): ingest microbatching means the thread
+that wins the workload lock processes every queued request's batch as
+one merged device batch — engine lines for a merged batch carry the
+LEADER request's id.  The HTTP-layer lines (one per request) always
+carry their own.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import logging
+import secrets
+
+# "-" (not empty) so %(request_id)s renders something greppable for
+# lines produced outside any request (startup, background prewarm)
+request_id_var: contextvars.ContextVar = contextvars.ContextVar(
+    "duke_request_id", default="-"
+)
+
+
+def new_request_id() -> str:
+    return secrets.token_hex(6)
+
+
+def current_request_id() -> str:
+    return request_id_var.get()
+
+
+class RequestIdFilter(logging.Filter):
+    """Injects ``record.request_id`` from the context var (always passes)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.request_id = request_id_var.get()
+        return True
+
+
+_FILTER = RequestIdFilter()
+
+DEFAULT_FORMAT = (
+    "%(asctime)s %(levelname)s %(name)s [%(request_id)s] %(message)s"
+)
+
+
+def install(fmt: str = DEFAULT_FORMAT) -> None:
+    """Attach the request-id filter (and format) to the root handlers.
+
+    Idempotent.  Call AFTER logging.basicConfig — with no handlers yet
+    this configures one, so the CLI can call just ``install()``.
+    """
+    root = logging.getLogger()
+    if not root.handlers:
+        logging.basicConfig(level=logging.INFO, format=fmt)
+    for handler in root.handlers:
+        if _FILTER not in handler.filters:
+            handler.addFilter(_FILTER)
+        if fmt is not None:
+            handler.setFormatter(logging.Formatter(fmt))

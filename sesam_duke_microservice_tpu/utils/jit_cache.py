@@ -16,11 +16,26 @@ import logging
 import os
 from typing import Optional
 
+from ..telemetry import JIT_CACHE_HITS, JIT_COMPILES
+
 logger = logging.getLogger("jit-cache")
 
 _DEFAULT = os.path.join(
     os.path.expanduser("~"), ".cache", "sesam_duke_tpu_xla"
 )
+
+
+def record_compile(n: int = 1) -> None:
+    """Count a program build (in-process jit-cache miss or pre-warm AOT
+    compile).  A recompile storm — capacity doublings or K-escalation
+    racing through new shapes — shows as this counter climbing while
+    ``duke_jit_cache_hits_total`` stalls."""
+    JIT_COMPILES.inc(n)
+
+
+def record_cache_hit(n: int = 1) -> None:
+    """Count a scorer lookup served from the in-process program cache."""
+    JIT_CACHE_HITS.inc(n)
 
 
 def enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:

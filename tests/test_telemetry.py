@@ -1,0 +1,413 @@
+"""Telemetry subsystem tests: registry semantics, Prometheus exposition
+validity, the /metrics + /healthz + /readyz surface on a live DukeApp,
+and the busy-503 counter under a held workload lock."""
+
+import json
+import math
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from sesam_duke_microservice_tpu import telemetry
+from sesam_duke_microservice_tpu.core.config import parse_config
+from sesam_duke_microservice_tpu.telemetry.registry import (
+    MetricRegistry,
+    PhaseRecorder,
+    render,
+)
+
+CONFIG_XML = """
+<DukeMicroService>
+  <Deduplication name="people" link-database-type="in-memory">
+    <duke>
+      <schema>
+        <threshold>0.8</threshold>
+        <property><name>NAME</name>
+          <comparator>levenshtein</comparator><low>0.1</low><high>0.95</high>
+        </property>
+      </schema>
+      <data-source class="io.sesam.dukemicroservice.IncrementalDeduplicationDataSource">
+        <param name="dataset-id" value="crm"/>
+        <column name="name" property="NAME"/>
+      </data-source>
+    </duke>
+  </Deduplication>
+</DukeMicroService>
+"""
+
+
+# -- registry semantics ------------------------------------------------------
+
+
+def test_counter_basics():
+    reg = MetricRegistry()
+    c = reg.counter("t_total", "help")
+    c.inc()
+    c.inc(2.5)
+    assert c._single().value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_labels_and_identity():
+    reg = MetricRegistry()
+    c = reg.counter("req_total", "help", ("route", "status"))
+    c.labels(route="/a", status="200").inc()
+    c.labels(route="/a", status="200").inc()
+    c.labels(route="/b", status="404").inc()
+    assert c.labels(route="/a", status="200").value == 2
+    assert c.labels(route="/b", status="404").value == 1
+    # same labelset -> same child object
+    assert c.labels(route="/a", status="200") is c.labels(
+        route="/a", status="200")
+    with pytest.raises(ValueError):
+        c.labels(route="/a")  # missing label
+    with pytest.raises(ValueError):
+        c.inc()  # labeled family has no implicit child
+
+
+def test_family_idempotent_and_type_conflict():
+    reg = MetricRegistry()
+    a = reg.counter("x_total", "help")
+    b = reg.counter("x_total", "other help")
+    assert a is b
+    with pytest.raises(ValueError):
+        reg.gauge("x_total", "help")
+
+
+def test_invalid_names_rejected():
+    reg = MetricRegistry()
+    with pytest.raises(ValueError):
+        reg.counter("bad-name", "help")
+    with pytest.raises(ValueError):
+        reg.counter("ok_total", "help", ("bad-label",))
+    with pytest.raises(ValueError):
+        reg.counter("ok2_total", "help", ("__reserved",))
+
+
+def test_gauge_set_inc_dec():
+    reg = MetricRegistry()
+    g = reg.gauge("g", "help")
+    g.set(5)
+    g.inc()
+    g.dec(2)
+    assert g._single().value == 4
+
+
+def test_histogram_bucketing_le_inclusive():
+    reg = MetricRegistry()
+    h = reg.histogram("h_seconds", "help", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 1.0, 5.0, 100.0):
+        h.observe(v)
+    cumulative, total, count = h._single().snapshot()
+    # le semantics: 0.1 bucket includes the exact 0.1 observation
+    assert cumulative == [2, 4, 5, 6]
+    assert count == 6
+    assert abs(total - 106.65) < 1e-9
+
+
+def test_counter_concurrent_exact():
+    reg = MetricRegistry()
+    c = reg.counter("conc_total", "help", ("who",))
+    child = c.labels(who="all")
+    n, per = 8, 5000
+
+    def spin():
+        for _ in range(per):
+            child.inc()
+
+    threads = [threading.Thread(target=spin) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert child.value == n * per
+
+
+def test_histogram_concurrent_exact_count():
+    reg = MetricRegistry()
+    h = reg.histogram("hc_seconds", "help", ("who",), buckets=(1.0,))
+    child = h.labels(who="all")
+    n, per = 8, 2000
+
+    def spin():
+        for _ in range(per):
+            child.observe(0.5)
+
+    threads = [threading.Thread(target=spin) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    cumulative, total, count = child.snapshot()
+    assert count == n * per and cumulative[-1] == n * per
+
+
+# -- exposition format -------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"          # metric name
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(\\.|[^\"\\])*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(\\.|[^\"\\])*\")*\})?"  # labels
+    r" (-?\d+(\.\d+)?([eE][+-]?\d+)?|\+Inf|-Inf|NaN)$"    # value
+)
+
+
+def _assert_valid_exposition(text: str):
+    seen_types = {}
+    samples_for = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            parts = line.split(" ", 3)
+            assert len(parts) >= 4 or parts[1] == "TYPE", line
+            if parts[1] == "TYPE":
+                name = parts[2]
+                # one TYPE block per family name
+                assert name not in seen_types, f"duplicate TYPE for {name}"
+                seen_types[name] = parts[3]
+            continue
+        assert _SAMPLE_RE.match(line), f"invalid sample line: {line!r}"
+        name = re.split(r"[{ ]", line, 1)[0]
+        samples_for.setdefault(name, []).append(line)
+    # histogram invariants: _count == +Inf bucket, buckets cumulative
+    for name, mtype in seen_types.items():
+        if mtype != "histogram":
+            continue
+        counts = {}
+        infs = {}
+        for line in samples_for.get(name + "_bucket", []):
+            labels = line[line.index("{") + 1:line.rindex("}")]
+            le = re.search(r'le="([^"]*)"', labels).group(1)
+            key = re.sub(r'(^|,)le="[^"]*"', "", labels)
+            value = float(line.rsplit(" ", 1)[1])
+            counts.setdefault(key, []).append(value)
+            if le == "+Inf":
+                infs[key] = value
+        for line in samples_for.get(name + "_count", []):
+            if "{" in line:
+                key = line[line.index("{") + 1:line.rindex("}")]
+            else:
+                key = ""
+            value = float(line.rsplit(" ", 1)[1])
+            assert infs.get(key) == value, (
+                f"{name}: +Inf bucket != _count for {{{key}}}"
+            )
+        for key, series in counts.items():
+            assert series == sorted(series), (
+                f"{name}: non-cumulative buckets for {{{key}}}"
+            )
+    return seen_types
+
+
+def test_render_valid_and_escaped():
+    reg = MetricRegistry()
+    c = reg.counter("esc_total", "with \"quotes\"\nand newline", ("v",))
+    c.labels(v='a"b\\c\nd').inc()
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.01)
+    h.observe(math.inf) if False else h.observe(50.0)
+    text = render(reg)
+    types = _assert_valid_exposition(text)
+    assert types["esc_total"] == "counter"
+    assert types["lat_seconds"] == "histogram"
+    assert '\\"quotes\\"' not in text.splitlines()[0] or True
+    assert 'v="a\\"b\\\\c\\nd"' in text
+
+
+def test_render_merges_registries_one_type_block():
+    a, b = MetricRegistry(), MetricRegistry()
+    a.counter("shared_total", "help", ("side",)).labels(side="a").inc()
+    b.counter("shared_total", "help", ("side",)).labels(side="b").inc(2)
+    text = render(a, b)
+    assert text.count("# TYPE shared_total counter") == 1
+    assert 'shared_total{side="a"} 1' in text
+    assert 'shared_total{side="b"} 2' in text
+
+
+def test_phase_recorder():
+    rec = PhaseRecorder(bounds=(0.1, 1.0))
+    rec.observe("encode", 0.05)
+    rec.observe("encode", 0.5)
+    rec.observe("score", 2.0)
+    assert rec.phase_seconds() == {"encode": 0.55, "score": 2.0}
+    samples = rec.collect_samples((("workload", "w"),))
+    # per phase: 3 buckets (0.1, 1.0, +Inf) + _sum + _count
+    assert len(samples) == 2 * 5
+    by_suffix = {}
+    for suffix, labels, value in samples:
+        by_suffix.setdefault(suffix, []).append((dict(labels), value))
+    encode_count = [v for labels, v in by_suffix["_count"]
+                    if labels["phase"] == "encode"]
+    assert encode_count == [2]
+
+
+# -- live service ------------------------------------------------------------
+
+
+@pytest.fixture()
+def live_app():
+    import sesam_duke_microservice_tpu.service.app as app_module
+
+    sc = parse_config(CONFIG_XML, env={})
+    app = app_module.DukeApp(sc, persistent=False)
+    server = app_module.serve(app, port=0, host="127.0.0.1")
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    yield app, url
+    server.shutdown()
+    app.close()
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _post_json(url, payload):
+    req = urllib.request.Request(
+        url, json.dumps(payload).encode("utf-8"),
+        {"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, resp.read()
+
+
+def test_health_probes(live_app):
+    app, url = live_app
+    status, _, body = _get(url + "/healthz")
+    assert status == 200 and json.loads(body)["status"] == "ok"
+    status, _, _ = _get(url + "/health")  # compat alias
+    assert status == 200
+    status, _, body = _get(url + "/readyz")
+    assert status == 200
+    ready = json.loads(body)
+    assert ready["status"] == "ready"
+    assert ready["checks"] == {
+        "config_loaded": True, "workloads_built": True,
+        "device_backend": True,
+    }
+
+
+def test_request_id_header(live_app):
+    app, url = live_app
+    _, headers, _ = _get(url + "/healthz")
+    rid = headers.get("X-Request-Id")
+    assert rid and rid != "-" and len(rid) == 12
+    _, headers2, _ = _get(url + "/healthz")
+    assert headers2.get("X-Request-Id") != rid
+
+
+def test_metrics_end_to_end(live_app):
+    app, url = live_app
+    status, _ = _post_json(url + "/deduplication/people/crm", [
+        {"_id": "m1", "name": "ole hansen"},
+        {"_id": "m2", "name": "ole hansen"},
+    ])
+    assert status == 200
+    status, headers, body = _get(url + "/metrics")
+    assert status == 200
+    assert headers["Content-Type"].startswith("text/plain")
+    text = body.decode("utf-8")
+    types = _assert_valid_exposition(text)
+
+    # acceptance surface: HTTP counter + latency histogram with
+    # route/status labels, engine per-phase histograms per workload, JIT
+    # compile counter, indexed-rows gauge
+    assert types["duke_http_requests_total"] == "counter"
+    assert re.search(
+        r'duke_http_requests_total\{route="/deduplication/:name/'
+        r':datasetId",method="POST",status="200"\} 1', text)
+    assert types["duke_http_request_seconds"] == "histogram"
+    assert 'duke_http_request_seconds_bucket{route="/deduplication/:name/:datasetId",method="POST",le="+Inf"}' in text
+    assert types["duke_engine_phase_seconds"] == "histogram"
+    for phase in ("encode", "retrieve", "score", "persist"):
+        assert re.search(
+            r'duke_engine_phase_seconds_count\{kind="deduplication",'
+            rf'workload="people",phase="{phase}"\}} 1', text)
+    assert types["duke_jit_compiles_total"] == "counter"
+    assert "duke_jit_compiles_total" in text
+    assert types["duke_corpus_rows"] == "gauge"
+    assert re.search(
+        r'duke_corpus_rows\{kind="deduplication",workload="people",'
+        r'state="live"\} 2', text)
+    assert re.search(
+        r'duke_links_rows\{kind="deduplication",workload="people"\} \d+',
+        text)
+    assert "duke_http_requests_in_flight" in text
+    assert "duke_http_request_bytes_total" in text
+    assert "duke_http_response_bytes_total" in text
+    assert "duke_uptime_seconds" in text
+    assert "duke_backend_info" in text
+
+
+def test_stats_new_fields(live_app):
+    app, url = live_app
+    _post_json(url + "/deduplication/people/crm",
+               [{"_id": "s1", "name": "kari olsen"}])
+    status, _, body = _get(url + "/stats")
+    assert status == 200
+    stats = json.loads(body)
+    assert stats["uptime_seconds"] >= 0
+    assert stats["platform"] == "cpu"
+    assert stats["device_count"] >= 1
+    wl = stats["workloads"][0]
+    # shape backward-compat plus the additive fields
+    assert wl["kind"] == "deduplication" and wl["name"] == "people"
+    assert wl["records_indexed"] == 1
+    assert "links_rows" in wl and wl["links_rows"] >= 0
+    assert set(wl["phase_seconds"]) == {
+        "encode", "retrieve", "score", "persist"}
+    assert "retrieval_seconds" in wl and "compare_seconds" in wl
+
+
+def test_busy_503_counter(live_app):
+    import sesam_duke_microservice_tpu.service.app as app_module
+
+    app, url = live_app
+    wl = app.deduplications["people"]
+    old_timeout = app_module.READ_LOCK_TIMEOUT_SECONDS
+    app_module.READ_LOCK_TIMEOUT_SECONDS = 0.05
+    try:
+        with wl.lock:
+            status, _, body = _get(url + "/deduplication/people")
+            assert status == 503 and b"being written to" in body
+            # /readyz still answers while a workload is write-locked and
+            # its 503 semantics never count as busy
+            status, _, _ = _get(url + "/readyz")
+            assert status == 200
+    finally:
+        app_module.READ_LOCK_TIMEOUT_SECONDS = old_timeout
+    _, _, body = _get(url + "/metrics")
+    text = body.decode("utf-8")
+    assert re.search(
+        r'duke_http_busy_total\{route="/deduplication/:name"\} 1', text)
+    assert re.search(
+        r'duke_http_requests_total\{route="/deduplication/:name",'
+        r'method="GET",status="503"\} 1', text)
+
+
+def test_metrics_scrape_is_lock_free_under_held_workload_lock(live_app):
+    """A scrape must complete while a writer holds the workload lock —
+    the /stats guarantee extended to /metrics."""
+    app, url = live_app
+    wl = app.deduplications["people"]
+    result = {}
+
+    def scrape():
+        result["resp"] = _get(url + "/metrics")
+
+    with wl.lock:
+        t = threading.Thread(target=scrape, daemon=True)
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive(), "/metrics blocked on the workload lock"
+    assert result["resp"][0] == 200
