@@ -176,7 +176,8 @@ def _backend(schema):
 
 def device_pairs_per_sec(schema, corpus_records) -> tuple:
     """Steady-state device scoring: (per-run rates list, per-phase
-    seconds dict) over BENCH_RUNS timed batches."""
+    seconds dict, per-run trace ids) over BENCH_RUNS timed batches."""
+    from sesam_duke_microservice_tpu.telemetry import tracing
     from sesam_duke_microservice_tpu.utils.jit_cache import (
         enable_persistent_cache,
     )
@@ -208,6 +209,7 @@ def device_pairs_per_sec(schema, corpus_records) -> tuple:
         index.delete(r)
 
     rates = []
+    trace_ids = []
     retrieval0 = proc.stats.retrieval_seconds
     compare0 = proc.stats.compare_seconds
     phases0 = dict(proc.phases.phase_seconds())
@@ -217,10 +219,19 @@ def device_pairs_per_sec(schema, corpus_records) -> tuple:
         )
         stats0 = proc.stats.pairs_compared
         t0 = time.perf_counter()
-        proc.deduplicate(queries)
+        # each timed run is one force-sampled trace: its engine phase
+        # spans land in the in-process flight recorder and the slowest
+        # run's id rides the BENCH json, so a regression links straight
+        # to a span tree instead of a bare number
+        with tracing.start_trace(
+            f"bench:run{run}", sampled=True,
+            attributes={"queries": QUERIES, "corpus": CORPUS},
+        ) as root:
+            proc.deduplicate(queries)
         dt = time.perf_counter() - t0
         scored = proc.stats.pairs_compared - stats0
         rates.append(scored / dt)
+        trace_ids.append(root.trace_id)
         for r in queries:
             index.delete(r)
     # per-phase split of the timed runs, from the same single-writer
@@ -237,7 +248,7 @@ def device_pairs_per_sec(schema, corpus_records) -> tuple:
             for k, v in proc.phases.phase_seconds().items()
         },
     }
-    return rates, phases
+    return rates, phases, trace_ids
 
 
 def main():
@@ -245,15 +256,20 @@ def main():
     corpus = stresstest_records(CORPUS, seed=1234)
 
     cpu_rate = cpu_baseline_pairs_per_sec(schema, corpus)
-    rates, phases = device_pairs_per_sec(schema, corpus)
+    rates, phases, trace_ids = device_pairs_per_sec(schema, corpus)
     dev_rate = float(np.median(rates))
 
+    # the slowest timed batch's trace id: the flight-recorder entry a
+    # regression investigation opens first (GET /debug/traces/<id> in a
+    # service run; in-process the same tree sits in tracing.RECORDER)
+    slowest = min(range(len(rates)), key=rates.__getitem__)
     result = {
         "metric": "pairs_scored_per_sec",
         "value": round(dev_rate, 1),
         "unit": "pairs/s",
         "vs_baseline": round(dev_rate / cpu_rate, 2),
         "phases": phases,
+        "slowest_trace_id": trace_ids[slowest],
     }
     print(json.dumps(result))
     print(

@@ -52,6 +52,7 @@ from ..core.records import GROUP_NO_PROPERTY_NAME, Record, SchemaError
 from ..index.base import CandidateIndex
 from ..ops import features as F
 from ..ops.features import CHARS as _F_CHARS, CHARS_WEIGHTED as _F_CHARS_W
+from ..telemetry import tracing
 from ..utils.jit_cache import record_cache_hit, record_compile
 from .listeners import MatchListener
 from .processor import (
@@ -639,7 +640,9 @@ class DeviceIndex(CandidateIndex):
         key = getattr(self, "_dispatch_key", None)
         d = dispatch.current() if key is not None else None
         if d is not None:
-            d.broadcast(("commit", key, pending))
+            # the trailing trace context makes the follower replay a
+            # remote child span of this request's trace (ISSUE 2)
+            d.broadcast(dispatch.with_trace_ctx(("commit", key, pending)))
         # once broadcast, a local failure leaves followers one commit
         # AHEAD (permanent mirror divergence) — latch before propagating
         with dispatch.latch_on_failure(
@@ -1774,9 +1777,14 @@ class DeviceProcessor:
         for listener in self.listeners:
             listener.batch_ready(len(records))
 
-        for record in records:
-            self.database.index(record)
-        self.database.commit()
+        # annotate=True bridges the span into jax.profiler.TraceAnnotation
+        # while an on-demand capture is live (utils/profiling), so the
+        # device timeline carries the same phase names as the trace tree
+        with tracing.span(PHASE_ENCODE, {"records": len(records)},
+                          annotate=True):
+            for record in records:
+                self.database.index(record)
+            self.database.commit()
         self.phases.observe(PHASE_ENCODE, time.monotonic() - t0)
         retrieval0 = self.stats.retrieval_seconds
         compare0 = self.stats.compare_seconds
@@ -1794,23 +1802,28 @@ class DeviceProcessor:
         key = getattr(self.database, "_dispatch_key", None)
         d = dispatch.current() if key is not None else None
         if d is not None:
-            d.broadcast(("score", key, list(records)))
+            d.broadcast(dispatch.with_trace_ctx(("score", key, list(records))))
         # a frontend that aborts mid-pass (listener exception, OOM) has
         # entered fewer collective programs than the followers it just
         # instructed — latch before propagating (advisor r4 medium)
+        match_ns = time.monotonic_ns()
         with dispatch.latch_on_failure(
             d, "frontend scoring pass aborted after broadcast"
         ):
             self._score_blocks(records)
 
         self.stats.batches += 1
-        self.phases.observe(
-            PHASE_RETRIEVE, self.stats.retrieval_seconds - retrieval0)
-        self.phases.observe(
-            PHASE_SCORE, self.stats.compare_seconds - compare0)
+        retrieve_dt = self.stats.retrieval_seconds - retrieval0
+        score_dt = self.stats.compare_seconds - compare0
+        self.phases.observe(PHASE_RETRIEVE, retrieve_dt)
+        self.phases.observe(PHASE_SCORE, score_dt)
+        # device-program resolve and host finalization interleave across
+        # the double-buffered blocks: the shared aggregate-span layout
+        tracing.add_phase_spans(match_ns, retrieve_dt, score_dt)
         t_persist = time.monotonic()
-        for listener in self.listeners:
-            listener.batch_done()
+        with tracing.span(PHASE_PERSIST, annotate=True):
+            for listener in self.listeners:
+                listener.batch_done()
         self.phases.observe(PHASE_PERSIST, time.monotonic() - t_persist)
         if self.profile:
             logger.info(

@@ -36,7 +36,7 @@ from ..core.bayes import combine_probabilities
 from ..core.config import DukeSchema
 from ..core.records import Record
 from ..index.base import CandidateIndex
-from ..telemetry import PhaseRecorder
+from ..telemetry import PhaseRecorder, tracing
 from .listeners import MatchListener
 
 # Per-batch engine phases recorded into each processor's PhaseRecorder
@@ -118,32 +118,50 @@ class Processor:
             listener.batch_ready(len(records))
 
         t0 = time.monotonic()
-        for record in records:
-            self.database.index(record)
-        self.database.commit()
+        with tracing.span(PHASE_ENCODE, {"records": len(records)}):
+            for record in records:
+                self.database.index(record)
+            self.database.commit()
         t1 = time.monotonic()
         retrieval0 = self.stats.retrieval_seconds
         compare0 = self.stats.compare_seconds
 
+        match_ns = time.monotonic_ns()
         if self.threads == 1:
             for record in records:
                 self._match_record(record)
         else:
+            # worker threads adopt the request's trace context so any
+            # spans they open land in the same tree (tracing.attach)
+            ctx = tracing.current_context()
             with ThreadPoolExecutor(max_workers=self.threads) as pool:
-                list(pool.map(self._match_record, records))
+                list(pool.map(
+                    lambda r: self._match_record_in_ctx(ctx, r), records))
 
         self.stats.batches += 1
         t2 = time.monotonic()
-        for listener in self.listeners:
-            listener.batch_done()
+        with tracing.span(PHASE_PERSIST):
+            for listener in self.listeners:
+                listener.batch_done()
         # per-batch phase observations (per-record splits accumulated in
         # ProfileStats above; the histogram granule is the batch)
+        retrieve_dt = self.stats.retrieval_seconds - retrieval0
+        score_dt = self.stats.compare_seconds - compare0
         self.phases.observe(PHASE_ENCODE, t1 - t0)
-        self.phases.observe(
-            PHASE_RETRIEVE, self.stats.retrieval_seconds - retrieval0)
-        self.phases.observe(
-            PHASE_SCORE, self.stats.compare_seconds - compare0)
+        self.phases.observe(PHASE_RETRIEVE, retrieve_dt)
+        self.phases.observe(PHASE_SCORE, score_dt)
         self.phases.observe(PHASE_PERSIST, time.monotonic() - t2)
+        # retrieval and scoring interleave per record (and across the
+        # thread pool): the shared aggregate-span layout
+        tracing.add_phase_spans(match_ns, retrieve_dt, score_dt)
+
+    def _match_record_in_ctx(self, ctx, record: Record) -> None:
+        """Pool-thread entry: re-enter the submitting request's trace."""
+        if ctx is None:
+            self._match_record(record)
+            return
+        with tracing.attach(ctx):
+            self._match_record(record)
 
     def _match_record(self, record: Record) -> None:
         t0 = time.monotonic()

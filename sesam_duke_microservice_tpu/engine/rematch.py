@@ -100,7 +100,8 @@ def ring_rematch(workload, *, query_block_rows: Optional[int] = None,
         # not worth serving a wedged mesh — latch on any failure
         # (dispatch.latch_on_failure, shared with commit/score).
         with d.op_lock:
-            d.broadcast(("rematch", key, query_block_rows))
+            d.broadcast(dispatch.with_trace_ctx(
+                ("rematch", key, query_block_rows)))
             with dispatch.latch_on_failure(
                 d, "frontend rematch aborted mid-run"
             ):

@@ -10,6 +10,7 @@ from __future__ import annotations
 import bisect
 from typing import Dict, List, Optional, Tuple
 
+from ..telemetry import tracing
 from .base import Link, LinkDatabase, is_same_assertion
 
 
@@ -84,10 +85,13 @@ class InMemoryLinkDatabase(LinkDatabase):
 
     def get_links_for_ids(self, record_ids) -> List[Link]:
         ids = set(record_ids)
-        return [
-            l.copy() for l in self._links.values()
-            if l.id1 in ids or l.id2 in ids
-        ]
+        # per-batch query (the one-to-one flush): coarse enough to span
+        with tracing.span("links:links_for_ids",
+                          {"backend": "in-memory", "ids": len(ids)}):
+            return [
+                l.copy() for l in self._links.values()
+                if l.id1 in ids or l.id2 in ids
+            ]
 
     def get_all_links(self) -> List[Link]:
         return list(self._links.values())
@@ -113,12 +117,15 @@ class InMemoryLinkDatabase(LinkDatabase):
         return ordered[start:]
 
     def get_changes_page(self, since: int, limit: int) -> List[Link]:
-        ordered = self._ordered()
-        start = bisect.bisect_right(ordered, since, key=lambda l: l.timestamp)
-        if limit <= 0 or start + limit >= len(ordered):
-            return ordered[start:]
-        cut = start + limit
-        last_ts = ordered[cut - 1].timestamp
-        while cut < len(ordered) and ordered[cut].timestamp == last_ts:
-            cut += 1
-        return ordered[start:cut]
+        with tracing.span("links:changes_page",
+                          {"backend": "in-memory", "since": since}):
+            ordered = self._ordered()
+            start = bisect.bisect_right(
+                ordered, since, key=lambda l: l.timestamp)
+            if limit <= 0 or start + limit >= len(ordered):
+                return ordered[start:]
+            cut = start + limit
+            last_ts = ordered[cut - 1].timestamp
+            while cut < len(ordered) and ordered[cut].timestamp == last_ts:
+                cut += 1
+            return ordered[start:cut]

@@ -12,6 +12,7 @@ from __future__ import annotations
 import sqlite3
 from typing import List
 
+from ..telemetry import tracing
 from ..utils.sqlite import SqliteConnectionPool
 from .base import Link, LinkDatabase, LinkKind, LinkStatus, is_same_assertion
 
@@ -79,18 +80,22 @@ class SqliteLinkDatabase(LinkDatabase):
             return []
         out: List[Link] = []
         conn = self._conn()
-        # SQLite caps host parameters (999 on older builds); chunk the IN
-        for start in range(0, len(ids), 450):
-            chunk = ids[start:start + 450]
-            marks = ",".join("?" * len(chunk))
-            cur = conn.execute(
-                "SELECT id1, id2, status, kind, confidence, timestamp "
-                f"FROM links WHERE id1 IN ({marks}) OR id2 IN ({marks})",
-                chunk + chunk,
-            )
-            out.extend(self._row_to_link(r) for r in cur.fetchall())
-        if len(ids) > 450:  # chunks can double-report a link joining two chunks
-            out = list({l.key(): l for l in out}.values())
+        # per-batch query (the one-to-one flush) — coarse enough to span
+        # without crowding the trace scratch (per-link ops are not spanned)
+        with tracing.span("links:links_for_ids",
+                          {"backend": "sqlite", "ids": len(ids)}):
+            # SQLite caps host parameters (999 on older builds); chunk the IN
+            for start in range(0, len(ids), 450):
+                chunk = ids[start:start + 450]
+                marks = ",".join("?" * len(chunk))
+                cur = conn.execute(
+                    "SELECT id1, id2, status, kind, confidence, timestamp "
+                    f"FROM links WHERE id1 IN ({marks}) OR id2 IN ({marks})",
+                    chunk + chunk,
+                )
+                out.extend(self._row_to_link(r) for r in cur.fetchall())
+            if len(ids) > 450:  # chunks can double-report a joining link
+                out = list({l.key(): l for l in out}.values())
         return out
 
     def get_all_links(self) -> List[Link]:
@@ -115,6 +120,11 @@ class SqliteLinkDatabase(LinkDatabase):
         if limit <= 0:
             return self.get_changes_since(since)
         conn = self._conn()
+        with tracing.span("links:changes_page",
+                          {"backend": "sqlite", "since": since}):
+            return self._changes_page(conn, since, limit)
+
+    def _changes_page(self, conn, since: int, limit: int) -> List[Link]:
         cur = conn.execute(
             "SELECT id1, id2, status, kind, confidence, timestamp FROM links "
             "WHERE timestamp > ? ORDER BY timestamp, id1, id2 LIMIT ?",

@@ -117,16 +117,25 @@ _HELLO_MAGIC = b"SDMT1"
 _HELLO_LEN = len(_HELLO_MAGIC) + 64  # magic + sha256 hexdigest (ascii)
 
 # Commit digest handshake: after replaying each ("commit", ...) op the
-# follower answers with ONE fixed-format raw frame — magic + ok byte +
-# its 32-byte chained mirror digest (DeviceIndex._mirror_digest) — and
-# the frontend compares against its own before releasing the op lock.
-# This makes asymmetric commit failures (a swallowed replay exception,
-# follower OOM, a nondeterministic bug) halt the job at the very commit
-# that diverged, instead of hanging a later collective or finalizing
-# wrong top-K links off a stale mirror.  Raw bytes, not pickle, so the
-# response path stays as dumb as the hello frame.
-_DIGEST_MAGIC = b"SDMD1"
+# follower answers with ONE raw frame — magic + ok byte + its 32-byte
+# chained mirror digest (DeviceIndex._mirror_digest), followed by a
+# 4-byte length-prefixed tracing blob (the replay's remote spans as
+# JSON, ISSUE 2; empty when no trace context rode the op) — and the
+# frontend compares the digest against its own before releasing the op
+# lock.  This makes asymmetric commit failures (a swallowed replay
+# exception, follower OOM, a nondeterministic bug) halt the job at the
+# very commit that diverged, instead of hanging a later collective or
+# finalizing wrong top-K links off a stale mirror.  Raw bytes (JSON for
+# the span blob), never pickle, so the response path stays as dumb as
+# the hello frame.  The magic is SDMD2 (was SDMD1 before the span blob
+# existed) and is checked BEFORE the length prefix is read, so a
+# mixed-version mesh halts with a protocol error instead of blocking on
+# bytes the other side will never send.
+_DIGEST_MAGIC = b"SDMD2"
 _DIGEST_LEN = len(_DIGEST_MAGIC) + 1 + 32
+# a corrupt/hostile length prefix must not allocate unbounded memory on
+# the frontend; real span blobs are a few KB (TRACE_MAX_SPANS-capped)
+_SPAN_BLOB_MAX = 4 << 20
 
 # Streamed bootstrap granularity: snapshot bytes per message / records per
 # message.  Bounds BOTH sides' transient memory (frontend pickle frame,
@@ -135,9 +144,12 @@ _SNAP_CHUNK = int(os.environ.get("DUKE_DISPATCH_SNAP_CHUNK", str(16 << 20)))
 _REC_BATCH = int(os.environ.get("DUKE_DISPATCH_REC_BATCH", "2048"))
 
 
-def _digest_frame(ok: bool, digest: bytes) -> bytes:
+def _digest_frame(ok: bool, digest: bytes, spans: bytes = b"") -> bytes:
     payload = digest if len(digest) == 32 else bytes(32)
-    return _DIGEST_MAGIC + (b"\x01" if ok else b"\x00") + payload
+    if len(spans) > _SPAN_BLOB_MAX:
+        spans = b""  # never let an oversized trace wedge the handshake
+    return (_DIGEST_MAGIC + (b"\x01" if ok else b"\x00") + payload
+            + struct.pack(">I", len(spans)) + spans)
 
 
 def _verify_enabled() -> bool:
@@ -148,6 +160,23 @@ def _hello_frame(token: str) -> bytes:
     import hashlib
 
     return _HELLO_MAGIC + hashlib.sha256(token.encode()).hexdigest().encode()
+
+
+def with_trace_ctx(op: tuple) -> tuple:
+    """Append the active trace context to a mesh op (ISSUE 2): followers
+    replay it as remote child spans of the leader's request trace.  No
+    active trace (startup, bootstrap streaming) appends nothing — the op
+    keeps its historical shape and followers see no context."""
+    tc = telemetry.tracing.propagation_context()
+    return op if tc is None else op + (tc,)
+
+
+def _op_trace_ctx(op: tuple, index: int) -> Optional[dict]:
+    """The optional trailing trace context of a replayed op (see
+    ``with_trace_ctx``)."""
+    if len(op) > index and isinstance(op[index], dict):
+        return op[index]
+    return None
 
 
 def _join_token() -> Optional[str]:
@@ -417,6 +446,22 @@ class Dispatcher:
             try:
                 conn.settimeout(_CONNECT_TIMEOUT_S)
                 frame = _recv_exact(conn, _DIGEST_LEN)
+                if frame[: len(_DIGEST_MAGIC)] != _DIGEST_MAGIC:
+                    # wrong magic = mixed-version follower (or stream
+                    # corruption): fail HERE, before blocking on a
+                    # length prefix the other side never sends
+                    raise EOFError(
+                        f"bad digest-frame magic "
+                        f"{frame[: len(_DIGEST_MAGIC)]!r} (mixed-version "
+                        f"mesh? expected {_DIGEST_MAGIC!r})"
+                    )
+                (blob_len,) = struct.unpack(">I", _recv_exact(conn, 4))
+                if blob_len > _SPAN_BLOB_MAX:
+                    raise EOFError(
+                        f"span blob length {blob_len} exceeds the "
+                        f"{_SPAN_BLOB_MAX}-byte cap (corrupt frame?)"
+                    )
+                blob = _recv_exact(conn, blob_len) if blob_len else b""
             except (OSError, EOFError) as e:
                 self.mark_failed(
                     f"no commit digest from follower {i} for {key}: {e!r}"
@@ -430,8 +475,13 @@ class Dispatcher:
                     conn.settimeout(None)
                 except OSError:
                     pass
-            ok = frame[: len(_DIGEST_MAGIC)] == _DIGEST_MAGIC and \
-                frame[len(_DIGEST_MAGIC)] == 1
+            # follower replay spans ride the handshake home: splice them
+            # into the request's live trace (same trace id) so one tree
+            # spans leader and followers (telemetry.tracing re-anchors
+            # the follower's monotonic clock at graft time)
+            telemetry.tracing.graft_remote(blob)
+            # magic already validated above (mismatch raised pre-blob)
+            ok = frame[len(_DIGEST_MAGIC)] == 1
             theirs = frame[len(_DIGEST_MAGIC) + 1:]
             if not ok or theirs != digest:
                 reason = (
@@ -755,9 +805,17 @@ class _FollowerSession:
                 "follower: %d workload replica(s) ready", len(self.replicas)
             )
         elif tag == "commit":
-            _, key, records = op
+            # ops carry the leader's trace context as an optional trailing
+            # element (ISSUE 2): the replay runs as a remote child span of
+            # the leader's request trace and rides home in the digest frame
+            _, key, records = op[:3]
+            cap = telemetry.tracing.capture_remote(
+                "follower:commit", _op_trace_ctx(op, 3),
+                {"records": len(records), "process": "follower"},
+            )
             try:
-                self.replicas[key].apply_commit(records)
+                with cap:
+                    self.replicas[key].apply_commit(records)
             except Exception:
                 # deterministic engine errors raise SYMMETRICALLY on the
                 # frontend (same code, same inputs), so surviving them
@@ -767,7 +825,7 @@ class _FollowerSession:
                 # ok=False halts the frontend at this very commit.
                 logger.exception("follower: commit replay failed")
                 if _verify_enabled():
-                    self._send(_digest_frame(False, b""))
+                    self._send(_digest_frame(False, b"", cap.wire()))
             else:
                 # answer the frontend's digest handshake (one frame per
                 # commit, read under the frontend's op lock).  Gated on
@@ -776,22 +834,36 @@ class _FollowerSession:
                 # eventually fill the TCP buffer and deadlock the loop.
                 if _verify_enabled():
                     self._send(_digest_frame(
-                        True, self.replicas[key].index._mirror_digest
+                        True, self.replicas[key].index._mirror_digest,
+                        cap.wire(),
                     ))
         elif tag == "score":
-            _, key, records = op
+            _, key, records = op[:3]
             try:
-                self.replicas[key].processor.score(records)
+                # no response channel on score ops: the replay span lands
+                # in the follower's LOCAL flight recorder (same trace id
+                # as the leader's tree) instead of shipping back
+                with telemetry.tracing.capture_remote(
+                    "follower:score", _op_trace_ctx(op, 3),
+                    {"records": len(records), "process": "follower"},
+                    recorder=telemetry.tracing.RECORDER,
+                ):
+                    self.replicas[key].processor.score(records)
             except Exception:
                 logger.exception("follower: score replay failed")
         elif tag == "rematch":
-            _, key, block_rows = op
+            _, key, block_rows = op[:3]
             from ..engine.rematch import replay_rematch
 
             replica = self.replicas[key]
             try:
-                replay_rematch(replica.index, replica.processor._proc,
-                               query_block_rows=block_rows)
+                with telemetry.tracing.capture_remote(
+                    "follower:rematch", _op_trace_ctx(op, 3),
+                    {"process": "follower"},
+                    recorder=telemetry.tracing.RECORDER,
+                ):
+                    replay_rematch(replica.index, replica.processor._proc,
+                                   query_block_rows=block_rows)
             except Exception:
                 logger.exception("follower: rematch replay failed")
         elif tag == "shutdown":

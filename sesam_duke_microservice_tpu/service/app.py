@@ -42,9 +42,16 @@ from urllib.parse import parse_qs, urlparse
 from .. import telemetry
 from ..core.config import ConfigError, ServiceConfig, load_default_config, parse_config
 from ..engine.workload import Workload, build_workload
+from ..telemetry import tracing
 from ..telemetry.logctx import new_request_id, request_id_var
+from . import debug as debug_api
 from .homepage import render_homepage
-from .metrics import HttpMetrics, backend_info, make_app_collector
+from .metrics import (
+    HttpMetrics,
+    backend_info,
+    make_app_collector,
+    make_process_collector,
+)
 
 logger = logging.getLogger("duke-tpu-service")
 
@@ -116,6 +123,7 @@ class DukeApp:
         self.metrics = telemetry.MetricRegistry()
         self.http_metrics = HttpMetrics(self.metrics)
         self.metrics.register_collector(make_app_collector(self))
+        self.metrics.register_collector(make_process_collector())
         self.apply_config(config)
 
     def readiness(self) -> Tuple[bool, Dict[str, bool]]:
@@ -252,10 +260,13 @@ _ENTITY_PATH = re.compile(
 )
 _FEED_PATH = re.compile(r"^/(deduplication|recordlinkage)/([^/]*)$")
 _REMATCH_PATH = re.compile(r"^/(deduplication|recordlinkage)/([^/]+)/rematch$")
+_DEBUG_TRACE_PATH = re.compile(r"^/debug/traces/([0-9a-f]{32})$")
 
-_STATIC_ROUTES = frozenset(
-    ("/", "/config", "/health", "/healthz", "/readyz", "/metrics", "/stats")
-)
+_STATIC_ROUTES = frozenset((
+    "/", "/config", "/health", "/healthz", "/readyz", "/metrics", "/stats",
+    "/debug/traces", "/debug/requests", "/debug/profile",
+    "/debug/profile/reset",
+))
 
 
 def _route_template(path: str) -> str:
@@ -264,6 +275,8 @@ def _route_template(path: str) -> str:
     label values."""
     if path in _STATIC_ROUTES:
         return path
+    if _DEBUG_TRACE_PATH.match(path):
+        return "/debug/traces/:id"
     if m := _REMATCH_PATH.match(path):
         return f"/{m.group(1)}/:name/rematch"
     if m := _ENTITY_PATH.match(path):
@@ -283,6 +296,7 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
     _resp_status: Optional[int] = None
     _resp_bytes: int = 0
     request_id: str = "-"
+    trace_id: str = "-"
 
     # -- plumbing -----------------------------------------------------------
 
@@ -290,10 +304,13 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
         logger.info("%s %s", self.address_string(), fmt % args)
 
     def _handle_request(self, method: str, route_fn) -> None:
-        """One instrumented request: request-id context, in-flight gauge,
+        """One instrumented request: request-id context, root trace span
+        (honoring an inbound W3C ``traceparent``), in-flight gauge,
         route/status counters, latency histogram, byte counters, busy-503
         counter.  The registry children lock for nanoseconds per request
-        — HTTP handler threads are never the device scoring path."""
+        — HTTP handler threads are never the device scoring path.  The
+        root span's exit applies the flight recorder's tail latch, so a
+        slow request is retained even when head sampling skipped it."""
         parsed = urlparse(self.path)
         route = _route_template(parsed.path)
         self.request_id = new_request_id()
@@ -304,33 +321,48 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
         hm = self.app.http_metrics
         hm.in_flight.inc()
         t0 = time.monotonic()
-        try:
+        with tracing.start_trace(
+            f"{method} {route}",
+            traceparent=self.headers.get("traceparent"),
+            attributes={
+                "http.method": method,
+                "http.route": route,
+                "http.target": parsed.path,
+                "request_id": self.request_id,
+            },
+        ) as root:
+            self.trace_id = root.trace_id
             try:
-                route_fn(parsed)
-            except _HttpError as e:
-                busy = isinstance(e, _BusyError)
-                self._reply_text(e.status, e.message)
-            except Exception:
-                logger.exception("Error serving %s %s", method, self.path)
-                self._reply_text(500, "Internal server error")
-        finally:
-            hm.in_flight.dec()
-            elapsed = time.monotonic() - t0
-            status = str(self._resp_status or 0)
-            hm.requests.labels(route=route, method=method,
-                               status=status).inc()
-            hm.latency.labels(route=route, method=method).observe(elapsed)
-            try:
-                req_bytes = int(self.headers.get("Content-Length") or 0)
-            except ValueError:
-                req_bytes = 0
-            if req_bytes > 0:
-                hm.request_bytes.labels(route=route).inc(req_bytes)
-            if self._resp_bytes:
-                hm.response_bytes.labels(route=route).inc(self._resp_bytes)
-            if busy:
-                hm.busy.labels(route=route).inc()
-            request_id_var.set("-")
+                try:
+                    route_fn(parsed)
+                except _HttpError as e:
+                    busy = isinstance(e, _BusyError)
+                    self._reply_text(e.status, e.message)
+                except Exception:
+                    logger.exception("Error serving %s %s", method, self.path)
+                    self._reply_text(500, "Internal server error")
+            finally:
+                status_code = self._resp_status or 0
+                root.set_attribute("http.status", status_code)
+                if status_code >= 500:
+                    root.status = "error"
+                hm.in_flight.dec()
+                elapsed = time.monotonic() - t0
+                status = str(status_code)
+                hm.requests.labels(route=route, method=method,
+                                   status=status).inc()
+                hm.latency.labels(route=route, method=method).observe(elapsed)
+                try:
+                    req_bytes = int(self.headers.get("Content-Length") or 0)
+                except ValueError:
+                    req_bytes = 0
+                if req_bytes > 0:
+                    hm.request_bytes.labels(route=route).inc(req_bytes)
+                if self._resp_bytes:
+                    hm.response_bytes.labels(route=route).inc(self._resp_bytes)
+                if busy:
+                    hm.busy.labels(route=route).inc()
+                request_id_var.set("-")
 
     def _reply(self, status: int, body: bytes, content_type: str = "application/json",
                extra_headers: Optional[dict] = None) -> None:
@@ -340,6 +372,7 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         self.send_header("X-Request-Id", self.request_id)
+        self.send_header("X-Trace-Id", self.trace_id)
         for k, v in (extra_headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -352,6 +385,28 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
 
     def _reply_text(self, status: int, message: str) -> None:
         self._reply(status, message.encode("utf-8"), "text/plain")
+
+    def send_error(self, code, message=None, explain=None):
+        """Stdlib error paths (malformed request line, unsupported
+        method) bypass ``_reply`` — without this override those are the
+        only responses missing the ``X-Request-Id``/``X-Trace-Id``
+        correlation headers (ISSUE 2 satellite).
+
+        These calls happen OUTSIDE ``_handle_request`` (the stdlib
+        rejects the request before routing), so on a keep-alive
+        connection the handler still holds the PREVIOUS request's ids —
+        always mint a fresh request id and clear the trace id, or the
+        error would correlate to the wrong trace."""
+        self.request_id = new_request_id()
+        self.trace_id = "-"
+        try:
+            short = message or BaseHTTPRequestHandler.responses.get(
+                code, ("Error",))[0]
+        except Exception:
+            short = "Error"
+        self.close_connection = True
+        self._reply(code, short.encode("utf-8", errors="replace"),
+                    "text/plain", {"Connection": "close"})
 
     def _read_body(self) -> bytes:
         try:
@@ -403,6 +458,15 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
             self._handle_metrics()
         elif path == "/stats":
             self._handle_stats()
+        elif path == "/debug/traces":
+            self._reply(*debug_api.handle_traces())
+        elif m := _DEBUG_TRACE_PATH.match(path):
+            fmt = (parse_qs(parsed.query).get("format") or ["json"])[0]
+            self._reply(*debug_api.handle_trace(m.group(1), fmt))
+        elif path == "/debug/requests":
+            self._reply(*debug_api.handle_requests())
+        elif path == "/debug/profile":
+            self._reply(*debug_api.handle_profile_status())
         elif m := _ENTITY_PATH.match(path):
             self._validate_entity_path(m)
             raise _HttpError(405, "This endpoint only supports POST requests.")
@@ -418,6 +482,11 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
         path = parsed.path
         if path == "/config":
             self._handle_config_upload(body)
+        elif path == "/debug/profile":
+            self._reply(*debug_api.handle_profile_start(
+                parse_qs(parsed.query)))
+        elif path == "/debug/profile/reset":
+            self._reply(*debug_api.handle_profile_reset())
         elif m := _REMATCH_PATH.match(path):
             self._handle_rematch(m, body)
         elif m := _ENTITY_PATH.match(path):
@@ -646,6 +715,7 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Transfer-Encoding", "chunked")
                     self.send_header("X-Request-Id", self.request_id)
+                    self.send_header("X-Trace-Id", self.trace_id)
                     self.end_headers()
                     self._write_chunk(b"[")
                     started = True

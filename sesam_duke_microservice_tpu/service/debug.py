@@ -1,0 +1,87 @@
+"""``/debug`` surface: flight recorder + on-demand device profiling.
+
+Rendering helpers for the four debug endpoints (ISSUE 2):
+
+    GET  /debug/traces              recent retained-trace summaries
+    GET  /debug/traces/<id>         one tree, ?format=json|chrome
+    GET  /debug/requests            always-on last-N request digests
+    POST /debug/profile?seconds=N   on-demand jax.profiler capture
+    POST /debug/profile/reset       re-arm the PROFILE_TRACE_DIR budget
+
+Each helper returns ``(status, body_bytes, content_type)`` so the HTTP
+layer stays a thin switch (service/app.py) and the logic is unit-testable
+without a socket.  Everything here reads recorder snapshots under the
+recorder's own short lock — never engine state, never the workload locks,
+so ``/debug`` cannot stall ingest.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Tuple
+
+from ..telemetry import tracing
+from ..utils import profiling
+
+_JSON = "application/json"
+
+Reply = Tuple[int, bytes, str]
+
+
+def _reply_json(status: int, payload) -> Reply:
+    return status, json.dumps(payload).encode("utf-8"), _JSON
+
+
+def handle_traces(recorder: tracing.FlightRecorder = None) -> Reply:
+    recorder = recorder if recorder is not None else tracing.RECORDER
+    return _reply_json(200, {"traces": recorder.summaries()})
+
+
+def handle_trace(trace_id: str, fmt: str = "json",
+                 recorder: tracing.FlightRecorder = None) -> Reply:
+    recorder = recorder if recorder is not None else tracing.RECORDER
+    if fmt not in ("json", "chrome"):
+        return _reply_json(
+            400, {"error": f"unknown format {fmt!r} (json|chrome)"})
+    record = recorder.get(trace_id)
+    if record is None:
+        return _reply_json(404, {
+            "error": f"trace {trace_id!r} is not in the flight recorder "
+                     "(unretained, evicted, or never existed)"})
+    if fmt == "chrome":
+        return _reply_json(200, tracing.chrome_trace(record))
+    return _reply_json(200, tracing.trace_to_json(record))
+
+
+def handle_requests(recorder: tracing.FlightRecorder = None) -> Reply:
+    recorder = recorder if recorder is not None else tracing.RECORDER
+    return _reply_json(200, {"requests": recorder.digests()})
+
+
+def handle_profile_status() -> Reply:
+    """``GET /debug/profile``: the live capture's dir/deadline, or
+    ``{"capturing": null}`` when idle — so an operator can see (and wait
+    out) a running capture instead of probing with 409s."""
+    return _reply_json(200, {"capturing": profiling.capture_status()})
+
+
+def handle_profile_start(query: dict) -> Reply:
+    raw = (query.get("seconds") or ["5"])[0]
+    try:
+        seconds = float(raw)
+    except ValueError:
+        return _reply_json(400, {"error": f"unparseable seconds {raw!r}"})
+    try:
+        info = profiling.start_capture(seconds)
+    except profiling.CaptureActiveError as e:
+        return _reply_json(409, {"error": str(e)})
+    except ValueError as e:
+        return _reply_json(400, {"error": str(e)})
+    return _reply_json(200, {"capturing": info})
+
+
+def handle_profile_reset() -> Reply:
+    return _reply_json(200, {
+        "trace_budget_reset": True,
+        "budget_batches": profiling.reset_trace_budget(),
+    })

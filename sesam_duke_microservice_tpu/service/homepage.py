@@ -69,6 +69,19 @@ def render_homepage(app) -> str:
         "<li>POST /{deduplication|recordlinkage}/:name/rematch &mdash; "
         "ring bulk re-match / link-DB backfill (device backends)</li>"
     )
+    rows.append(
+        f"<li>GET {link('/debug/traces')} &mdash; flight recorder "
+        "(retained traces; /debug/traces/&lt;id&gt;?format=chrome for "
+        "Perfetto)</li>"
+    )
+    rows.append(
+        f"<li>GET {link('/debug/requests')} &mdash; last-N request "
+        "digests with per-phase timings</li>"
+    )
+    rows.append(
+        "<li>POST /debug/profile?seconds=N &mdash; on-demand "
+        "jax.profiler device capture</li>"
+    )
     rows.append("</ul>")
 
     body = "\n".join(rows)

@@ -16,9 +16,12 @@ same guarantee the /stats endpoint has always given
 
 from __future__ import annotations
 
+import os
+import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from .. import __version__
 from ..telemetry import (
     DEFAULT_LATENCY_BUCKETS,
     FamilySnapshot,
@@ -82,6 +85,64 @@ def backend_info() -> Tuple[str, int]:
         except Exception:
             return ("unavailable", 0)
     return _backend_info_cache
+
+
+def _jax_version() -> str:
+    try:
+        import jax
+
+        return jax.__version__
+    except Exception:
+        return "unavailable"
+
+
+def make_process_collector():
+    """Scrape-time build-info + process gauges (ISSUE 2 satellite).
+
+    ``duke_build_info`` carries the identifying labels (service version,
+    jax version, backend platform) with a constant value of 1 — the
+    Prometheus idiom for joinable build metadata; the process gauges
+    read ``resource.getrusage`` / ``/proc`` at scrape time so nothing is
+    maintained between scrapes."""
+    import resource
+    import sys
+
+    def collect():
+        labels = (
+            ("version", __version__),
+            ("jax", _jax_version()),
+            ("platform", backend_info()[0]),
+        )
+        out = [FamilySnapshot(
+            "duke_build_info", "gauge",
+            "Build/runtime identity (value is always 1)",
+            [("", labels, 1.0)],
+        )]
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        # ru_maxrss is KiB on Linux, bytes on macOS
+        rss = ru.ru_maxrss * (1 if sys.platform == "darwin" else 1024)
+        out.append(FamilySnapshot(
+            "duke_process_max_rss_bytes", "gauge",
+            "Peak resident set size (resource.getrusage ru_maxrss)",
+            [("", (), float(rss))],
+        ))
+        try:
+            fds = len(os.listdir("/proc/self/fd"))
+        except OSError:
+            fds = None  # non-procfs platform: omit rather than lie
+        if fds is not None:
+            out.append(FamilySnapshot(
+                "duke_process_open_fds", "gauge",
+                "Open file descriptors", [("", (), float(fds))],
+            ))
+        out.append(FamilySnapshot(
+            "duke_process_threads", "gauge",
+            "Live Python threads (threading.active_count)",
+            [("", (), float(threading.active_count()))],
+        ))
+        return out
+
+    return collect
 
 
 def _workload_iter(app):

@@ -32,6 +32,10 @@ from .registry import (  # noqa: F401
     render,
 )
 
+# the tracing layer (ISSUE 2): imported as a submodule attribute so every
+# layer can `from ..telemetry import tracing` without a second import line
+from . import tracing  # noqa: F401  (imports only stdlib + .logctx)
+
 GLOBAL = MetricRegistry()
 
 # -- JIT layer (written via utils/jit_cache record_* helpers) ----------------

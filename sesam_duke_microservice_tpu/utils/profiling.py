@@ -2,7 +2,7 @@
 ``PROFILE=1`` -> ``Processor.setPerformanceProfiling`` per-phase timing,
 App.java:239-244,345,466 — SURVEY.md section 5.1).
 
-Two levels:
+Three levels:
 
   * ``PROFILE=1`` — per-batch wall-clock logs + ProfileStats counters
     (engine.processor / engine.device_matcher), mirroring the reference's
@@ -11,7 +11,15 @@ Two levels:
     traces (XLA op timeline, HBM usage, fusion view in TensorBoard /
     xprof) for the first ``PROFILE_TRACE_BATCHES`` (default 3) scoring
     batches.  Bounded by default: traces are large and the service is
-    long-running.
+    long-running.  The spent budget is resettable at runtime
+    (``reset_trace_budget`` / ``POST /debug/profile/reset``) so a
+    long-running service can re-capture after a config reload.
+  * **on-demand capture** (ISSUE 2) — ``start_capture(seconds)`` /
+    ``POST /debug/profile?seconds=N`` opens a ``jax.profiler`` trace NOW
+    for N seconds, no restart and no env preconfiguration.  While a
+    capture is live, tracing spans created with ``annotate=True``
+    (engine phases) also enter ``jax.profiler.TraceAnnotation`` so the
+    device timeline carries the request-trace names.
 """
 
 from __future__ import annotations
@@ -19,7 +27,12 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
+import tempfile
 import threading
+import time
+from typing import Any, Dict, Optional
+
+from ..telemetry import tracing as _tracing
 
 logger = logging.getLogger("profiling")
 
@@ -36,6 +49,15 @@ def _trace_budget() -> int:
         return int(os.environ.get("PROFILE_TRACE_BATCHES", "3"))
     except ValueError:
         return 3
+
+
+def reset_trace_budget() -> int:
+    """Re-arm the PROFILE_TRACE_DIR batch-capture budget (the spent count
+    used to be process-lifetime-once).  Returns the re-armed budget."""
+    global _traced_batches
+    with _lock:
+        _traced_batches = 0
+    return _trace_budget()
 
 
 @contextlib.contextmanager
@@ -83,3 +105,106 @@ def trace_batch(label: str):
             logger.exception(
                 "device trace teardown failed (batch continues)"
             )
+
+
+# -- on-demand capture (POST /debug/profile) ---------------------------------
+
+# seam for tests: the two jax.profiler touch points, monkeypatchable so
+# endpoint smoke tests never spin a real profiler session
+def profiler_start(logdir: str) -> None:
+    import jax
+
+    jax.profiler.start_trace(logdir)
+
+
+def profiler_stop() -> None:
+    import jax
+
+    jax.profiler.stop_trace()
+
+
+MAX_CAPTURE_SECONDS = 600.0
+
+_capture_lock = threading.Lock()
+_capture: Optional[Dict[str, Any]] = None
+
+
+def capture_status() -> Optional[Dict[str, Any]]:
+    """The live capture's public info, or None."""
+    with _capture_lock:
+        if _capture is None:
+            return None
+        info = {k: _capture[k] for k in
+                ("dir", "seconds", "started_unix")}
+        info["remaining_seconds"] = round(
+            max(0.0, _capture["until"] - time.monotonic()), 3)
+        return info
+
+
+def start_capture(seconds: float,
+                  logdir: Optional[str] = None) -> Dict[str, Any]:
+    """Open a ``jax.profiler`` capture NOW for ``seconds`` seconds.
+
+    Generalizes the first-N-batches ``PROFILE_TRACE_DIR`` capture to any
+    moment in a running service: a timer thread stops the capture, and
+    while it is live the request-tracing layer bridges its engine phase
+    spans into device TraceAnnotations.  One capture at a time
+    (``CaptureActiveError``); failures to start propagate to the caller
+    (the endpoint answers 500) with no state latched.
+    """
+    seconds = float(seconds)
+    if not (0 < seconds <= MAX_CAPTURE_SECONDS):
+        raise ValueError(
+            f"capture seconds must be in (0, {MAX_CAPTURE_SECONDS:g}]"
+        )
+    global _capture
+    with _capture_lock:
+        if _capture is not None:
+            raise CaptureActiveError(
+                f"a device capture is already running into "
+                f"{_capture['dir']}"
+            )
+        directory = (logdir or trace_dir()
+                     or tempfile.mkdtemp(prefix="duke-profile-"))
+        profiler_start(directory)
+        _tracing.set_device_annotations(True)
+        timer = threading.Timer(seconds, stop_capture)
+        timer.daemon = True
+        _capture = {
+            "dir": directory,
+            "seconds": seconds,
+            "started_unix": round(time.time(), 3),
+            "until": time.monotonic() + seconds,
+            "timer": timer,
+        }
+        timer.start()
+        logger.info("on-demand device capture started: %.3gs into %s",
+                    seconds, directory)
+        return {k: _capture[k] for k in ("dir", "seconds", "started_unix")}
+
+
+def stop_capture() -> Optional[Dict[str, Any]]:
+    """End the live capture (timer callback; also callable early).
+    Returns the finished capture's info, or None if none was live."""
+    global _capture
+    with _capture_lock:
+        if _capture is None:
+            return None
+        done, _capture = _capture, None
+        done.pop("until", None)
+        timer = done.pop("timer", None)
+        if timer is not None:
+            timer.cancel()
+        _tracing.set_device_annotations(False)
+        try:
+            profiler_stop()
+            logger.info("on-demand device capture finished: %s",
+                        done["dir"])
+        except Exception:
+            logger.exception("on-demand capture teardown failed")
+            done["error"] = "profiler stop failed (see logs)"
+        return done
+
+
+class CaptureActiveError(RuntimeError):
+    """A second ``start_capture`` while one is live (endpoint: 409)."""
