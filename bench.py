@@ -52,6 +52,17 @@ os.environ.setdefault(
 # "ann"/"sharded" (embedding-ANN blocking, single-chip / mesh)
 BACKEND = os.environ.get("BENCH_BACKEND", "device")
 CPU_SAMPLE_PAIRS = int(os.environ.get("BENCH_CPU_PAIRS", "20000"))
+# end-to-end ingest bench (records/s through deduplicate, including host
+# finalization and link persist) on a FINALIZE-BOUND workload: the corpus
+# is duplicate-heavy (groups of BENCH_E2E_GROUP identical records), so
+# every query carries ~GROUP surviving pairs into host finalization and
+# ~GROUP link upserts into persist — the post-device Amdahl regime this
+# round's finalization subsystem exists for.  BENCH_E2E=0 skips it.
+E2E = os.environ.get("BENCH_E2E", "1") != "0"
+E2E_CORPUS = int(os.environ.get("BENCH_E2E_CORPUS", "8192"))
+E2E_QUERIES = int(os.environ.get("BENCH_E2E_QUERIES", "1024"))
+E2E_GROUP = int(os.environ.get("BENCH_E2E_GROUP", "64"))
+E2E_RUNS = int(os.environ.get("BENCH_E2E_RUNS", "3"))
 
 
 def stresstest_records(n, seed=1234, dataset="ds1"):
@@ -251,6 +262,140 @@ def device_pairs_per_sec(schema, corpus_records) -> tuple:
     return rates, phases, trace_ids
 
 
+def duplicate_group_records(n, group, seed, dataset):
+    """Duplicate-heavy corpus: ``n`` records over ``n // group`` distinct
+    identities (identical name/area/ssn within a group), so each query is
+    a fresh copy of an identity and survives against the whole group."""
+    from sesam_duke_microservice_tpu.core.records import (
+        DATASET_ID_PROPERTY_NAME,
+        ID_PROPERTY_NAME,
+        ORIGINAL_ENTITY_ID_PROPERTY_NAME,
+        Record,
+    )
+
+    rng = random.Random(seed)
+    identities = max(1, n // group)
+    pool = [
+        (
+            f"person {i} vangsnes {rng.randint(0, 999)}",
+            str(rng.randint(1, 10)),
+            str(100000 + i),
+        )
+        for i in range(identities)
+    ]
+    records = []
+    for i in range(n):
+        name, area, ssn = pool[i % identities]
+        r = Record()
+        r.add_value(ID_PROPERTY_NAME, f"{dataset}__{i}")
+        r.add_value(ORIGINAL_ENTITY_ID_PROPERTY_NAME, str(i))
+        r.add_value(DATASET_ID_PROPERTY_NAME, dataset)
+        r.add_value("name", name)
+        r.add_value("area", area)
+        r.add_value("ssn", ssn)
+        records.append(r)
+    return records
+
+
+def _e2e_run(schema, tmpdir, *, serial: bool) -> dict:
+    """One end-to-end ingest measurement: deduplicate (device scoring +
+    host finalization) + link persist to a durable sqlite store.
+
+    ``serial=True`` pins the pre-finalization-subsystem configuration —
+    one finalize thread, no decisive-band skip, per-link synchronous
+    sqlite writes — so the headline can report the speedup of the new
+    defaults over the legacy path in one bench invocation.
+    """
+    from sesam_duke_microservice_tpu.engine.device_matcher import (
+        DeviceIndex,
+        DeviceProcessor,
+    )
+    from sesam_duke_microservice_tpu.engine.finalize import FinalizeExecutor
+    from sesam_duke_microservice_tpu.engine.listeners import LinkMatchListener
+    from sesam_duke_microservice_tpu.links.sqlite import SqliteLinkDatabase
+    from sesam_duke_microservice_tpu.links.write_behind import (
+        WriteBehindLinkDatabase,
+    )
+
+    mode = "serial" if serial else "parallel"
+    linkdb = SqliteLinkDatabase(os.path.join(tmpdir, f"links-{mode}.sqlite"))
+    if serial:
+        db, listener = linkdb, LinkMatchListener(linkdb, batch=False)
+    else:
+        db = WriteBehindLinkDatabase(linkdb)
+        listener = LinkMatchListener(db)
+
+    index = DeviceIndex(schema)
+    # the parallel arm defaults the pool to the machine's cores so the
+    # thread fan-out is actually measured; DUKE_FINALIZE_THREADS still
+    # overrides inside FinalizeExecutor
+    proc = DeviceProcessor(schema, index, threads=(os.cpu_count() or 2))
+    if serial:
+        proc.finalizer = FinalizeExecutor(1, decisive=False, use_env=False)
+    proc.add_match_listener(listener)
+
+    corpus = duplicate_group_records(E2E_CORPUS, E2E_GROUP, seed=42,
+                                     dataset="base")
+    for r in corpus:
+        index.index(r)
+    index.commit()
+
+    # warmup batch (compiles + full upload), deleted afterwards so every
+    # timed run ingests against the same live corpus
+    warm = duplicate_group_records(E2E_QUERIES, E2E_GROUP, seed=42,
+                                   dataset="warm")
+    proc.deduplicate(warm)
+    for r in warm:
+        index.delete(r)
+
+    rescored0 = proc.stats.pairs_rescored
+    skipped0 = proc.stats.pairs_skipped
+    t0 = time.perf_counter()
+    for run in range(E2E_RUNS):
+        batch = duplicate_group_records(
+            E2E_QUERIES, E2E_GROUP, seed=42, dataset=f"ing{mode}{run}"
+        )
+        proc.deduplicate(batch)
+        for r in batch:
+            index.delete(r)
+    # the write-behind flush must be durable before the clock stops:
+    # records/s includes persist, not just the enqueue
+    db.drain()
+    dt = time.perf_counter() - t0
+    db.close()
+    return {
+        "records_per_sec": round(E2E_RUNS * E2E_QUERIES / dt, 1),
+        "rescored": proc.stats.pairs_rescored - rescored0,
+        "skipped": proc.stats.pairs_skipped - skipped0,
+        "finalize_threads": proc.finalizer.threads,
+    }
+
+
+def e2e_ingest(schema) -> dict:
+    """records/s through ``deduplicate`` + persist, new defaults vs the
+    legacy serial path (see _e2e_run)."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="duke-e2e-bench") as tmpdir:
+        serial = _e2e_run(schema, tmpdir, serial=True)
+        parallel = _e2e_run(schema, tmpdir, serial=False)
+    return {
+        "metric": "ingest_records_per_sec",
+        "value": parallel["records_per_sec"],
+        "unit": "records/s",
+        "vs_serial_finalize": round(
+            parallel["records_per_sec"] / serial["records_per_sec"], 2
+        ),
+        "serial_records_per_sec": serial["records_per_sec"],
+        "finalize_threads": parallel["finalize_threads"],
+        "finalize_rescored": parallel["rescored"],
+        "finalize_skipped": parallel["skipped"],
+        "corpus": E2E_CORPUS,
+        "queries_per_batch": E2E_QUERIES,
+        "dup_group": E2E_GROUP,
+    }
+
+
 def main():
     schema = bench_schema()
     corpus = stresstest_records(CORPUS, seed=1234)
@@ -271,6 +416,8 @@ def main():
         "phases": phases,
         "slowest_trace_id": trace_ids[slowest],
     }
+    if E2E and BACKEND == "device":
+        result["e2e"] = e2e_ingest(schema)
     print(json.dumps(result))
     print(
         f"# cpu_baseline={cpu_rate:.0f} pairs/s, device median-of-{len(rates)}"

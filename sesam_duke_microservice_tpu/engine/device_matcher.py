@@ -1530,16 +1530,18 @@ class _ScorerCache:
         from ..ops import scoring as S
 
         index = self.index
-        schema = index.schema
-        thresholds = [schema.threshold]
-        if schema.maybe_threshold:
-            thresholds.append(schema.maybe_threshold)
-        min_threshold = min(thresholds)
-        host_bound = S.host_bound_logit(index.plan.host_props)
-        # 1e-3 safety margin covers float32 kernel error at the bound; the
-        # surviving pairs are re-scored host-exact, so the margin only costs
-        # a few extra finalizations, never correctness.
-        return S.probability_to_logit(min_threshold) - host_bound - 1e-3
+        # the long-validated 1e-3 insurance margin covering float32
+        # kernel error at the bound (differential-tested; surviving pairs
+        # are re-scored host-exact, so it only costs extra
+        # finalizations).  Deliberately NOT widened to the certified
+        # per-plan margin: for degenerate configs (low=0.0 / high=1.0)
+        # the certified bound explodes and would disable the filter
+        # entirely; such schemas instead get an empty decisive band
+        # (prune bound below this filter bound -> nothing skipped), which
+        # degrades to rescore-everything, never to unsoundness.  Both
+        # bounds derive from the ONE emit_bound_logit formula so the
+        # threshold/host-bound handling can never drift apart.
+        return S.emit_bound_logit(index.schema, index.plan, 1e-3)
 
     def _prepare_queries(self, records: Sequence[Record],
                          group_filtering: bool):
@@ -1744,6 +1746,8 @@ class DeviceProcessor:
     def __init__(self, schema: DukeSchema, database: DeviceIndex, *,
                  group_filtering: bool = False, profile: bool = False,
                  threads: int = 1):
+        from .finalize import FinalizeExecutor
+
         self.schema = schema
         self.database = database
         self.group_filtering = group_filtering
@@ -1754,7 +1758,10 @@ class DeviceProcessor:
         # the writer exclusivity; readers are lock-free scrapes)
         self.phases = PhaseRecorder()
         self._scorers = database.scorer_cache
-        del threads  # device path has no host thread fan-out
+        # host finalization of the surviving top-K pairs fans out over
+        # this executor (DUKE_FINALIZE_THREADS overrides ``threads``);
+        # events still emit in strict query order (engine.finalize)
+        self.finalizer = FinalizeExecutor(threads)
         # compile the scorer shape ladder in the background while the
         # service finishes startup / the first batches are parsed
         self._scorers.prewarm_async(group_filtering)
@@ -1843,8 +1850,6 @@ class DeviceProcessor:
         or the cross-host collectives deadlock, so the loop is shared
         rather than reimplemented (parallel.dispatch invariant 2).
         """
-        threshold = self.schema.threshold
-        maybe = self.schema.maybe_threshold
         corpus = self.database.corpus
         live_rows = int(corpus.row_valid.sum() - corpus.row_deleted[
             corpus.row_valid].sum())
@@ -1878,26 +1883,22 @@ class DeviceProcessor:
 
             if not self.finalize_survivors:
                 continue
-            for qi, record in enumerate(block):
-                survivors = result.survivors(qi)
-                found = False
-                for row, _device_logit in survivors:
-                    rid = corpus.row_ids[row]
-                    candidate = self.database.records.get(rid)
-                    if candidate is None or rid == record.record_id:
-                        continue
-                    prob = self.compare(record, candidate)
-                    if prob > threshold:
-                        found = True
-                        self._emit("matches", record, candidate, prob)
-                    elif maybe is not None and maybe != 0.0 and prob > maybe:
-                        found = True
-                        self._emit("matches_perhaps", record, candidate, prob)
-                if not found:
+            # parallel host finalization: workers compute the exact f64
+            # rescores (and the decisive-band skips) per query; events
+            # then emit HERE, serially and in query order, so listener
+            # streams and link rows are identical to the serial path at
+            # any DUKE_FINALIZE_THREADS (engine.finalize)
+            outcomes = self.finalizer.finalize_block(self, block, result)
+            for qi, (record, out) in enumerate(zip(block, outcomes)):
+                for event, candidate, prob in out.events:
+                    self._emit(event, record, candidate, prob)
+                if not out.events:
                     for listener in self.listeners:
                         listener.no_match_for(record)
                 self.stats.records_processed += 1
-                self.stats.candidates_retrieved += len(survivors)
+                self.stats.candidates_retrieved += out.survivors
+                self.stats.pairs_rescored += out.rescored
+                self.stats.pairs_skipped += out.skipped
                 if self.exhaustive:
                     # the device ran the exact comparator kernels against
                     # every live corpus row for this query

@@ -1,0 +1,174 @@
+"""Parallel host finalization of device-scored survivor pairs.
+
+The device scorer ranks ~tens of millions of exact pairs per second, but
+every surviving top-K pair used to funnel through a single-threaded Python
+loop (per-survivor ``Processor.compare`` in ``DeviceProcessor
+._score_blocks``) — so end-to-end ingest throughput was bounded by host
+finalization, not the TPU (the post-device Amdahl bottleneck).  This module
+makes that loop parallel, bounded, and mostly skippable:
+
+  * **Parallel**: per-query survivor finalization fans out over a worker
+    pool sized by ``DUKE_FINALIZE_THREADS`` (falling back to the
+    processor's ``threads`` knob).  Workers only *compute* — the exact f64
+    ``compare`` per survivor and the would-be events; results are gathered
+    and listener events are emitted by the coordinating thread in strict
+    query order, so the match/maybe/no-match stream and the link rows are
+    bit-identical to the serial path at any thread count.
+
+  * **Skippable** (decisive-band pruning, ``DUKE_DECISIVE_BAND``): the
+    device logit is optimistic — host-only properties contribute their
+    maximum and float32 error is credited via the certified margin
+    (``ops.scoring.certified_f32_margin``).  A survivor whose upper-bound
+    probability still cannot clear ``min(threshold, maybe_threshold)``
+    certifiably emits no event, so its host ``compare`` is skipped.  The
+    device-side survivor filter keeps a coarser (1e-3) insurance margin,
+    so the skipped band is exactly the over-conservative tail the filter
+    retains; emitted pairs always get the exact f64 rescore, preserving
+    the bit-identical-probability contract.  The skipped/rescored split
+    rides ``ProfileStats`` (``duke_finalize_pairs_total`` on /metrics).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Sequence, Tuple
+
+from ..core.records import Record
+
+
+class QueryOutcome:
+    """One query's finalization result, computed off the listener thread.
+
+    ``events`` holds ``(event_name, candidate, probability)`` in survivor
+    (descending device logit) order — exactly what the serial loop would
+    have emitted; an empty list means ``no_match_for``.
+    """
+
+    __slots__ = ("events", "survivors", "rescored", "skipped")
+
+    def __init__(self, events: List[Tuple[str, Record, float]],
+                 survivors: int, rescored: int, skipped: int):
+        self.events = events
+        self.survivors = survivors
+        self.rescored = rescored
+        self.skipped = skipped
+
+
+def _resolve_threads(threads: int, use_env: bool) -> int:
+    if use_env:
+        env = os.environ.get("DUKE_FINALIZE_THREADS")
+        if env:
+            try:
+                return max(1, int(env))
+            except ValueError:
+                # a typo'd manifest must not keep the service from
+                # starting (the convention every env knob here follows)
+                logging.getLogger("finalize").warning(
+                    "ignoring non-integer DUKE_FINALIZE_THREADS=%r", env
+                )
+    return max(1, threads)
+
+
+class FinalizeExecutor:
+    """Block-scoped survivor-finalization executor for device processors.
+
+    One instance per processor; the pool is created lazily on the first
+    multi-threaded block and reused across batches (the host ``Processor``
+    precedent of a pool per batch would pay thread spawn per microbatch).
+    ``use_env=False`` pins the constructor arguments against the env knobs
+    (benchmark baselines).
+    """
+
+    def __init__(self, threads: int = 1, *, decisive: Optional[bool] = None,
+                 use_env: bool = True):
+        self.threads = _resolve_threads(threads, use_env)
+        if decisive is None:
+            decisive = (not use_env
+                        or os.environ.get("DUKE_DECISIVE_BAND", "1") != "0")
+        self.decisive = decisive
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._pool_lock = threading.Lock()
+
+    def _get_pool(self) -> ThreadPoolExecutor:
+        with self._pool_lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.threads,
+                    thread_name_prefix="finalize",
+                )
+            return self._pool
+
+    def shutdown(self) -> None:
+        with self._pool_lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    def finalize_block(self, proc, block: Sequence[Record],
+                       result) -> List[QueryOutcome]:
+        """Compute every query's outcome for one scored block.
+
+        ``proc`` is the owning DeviceProcessor (supplies ``compare``, the
+        record mirror, and thresholds); ``result`` is the resolved
+        ``_BlockResult``.  Returns outcomes in query order; the caller
+        emits the listener events serially from them.
+        """
+        from ..ops import scoring as S
+
+        database = proc.database
+        corpus = database.corpus
+        records_map = database.records
+        threshold = proc.schema.threshold
+        maybe = proc.schema.maybe_threshold
+        # recomputed per block: the plan's host/device split can change
+        # between batches (long-text demotion) and the bound must track it
+        prune = (S.decisive_prune_logit(proc.schema, database.plan)
+                 if self.decisive else None)
+        resolver = records_map.get
+        if not isinstance(records_map, dict):
+            # lazy store-backed mirrors (LazyRecordMap) mutate an LRU on
+            # every get — serialize just the resolution, not the compare
+            rl = threading.Lock()
+            inner = resolver
+
+            def resolver(rid):  # noqa: F811 - deliberate shadowing
+                with rl:
+                    return inner(rid)
+
+        compare = proc.compare
+        row_ids = corpus.row_ids
+
+        def one(qi: int, record: Record) -> QueryOutcome:
+            events: List[Tuple[str, Record, float]] = []
+            survivors = result.survivors(qi)
+            rescored = skipped = 0
+            rec_id = record.record_id
+            for row, device_logit in survivors:
+                rid = row_ids[row]
+                if rid is None or rid == rec_id:
+                    continue
+                if prune is not None and device_logit <= prune:
+                    # upper-bound probability certifiably below the
+                    # minimum emit threshold: no event possible
+                    skipped += 1
+                    continue
+                candidate = resolver(rid)
+                if candidate is None:
+                    continue
+                prob = compare(record, candidate)
+                rescored += 1
+                if prob > threshold:
+                    events.append(("matches", candidate, prob))
+                elif maybe is not None and maybe != 0.0 and prob > maybe:
+                    events.append(("matches_perhaps", candidate, prob))
+            return QueryOutcome(events, len(survivors), rescored, skipped)
+
+        if self.threads <= 1 or len(block) <= 1:
+            return [one(qi, r) for qi, r in enumerate(block)]
+        pool = self._get_pool()
+        # map() preserves submission order, so outcomes line up with the
+        # block and emission stays in strict query order
+        return list(pool.map(one, range(len(block)), block))

@@ -30,24 +30,58 @@ class MatchListener:
 
 
 class LinkMatchListener(MatchListener):
-    """Duke's LinkDatabaseMatchListener: persist match events as links."""
+    """Duke's LinkDatabaseMatchListener: persist match events as links.
 
-    def __init__(self, linkdb: LinkDatabase):
+    With ``batch=True`` (the default) the batch's links are collected and
+    handed to the database as ONE ``assert_links`` call at ``batch_done``
+    — a single transaction on the durable backend instead of a
+    query+commit per link, which dominated the persist phase on
+    match-heavy batches.  Timestamps are assigned at event time (Link
+    construction), so the deferred write is invisible to ``?since=``
+    pollers.  ``batch=False`` preserves the legacy per-event write for
+    embedders that read the database mid-batch.
+    """
+
+    def __init__(self, linkdb: LinkDatabase, batch: bool = True):
         self.linkdb = linkdb
+        self.batch = batch
+        self._pending: List[Link] = []
+
+    def batch_ready(self, size: int) -> None:
+        # a batch that aborted mid-scoring must not leak its buffered
+        # links into the next batch's flush transaction
+        self._pending = []
+
+    def _assert(self, link: Link) -> None:
+        if self.batch:
+            self._pending.append(link)
+        else:
+            self.linkdb.assert_link(link)
 
     def matches(self, r1: Record, r2: Record, confidence: float) -> None:
-        self.linkdb.assert_link(
+        self._assert(
             Link(r1.record_id, r2.record_id, LinkStatus.INFERRED,
                  LinkKind.DUPLICATE, confidence)
         )
 
     def matches_perhaps(self, r1: Record, r2: Record, confidence: float) -> None:
-        self.linkdb.assert_link(
+        self._assert(
             Link(r1.record_id, r2.record_id, LinkStatus.INFERRED,
                  LinkKind.MAYBE, confidence)
         )
 
+    def flush_pending(self) -> None:
+        """Hand the collected links to the database now (one batched
+        call), without ending the batch.  The one-to-one flush calls this
+        before its conflict prefetch so this batch's pass-through
+        maybe-link upserts are visible to the prefetched link state,
+        exactly as the legacy per-event writes were."""
+        pending, self._pending = self._pending, []
+        if pending:
+            self.linkdb.assert_links(pending)
+
     def batch_done(self) -> None:
+        self.flush_pending()
         self.linkdb.commit()
 
 
@@ -104,6 +138,12 @@ class ServiceMatchListener(MatchListener):
 
     def batch_done(self) -> None:
         if self.one_to_one:
+            if not self.link_database_updates_disabled:
+                # maybe-matches passed straight through during scoring and
+                # sit in the wrapped listener's batch buffer; hand them to
+                # the DB before the flush's conflict prefetch reads link
+                # state, matching the legacy immediate-write visibility
+                self._wrapped.flush_pending()
             self._flush_one_to_one()
         if not self.link_database_updates_disabled:
             self._wrapped.batch_done()

@@ -60,6 +60,12 @@ class ProfileStats:
     records_processed: int = 0
     candidates_retrieved: int = 0
     pairs_compared: int = 0
+    # host-finalization split (device backends): survivors rescored with
+    # the exact f64 compare vs survivors skipped by decisive-band pruning
+    # (engine.finalize) — always zero on the host engine, whose candidate
+    # loop has no device pre-score to prune against
+    pairs_rescored: int = 0
+    pairs_skipped: int = 0
     retrieval_seconds: float = 0.0
     compare_seconds: float = 0.0
 
@@ -68,6 +74,8 @@ class ProfileStats:
         self.records_processed += other.records_processed
         self.candidates_retrieved += other.candidates_retrieved
         self.pairs_compared += other.pairs_compared
+        self.pairs_rescored += other.pairs_rescored
+        self.pairs_skipped += other.pairs_skipped
         self.retrieval_seconds += other.retrieval_seconds
         self.compare_seconds += other.compare_seconds
 

@@ -148,6 +148,23 @@ class Workload:
             raise req.error
         return []
 
+    def _retract_links_for(self, deleted: Sequence[Record]) -> None:
+        """Retract every link touching the deleted records.
+
+        ONE batched prefetch for the whole set: per-record
+        ``get_all_links_for`` calls would pay a write-behind drain
+        round-trip per record (each record's buffered retracts sealed and
+        flushed by the next record's read).  A link touching two deleted
+        records is retracted once; re-asserting it identically is
+        idempotent either way.
+        """
+        if not deleted:
+            return
+        ids = [r.record_id for r in deleted]
+        for link in self.link_database.get_links_for_ids(ids):
+            link.retract()
+            self.link_database.assert_link(link)
+
     def _mesh_op_lock(self):
         """Multi-host serving: the dispatcher's global op lock, held across
         every device-program-producing section so processes enqueue mesh
@@ -207,10 +224,7 @@ class Workload:
                     deleted = [r for r in records if r.is_deleted()]
                     for record in deleted:
                         self.index.index(record)
-                        for link in self.link_database.get_all_links_for(
-                                record.record_id):
-                            link.retract()
-                            self.link_database.assert_link(link)
+                    self._retract_links_for(deleted)
                 except Exception as e:  # store errors stay per-request
                     if put_done:
                         # the store committed rows the index will never
@@ -228,6 +242,11 @@ class Workload:
                 with self._mesh_op_lock():
                     if any_deleted:
                         self.index.commit()
+                        # seal the retraction writes even when no scoring
+                        # pass (and thus no listener batch_done/commit)
+                        # follows — a delete-only group must not leave
+                        # them unsealed in the write-behind buffer
+                        self.link_database.commit()
                     if all_live:
                         self.processor.deduplicate(all_live)
                 if ok:
@@ -286,15 +305,16 @@ class Workload:
                     put_done = True
                 for record in deleted:
                     # tombstone in the index (still resolvable by the GET
-                    # feed's point lookups), then retract its links
+                    # feed's point lookups); links retract batched below
                     self.index.index(record)
-                    for link in self.link_database.get_all_links_for(record.record_id):
-                        link.retract()
-                        self.link_database.assert_link(link)
+                self._retract_links_for(deleted)
 
             with self._mesh_op_lock():
                 if deleted and not http_transform:
                     self.index.commit()
+                    # seal retraction writes for delete-only batches (see
+                    # _run_merged; no-op when a scoring pass follows)
+                    self.link_database.commit()
                 if live or http_transform:
                     self.processor.deduplicate(live)
 
@@ -386,6 +406,9 @@ class Workload:
                 or not hasattr(self.index, "snapshot_save")):
             return
         try:
+            # drain any write-behind link flush first: a snapshot must
+            # never be newer than the link rows its batches produced
+            self.link_database.drain()
             self.index.snapshot_save(_snapshot_path(self.config.data_folder))
         except Exception:
             logging.getLogger("workload").exception(
@@ -403,6 +426,9 @@ class Workload:
         self.closed = True
         if save_snapshot:
             self.save_corpus_snapshot()
+        finalizer = getattr(self.processor, "finalizer", None)
+        if finalizer is not None:
+            finalizer.shutdown()
         self.index.close()
         self.link_database.close()
         if self.record_store is not None:
@@ -440,21 +466,24 @@ def build_workload(wc: WorkloadConfig, sc: ServiceConfig, *,
 
         index = DeviceIndex(wc.duke, tunables=sc.tunables)
         processor = DeviceProcessor(
-            wc.duke, index, group_filtering=group_filtering, profile=sc.profile
+            wc.duke, index, group_filtering=group_filtering,
+            profile=sc.profile, threads=sc.threads,
         )
     elif backend == "ann":
         from .ann_matcher import AnnIndex, AnnProcessor
 
         index = AnnIndex(wc.duke, tunables=sc.tunables)
         processor = AnnProcessor(
-            wc.duke, index, group_filtering=group_filtering, profile=sc.profile
+            wc.duke, index, group_filtering=group_filtering,
+            profile=sc.profile, threads=sc.threads,
         )
     elif backend == "sharded":
         from .sharded_matcher import ShardedAnnIndex, ShardedAnnProcessor
 
         index = ShardedAnnIndex(wc.duke, tunables=sc.tunables)
         processor = ShardedAnnProcessor(
-            wc.duke, index, group_filtering=group_filtering, profile=sc.profile
+            wc.duke, index, group_filtering=group_filtering,
+            profile=sc.profile, threads=sc.threads,
         )
     elif backend == "sharded-brute":
         from .sharded_matcher import (
@@ -464,7 +493,8 @@ def build_workload(wc: WorkloadConfig, sc: ServiceConfig, *,
 
         index = ShardedDeviceIndex(wc.duke, tunables=sc.tunables)
         processor = ShardedDeviceProcessor(
-            wc.duke, index, group_filtering=group_filtering, profile=sc.profile
+            wc.duke, index, group_filtering=group_filtering,
+            profile=sc.profile, threads=sc.threads,
         )
     else:
         index = InvertedIndex(wc.duke, tunables=sc.tunables)

@@ -1,6 +1,7 @@
 from .base import Link, LinkStatus, LinkKind, LinkDatabase
 from .memory import InMemoryLinkDatabase
 from .sqlite import SqliteLinkDatabase
+from .write_behind import WriteBehindLinkDatabase
 
 __all__ = [
     "Link",
@@ -9,13 +10,23 @@ __all__ = [
     "LinkDatabase",
     "InMemoryLinkDatabase",
     "SqliteLinkDatabase",
+    "WriteBehindLinkDatabase",
 ]
 
 
 def create_link_database(link_database_type: str, data_folder=None,
                          is_record_linkage: bool = False) -> LinkDatabase:
     """Factory mirroring App.java:566-611: 'h2' (durable; SQLite here) or
-    'in-memory'."""
+    'in-memory'.
+
+    Unless ``DUKE_WRITE_BEHIND=0``, the DURABLE backend is wrapped in
+    ``WriteBehindLinkDatabase`` so each batch's flush transaction
+    overlaps the next microbatch's encode phase; every row-returning
+    read drains first, so feed and lookup semantics are unchanged
+    (links.write_behind).  The in-memory backend is never wrapped —
+    its writes are microsecond list appends with nothing to overlap,
+    so the flusher thread and drain barriers would be pure overhead.
+    """
     import os
 
     if link_database_type == "in-memory":
@@ -25,5 +36,8 @@ def create_link_database(link_database_type: str, data_folder=None,
             return InMemoryLinkDatabase()
         name = "recordlinkdatabase" if is_record_linkage else "linkdatabase"
         os.makedirs(data_folder, exist_ok=True)
-        return SqliteLinkDatabase(os.path.join(data_folder, name + ".sqlite"))
+        db = SqliteLinkDatabase(os.path.join(data_folder, name + ".sqlite"))
+        if os.environ.get("DUKE_WRITE_BEHIND", "1") == "0":
+            return db
+        return WriteBehindLinkDatabase(db)
     raise ValueError(f"Got an unknown 'link-database-type' value: '{link_database_type}'")

@@ -94,6 +94,18 @@ class LinkDatabase:
     def assert_link(self, link: Link) -> None:
         raise NotImplementedError
 
+    def assert_links(self, links: List[Link]) -> None:
+        """Assert a whole batch of links in arrival order.
+
+        The listener chain collects one batch's match events and persists
+        them here in a single call — the durable backend turns this into
+        ONE transaction (``executemany``) instead of a query+commit per
+        link, which dominated the persist phase on match-heavy batches.
+        This default keeps tiny custom backends working.
+        """
+        for link in links:
+            self.assert_link(link)
+
     def get_all_links_for(self, record_id: str) -> List[Link]:
         raise NotImplementedError
 
@@ -147,6 +159,12 @@ class LinkDatabase:
 
     def commit(self) -> None:
         pass
+
+    def drain(self) -> None:
+        """Block until every buffered/asynchronous write is durably
+        applied.  Synchronous backends have nothing pending — only the
+        write-behind wrapper overrides; callers needing the barrier
+        (snapshot save, benchmarks) call it unconditionally."""
 
     def close(self) -> None:
         pass

@@ -72,6 +72,15 @@ class InMemoryLinkDatabase(LinkDatabase):
             if self._sorted is not None:
                 self._append_sorted(link)
 
+    def assert_links(self, links: List[Link]) -> None:
+        # per-link assert is already O(1) in memory; the override only
+        # adds the per-batch trace span the sqlite backend gets, so the
+        # persist phase is attributable on either backend
+        with tracing.span("links:assert_batch",
+                          {"backend": "in-memory", "links": len(links)}):
+            for link in links:
+                self.assert_link(link)
+
     def get_all_links_for(self, record_id: str) -> List[Link]:
         # COPIES, not the stored objects (matching the sqlite backend's
         # fresh rows): callers retract-then-reassert these, and an

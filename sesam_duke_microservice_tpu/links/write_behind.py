@@ -1,0 +1,181 @@
+"""Write-behind link persistence with a drain barrier on every read.
+
+The persist phase used to flush each batch's link upserts synchronously
+inside ``batch_done`` — serial with the next microbatch's encode phase.
+This wrapper buffers writes in arrival order and flushes them on a single
+background thread (one ``assert_links`` transaction + ``commit`` per
+batch), so the durable flush overlaps the next microbatch's encode/device
+work instead of extending the persist phase.
+
+Consistency contract:
+
+  * **Ordering** — writes apply in arrival order; ``commit()`` seals the
+    current buffer as one batch and enqueues it (non-blocking).
+  * **Drain barrier** — every row-returning read (``/datasets`` feed
+    pages, the one-to-one flush's batched link fetch, delete-retraction
+    lookups) drains buffered and in-flight writes first, so a reader can
+    never observe a torn batch.  ``close()`` and the workload's
+    corpus-snapshot save drain too.  ``count()`` alone is non-draining:
+    it feeds monitoring gauges, which must not block on flush latency.
+  * **Failure** — a background flush error latches the wrapper: the batch
+    that failed was ONE transaction (all-or-nothing on the sqlite
+    backend), and every subsequent write/commit/drain raises the latched
+    error so ingest cannot silently run ahead of a dead link store.
+    Recovery is a workload reload/restart, same as any persistent-store
+    failure.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from typing import List, Optional
+
+from .base import Link, LinkDatabase
+
+logger = logging.getLogger("links-write-behind")
+
+
+class WriteBehindLinkDatabase(LinkDatabase):
+    # backpressure: at most this many sealed batches may be pending
+    # behind the flusher; commit() blocks past it, so a slow disk turns
+    # into ingest backpressure instead of unbounded queue growth — and
+    # every drain barrier (reads, scrapes) is bounded by a handful of
+    # flush transactions rather than an arbitrary backlog
+    _MAX_PENDING = 4
+
+    def __init__(self, inner: LinkDatabase):
+        self.inner = inner
+        self._cv = threading.Condition()
+        self._buf: List[Link] = []
+        self._queue: deque = deque()
+        self._inflight = False
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- worker --------------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        # called with _cv held
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="link-flush"
+            )
+            self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if not self._queue:
+                    return  # closed and drained
+                batch = self._queue.popleft()
+                self._inflight = True
+            try:
+                self.inner.assert_links(batch)
+                self.inner.commit()
+            except BaseException as e:  # latch: readers/writers must see it
+                logger.exception("write-behind link flush failed")
+                with self._cv:
+                    self._error = e
+                    self._inflight = False
+                    self._queue.clear()
+                    self._cv.notify_all()
+                return
+            with self._cv:
+                self._inflight = False
+                self._cv.notify_all()
+
+    def _raise_latched(self) -> None:
+        # called with _cv held
+        if self._error is not None:
+            raise RuntimeError(
+                "link write-behind flush failed; the link store is stale "
+                "(reload the workload to recover)"
+            ) from self._error
+
+    # -- writes (buffered, arrival order) ------------------------------------
+
+    def assert_link(self, link: Link) -> None:
+        with self._cv:
+            self._raise_latched()
+            self._buf.append(link)
+
+    def assert_links(self, links: List[Link]) -> None:
+        with self._cv:
+            self._raise_latched()
+            self._buf.extend(links)
+
+    def commit(self) -> None:
+        """Seal the buffered writes as one batch and enqueue the flush;
+        returns immediately unless the flusher is ``_MAX_PENDING`` batches
+        behind (then it blocks — backpressure, not unbounded memory)."""
+        with self._cv:
+            self._raise_latched()
+            if not self._buf:
+                return
+            while len(self._queue) >= self._MAX_PENDING:
+                self._cv.wait()
+                self._raise_latched()
+            batch, self._buf = self._buf, []
+            self._queue.append(batch)
+            self._ensure_thread()
+            self._cv.notify_all()
+
+    def drain(self) -> None:
+        """Block until every buffered and queued write is durably applied
+        (the read barrier; re-raises a latched flush failure)."""
+        self.commit()
+        with self._cv:
+            while (self._queue or self._inflight) and self._error is None:
+                self._cv.wait()
+            self._raise_latched()
+
+    # -- reads (drain first) -------------------------------------------------
+
+    def get_all_links_for(self, record_id: str) -> List[Link]:
+        self.drain()
+        return self.inner.get_all_links_for(record_id)
+
+    def get_links_for_ids(self, record_ids) -> List[Link]:
+        self.drain()
+        return self.inner.get_links_for_ids(record_ids)
+
+    def get_all_links(self) -> List[Link]:
+        self.drain()
+        return self.inner.get_all_links()
+
+    def count(self) -> int:
+        # deliberately NOT drained: count feeds /metrics and /stats
+        # gauges, and a scrape must neither block on in-flight flush
+        # transactions nor seal another thread's in-progress batch buffer
+        # into a separate transaction.  The value trails the buffered
+        # writes by at most a batch or two (exact again after any drain
+        # point); every row-returning read keeps the full barrier.
+        return self.inner.count()
+
+    def get_changes_since(self, since: int) -> List[Link]:
+        self.drain()
+        return self.inner.get_changes_since(since)
+
+    def get_changes_page(self, since: int, limit: int) -> List[Link]:
+        self.drain()
+        return self.inner.get_changes_page(since, limit)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        try:
+            self.drain()
+        except RuntimeError:
+            pass  # latched failure: nothing left to save
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+            thread = self._thread
+        if thread is not None:
+            thread.join(timeout=10.0)
+        self.inner.close()

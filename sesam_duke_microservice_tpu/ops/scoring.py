@@ -54,6 +54,117 @@ def host_bound_logit(host_props) -> float:
     return sum(max(0.0, probability_to_logit(p.high)) for p in host_props)
 
 
+_F32_EPS = float(np.finfo(np.float32).eps)
+
+
+def certified_f32_margin(plan: "F.SchemaFeatures") -> float:
+    """Certified upper bound on |device f32 logit - exact f64 logit|.
+
+    The device program computes, per property, a similarity, Duke's
+    quadratic probability map, and a clamped log-odds, then sums the
+    per-property logits — all in float32.  Per property the error budget
+    has two parts:
+
+      * **similarity error through the map**: a per-kernel-kind
+        similarity budget (``_SIM_ERROR_BOUND``: 64 ulps for the
+        integer-count-ratio kernels, wider for weighted-Levenshtein and
+        numeric, uncertifiable for geoposition), amplified by the
+        worst-case slope of the probability→log-odds composition.
+        ``|dlogit/dp| = 1/(p(1-p))`` and ``|dp/dsim| <= 1``, so the
+        amplification is bounded by ``1/min(high(1-high), low(1-low))``
+        — a property with an extreme ``high`` (sharp log-odds) correctly
+        demands a wider margin;
+      * **direct rounding of the log-odds**: 32 ulps of the clamp
+        ``_MAX_LOGIT``.
+
+    The final sum of ``n`` clamped terms adds ``n * ulp(n * _MAX_LOGIT)``
+    of accumulation error.  Branch discontinuities (the ``sim >= 0.5``
+    split in the probability map) are outside any rounding bound — they
+    are the same measure-zero exposure the device-side survivor filter
+    has always had and are covered by the differential tests, not by
+    this margin.
+
+    When a schema's sharp properties push this margin past the device
+    filter's fixed 1e-3 insurance margin the decisive band is empty
+    (the prune bound falls below the filter bound, so no survivor ever
+    sits in it) — pruning degrades to "rescore everything", never to
+    unsoundness.  The filter itself deliberately stays at 1e-3: a
+    degenerate config (low=0.0 / high=1.0) makes this margin huge, and
+    widening the filter with it would stop filtering at all.
+
+    Used by decisive-band pruning (engine.finalize): a survivor whose
+    device logit plus this margin plus the optimistic host-property bound
+    still cannot reach ``logit(min_threshold)`` certifiably cannot emit an
+    event, so its exact host rescore is skipped.
+    """
+    n = max(1, len(plan.device_props))
+    total = n * _F32_EPS * (n * _MAX_LOGIT)  # accumulation of the sum
+    for spec in plan.device_props:
+        high = min(max(float(spec.high), _EPS), 1.0 - _EPS)
+        low = min(max(float(spec.low), _EPS), 1.0 - _EPS)
+        amplification = 1.0 / min(high * (1.0 - high), low * (1.0 - low))
+        sim_err = _SIM_ERROR_BOUND.get(spec.kind, float("inf"))
+        # a property's logit is clamped to [-_MAX_LOGIT, _MAX_LOGIT], so
+        # however steep the map, its error cannot exceed the clamp range
+        total += min(sim_err * amplification, 2.0 * _MAX_LOGIT)
+        total += 32.0 * _F32_EPS * _MAX_LOGIT      # log-odds rounding
+    return total
+
+
+# Per-kind absolute similarity-error bounds for the certified margin.
+# Edit-distance / set / hash / phonetic sims are ratios of exact integer
+# counts with one final f32 division — 64 ulps is generous.  Weighted
+# Levenshtein accumulates up to ~256 f32 weight additions; numeric is a
+# ratio of f32-quantized doubles; both get wider budgets.  Geoposition is
+# NOT certifiable: f32 lat/lon quantization alone is meters of position
+# error, arbitrarily large in similarity units for small max-distance —
+# its inf entry collapses the decisive band (rescore everything) for any
+# schema carrying a geo property, which is the sound default for unknown
+# future kinds too.
+_SIM_ERROR_BOUND = {
+    F.CHARS: 64.0 * _F32_EPS,
+    F.GRAM_SET: 64.0 * _F32_EPS,
+    F.TOKEN_SET: 64.0 * _F32_EPS,
+    F.HASH: 64.0 * _F32_EPS,
+    F.PHONETIC: 64.0 * _F32_EPS,
+    F.CHARS_WEIGHTED: 2048.0 * _F32_EPS,
+    F.NUMERIC: 256.0 * _F32_EPS,
+    F.GEO: float("inf"),
+}
+
+
+def emit_bound_logit(schema, plan: "F.SchemaFeatures",
+                     margin: float) -> float:
+    """ONE copy of the survivor-bound formula: the device logit below
+    which a pair cannot emit an event at the given error ``margin`` —
+    ``logit(min(threshold, maybe_threshold))`` minus the optimistic
+    host-property contribution minus ``margin``.  The device-side
+    survivor filter and decisive-band pruning both derive from this, so
+    they can never drift onto different threshold/host-bound handling
+    (pruning soundness requires the prune bound to sit inside the
+    filter's retained band)."""
+    thresholds = [schema.threshold]
+    if schema.maybe_threshold:
+        thresholds.append(schema.maybe_threshold)
+    return (
+        probability_to_logit(min(thresholds))
+        - host_bound_logit(plan.host_props)
+        - margin
+    )
+
+
+def decisive_prune_logit(schema, plan: "F.SchemaFeatures") -> float:
+    """Device-logit bound below which a survivor is *decisively* a
+    non-event: ``device_logit <= decisive_prune_logit`` implies the exact
+    f64 pair probability cannot exceed ``min(threshold, maybe_threshold)``
+    even with every host-scored property at its optimistic maximum and the
+    certified float32 error credited in the survivor's favor.  Survivors
+    at or below this bound skip the host ``compare`` call entirely;
+    everything above it is rescored host-exact, so emitted probabilities
+    stay bit-identical to the host engine."""
+    return emit_bound_logit(schema, plan, certified_f32_margin(plan))
+
+
 # -- per-property pair similarity -------------------------------------------
 
 

@@ -384,6 +384,7 @@ class Dispatcher:
                 conn.close()
             except OSError:
                 pass
+        telemetry.DISPATCH_FOLLOWERS.set(0)
         if self._server is not None:
             self._server.close()
         if _DISPATCHER is self:
@@ -423,6 +424,10 @@ class Dispatcher:
                 except OSError as e:
                     self._failed = repr(e)
                     telemetry.DISPATCH_DOWN.set(1)
+                    # the mesh is down, not just degraded: zero the
+                    # follower gauge so dashboards watching it see the
+                    # outage without also graphing duke_dispatch_down
+                    telemetry.DISPATCH_FOLLOWERS.set(0)
                     logger.error(
                         "dispatch: broadcast to a follower failed (%s); "
                         "halting mesh ops — restart the job", e,
@@ -500,6 +505,10 @@ class Dispatcher:
         if self._failed is None:
             self._failed = reason
             telemetry.DISPATCH_DOWN.set(1)
+            # connected-follower gauge drops to zero with the latch: the
+            # mesh cannot serve another op, so a dashboard on the gauge
+            # alone sees the outage (ROADMAP open item)
+            telemetry.DISPATCH_FOLLOWERS.set(0)
             logger.error(
                 "dispatch: halting mesh ops (%s) — restart the job", reason
             )

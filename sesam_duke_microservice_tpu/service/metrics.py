@@ -173,6 +173,8 @@ def make_app_collector(app):
         link_samples = []
         queue_samples = []
         warm_samples = []
+        finalize_samples = []
+        finalize_threads = []
         for kind, name, wl in _workload_iter(app):
             labels = (("kind", kind), ("workload", name))
             proc = wl.processor
@@ -189,6 +191,17 @@ def make_app_collector(app):
                     ("", labels, stats.candidates_retrieved))
                 counter_samples["pairs"].append(
                     ("", labels, stats.pairs_compared))
+            finalizer = getattr(proc, "finalizer", None)
+            if finalizer is not None and stats is not None:
+                # decisive-band split: survivors rescored host-exact vs
+                # certifiably skipped without a compare (engine.finalize)
+                finalize_samples.append((
+                    "", labels + (("outcome", "rescored"),),
+                    stats.pairs_rescored))
+                finalize_samples.append((
+                    "", labels + (("outcome", "skipped"),),
+                    stats.pairs_skipped))
+                finalize_threads.append(("", labels, finalizer.threads))
             live = getattr(wl.index, "live_records", None)
             indexed = None
             corpus = getattr(wl.index, "corpus", None)
@@ -268,6 +281,16 @@ def make_app_collector(app):
                 "duke_prewarm_compiles", "gauge",
                 "Successful background AOT scorer compiles",
                 warm_samples))
+        if finalize_samples:
+            out.append(FamilySnapshot(
+                "duke_finalize_pairs_total", "counter",
+                "Device-scored survivors by finalization outcome: "
+                "rescored host-exact vs skipped by decisive-band pruning",
+                finalize_samples))
+            out.append(FamilySnapshot(
+                "duke_finalize_threads", "gauge",
+                "Worker threads in the host-finalization pool "
+                "(DUKE_FINALIZE_THREADS)", finalize_threads))
         return out
 
     return collect

@@ -1,0 +1,532 @@
+"""Finalization-subsystem tests (parallel host finalization, decisive-band
+pruning, write-behind link persist — ISSUE 3).
+
+Determinism contract: the listener event SEQUENCE (not just the set) and
+the link-database contents must be identical across any
+``DUKE_FINALIZE_THREADS`` — workers only compute, the coordinator emits in
+strict query order.  Decisive-band pruning must be invisible in the event
+stream: a differential run against the host oracle holds it to the same
+events the serial exact path produces.
+"""
+
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from sesam_duke_microservice_tpu.core import comparators as C
+from sesam_duke_microservice_tpu.core.config import DukeSchema, MatchTunables
+from sesam_duke_microservice_tpu.core.records import (
+    DELETED_PROPERTY_NAME,
+    GROUP_NO_PROPERTY_NAME,
+    ID_PROPERTY_NAME,
+    Property,
+    Record,
+)
+from sesam_duke_microservice_tpu.engine.device_matcher import (
+    DeviceIndex,
+    DeviceProcessor,
+    _BlockResult,
+)
+from sesam_duke_microservice_tpu.engine.finalize import FinalizeExecutor
+from sesam_duke_microservice_tpu.engine.listeners import (
+    LinkMatchListener,
+    MatchListener,
+)
+from sesam_duke_microservice_tpu.engine.processor import Processor
+from sesam_duke_microservice_tpu.index.base import CandidateIndex
+from sesam_duke_microservice_tpu.links import (
+    InMemoryLinkDatabase,
+    Link,
+    LinkKind,
+    LinkStatus,
+    SqliteLinkDatabase,
+    WriteBehindLinkDatabase,
+)
+
+
+def dedup_schema(threshold=0.8, maybe=0.6):
+    numeric = C.Numeric()
+    numeric.min_ratio = 0.5
+    return DukeSchema(
+        threshold=threshold,
+        maybe_threshold=maybe,
+        properties=[
+            Property(ID_PROPERTY_NAME, id_property=True),
+            Property("name", C.Levenshtein(), 0.3, 0.9),
+            Property("city", C.Exact(), 0.4, 0.8),
+            Property("amount", numeric, 0.4, 0.7),
+        ],
+        data_sources=[],
+    )
+
+
+def make_record(rid, **props):
+    r = Record()
+    r.add_value(ID_PROPERTY_NAME, rid)
+    for k, v in props.items():
+        r.add_value(k, v)
+    return r
+
+
+NAMES = [
+    "acme corp", "acme corporation", "globex", "globex inc", "initech",
+    "initech llc", "umbrella", "umbrela", "stark industries", "stark ind",
+]
+CITIES = ["oslo", "bergen", "trondheim"]
+
+
+def random_records(n, seed, prefix="r"):
+    rng = random.Random(seed)
+    records = []
+    for i in range(n):
+        base = rng.choice(NAMES)
+        if rng.random() < 0.4:
+            pos = rng.randrange(len(base))
+            base = base[:pos] + rng.choice("abcdefgh") + base[pos + 1:]
+        records.append(make_record(
+            f"{prefix}{i}",
+            name=base,
+            city=rng.choice(CITIES),
+            amount=str(rng.choice([100, 200, 200, 300, 1000])),
+        ))
+    return records
+
+
+class OrderedLog(MatchListener):
+    """Full ordered event tape — sequence equality is the contract."""
+
+    def __init__(self):
+        self.events = []
+
+    def matches(self, r1, r2, confidence):
+        self.events.append(
+            ("match", r1.record_id, r2.record_id, round(confidence, 9)))
+
+    def matches_perhaps(self, r1, r2, confidence):
+        self.events.append(
+            ("maybe", r1.record_id, r2.record_id, round(confidence, 9)))
+
+    def no_match_for(self, record):
+        self.events.append(("none", record.record_id))
+
+
+class BruteForceIndex(CandidateIndex):
+    """Total-recall host oracle index (as in test_device_matcher)."""
+
+    def __init__(self):
+        self.records = {}
+        self.indexing_disabled = False
+
+    def index(self, record):
+        if not self.indexing_disabled:
+            self.records[record.record_id] = record
+
+    def commit(self):
+        pass
+
+    def find_record_by_id(self, record_id):
+        return self.records.get(record_id)
+
+    def find_candidate_matches(self, record, group_filtering=False):
+        group = record.get_value(GROUP_NO_PROPERTY_NAME)
+        out = []
+        for r in self.records.values():
+            if r.get_value(DELETED_PROPERTY_NAME) == "true":
+                continue
+            if group_filtering and r.get_value(GROUP_NO_PROPERTY_NAME) == group:
+                continue
+            out.append(r)
+        return out
+
+    def delete(self, record):
+        self.records.pop(record.record_id, None)
+
+    def set_indexing_disabled(self, disabled):
+        self.indexing_disabled = disabled
+
+
+def run_device(schema, batches, *, threads=1, linkdb=None):
+    index = DeviceIndex(schema, tunables=MatchTunables())
+    proc = DeviceProcessor(schema, index, threads=threads)
+    log = OrderedLog()
+    proc.add_match_listener(log)
+    if linkdb is not None:
+        proc.add_match_listener(LinkMatchListener(linkdb))
+    for batch in batches:
+        proc.deduplicate(batch)
+    return log, proc
+
+
+def link_rows(db):
+    return sorted(
+        (l.id1, l.id2, l.status.value, l.kind.value, round(l.confidence, 9))
+        for l in db.get_all_links()
+    )
+
+
+class TestThreadDeterminism:
+    def test_event_sequence_and_links_identical_across_thread_counts(
+            self, tmp_path, monkeypatch):
+        # CI runs the whole suite under DUKE_FINALIZE_THREADS=4; this test
+        # sweeps explicit counts, so the env override must not apply
+        monkeypatch.delenv("DUKE_FINALIZE_THREADS", raising=False)
+        schema = dedup_schema()
+        b1 = random_records(30, seed=11)
+        b2 = random_records(20, seed=12, prefix="s")
+        results = {}
+        for threads in (1, 4, 8):
+            db = SqliteLinkDatabase(str(tmp_path / f"links{threads}.sqlite"))
+            log, proc = run_device(schema, [b1, b2], threads=threads,
+                                   linkdb=db)
+            assert proc.finalizer.threads == threads
+            results[threads] = (log.events, link_rows(db))
+            db.close()
+        base_events, base_links = results[1]
+        assert base_events, "fixture produced no events"
+        for threads in (4, 8):
+            events, links = results[threads]
+            assert events == base_events, f"threads={threads} event drift"
+            assert links == base_links, f"threads={threads} link drift"
+
+    def test_env_knob_overrides_ctor(self, monkeypatch):
+        monkeypatch.setenv("DUKE_FINALIZE_THREADS", "6")
+        assert FinalizeExecutor(1).threads == 6
+        monkeypatch.delenv("DUKE_FINALIZE_THREADS")
+        assert FinalizeExecutor(3).threads == 3
+        # benchmark baselines pin against the env
+        monkeypatch.setenv("DUKE_FINALIZE_THREADS", "6")
+        assert FinalizeExecutor(1, use_env=False).threads == 1
+
+
+class TestDecisiveBand:
+    def test_differential_vs_host_oracle(self):
+        # decisive-band pruning (on by default) must emit exactly the
+        # host engine's events on the fixture corpora
+        schema = dedup_schema(threshold=0.92, maybe=0.6)
+        records = random_records(40, seed=7)
+        host_index = BruteForceIndex()
+        host = Processor(schema, host_index)
+        host_log = OrderedLog()
+        host.add_match_listener(host_log)
+        host.deduplicate(records)
+
+        dev_log, proc = run_device(schema, [records])
+        assert proc.finalizer.decisive is True
+        assert set(dev_log.events) == set(host_log.events)
+
+    def test_flag_off_same_events(self, monkeypatch):
+        schema = dedup_schema()
+        records = random_records(35, seed=3)
+        on_log, on_proc = run_device(schema, [records])
+        monkeypatch.setenv("DUKE_DECISIVE_BAND", "0")
+        off_log, off_proc = run_device(schema, [records])
+        assert off_proc.finalizer.decisive is False
+        assert on_log.events == off_log.events
+        # with the band off every survivor is rescored
+        assert off_proc.stats.pairs_skipped == 0
+        assert (off_proc.stats.pairs_rescored
+                >= on_proc.stats.pairs_rescored)
+
+    def test_prune_bound_inside_device_filter(self):
+        # the device-side survivor filter must retain everything the
+        # certified prune bound would emit: prune_logit >= min_logit
+        from sesam_duke_microservice_tpu.ops import scoring as S
+
+        schema = dedup_schema()
+        index = DeviceIndex(schema, tunables=MatchTunables())
+        prune = S.decisive_prune_logit(schema, index.plan)
+        min_logit = index.scorer_cache._min_logit()
+        assert prune >= min_logit
+        assert S.certified_f32_margin(index.plan) < 1e-3
+
+    def test_degenerate_schema_disables_band_not_filter(self):
+        # low=0.0 / high=1.0 blows the certified margin up; the device
+        # filter must keep its fixed 1e-3 margin (still filtering) while
+        # the decisive band collapses to empty (prune below the filter)
+        from sesam_duke_microservice_tpu.ops import scoring as S
+
+        schema = DukeSchema(
+            threshold=0.8, maybe_threshold=None,
+            properties=[
+                Property(ID_PROPERTY_NAME, id_property=True),
+                Property("name", C.Levenshtein(), 0.0, 1.0),
+                Property("city", C.Exact(), 0.4, 0.8),
+            ],
+            data_sources=[],
+        )
+        index = DeviceIndex(schema, tunables=MatchTunables())
+        min_logit = index.scorer_cache._min_logit()
+        expected = S.emit_bound_logit(schema, index.plan, 1e-3)
+        assert min_logit == pytest.approx(expected)
+        assert min_logit > -10  # the filter still filters
+        prune = S.decisive_prune_logit(schema, index.plan)
+        assert prune < min_logit  # empty band: nothing ever skipped
+
+    def test_band_skips_without_compare(self):
+        # a survivor at or below the certified bound must be dropped
+        # WITHOUT a host compare call; one above it must be rescored
+        from sesam_duke_microservice_tpu.ops import scoring as S
+
+        schema = dedup_schema()
+        index = DeviceIndex(schema, tunables=MatchTunables())
+        a = make_record("a", name="acme corp", city="oslo", amount="100")
+        b = make_record("b", name="acme corp", city="oslo", amount="100")
+        index.index(a)
+        index.index(b)
+        index.commit()
+
+        prune = S.decisive_prune_logit(schema, index.plan)
+        row_b = index.id_to_row["b"]
+        compared = []
+
+        class Proc:
+            database = index
+            compare = staticmethod(
+                lambda r1, r2: compared.append((r1.record_id, r2.record_id))
+                or 0.99
+            )
+
+        Proc.schema = schema
+        ex = FinalizeExecutor(1)
+        assert ex.decisive
+
+        def result_at(logit):
+            return _BlockResult(
+                np.array([[logit]], np.float32),
+                np.array([[row_b]], np.int32),
+                prune - 100.0,  # survivors() filter far below the band
+            )
+
+        (out,) = ex.finalize_block(Proc, [a], result_at(prune - 1e-6))
+        assert (out.skipped, out.rescored) == (1, 0)
+        assert compared == []
+
+        (out,) = ex.finalize_block(Proc, [a], result_at(prune + 1e-3))
+        assert (out.skipped, out.rescored) == (0, 1)
+        assert compared == [("a", "b")]
+        assert out.events and out.events[0][0] == "matches"
+
+
+class TestWriteBehind:
+    def L(self, id1, id2, conf=0.9, status=LinkStatus.INFERRED, ts=None):
+        return Link(id1, id2, status, LinkKind.DUPLICATE, conf, ts)
+
+    def test_reads_drain_pending_writes(self):
+        db = WriteBehindLinkDatabase(InMemoryLinkDatabase())
+        db.assert_link(self.L("a", "b", ts=100))
+        db.commit()  # enqueued, possibly not yet applied
+        assert [l.key() for l in db.get_all_links()] == [("a", "b")]
+        # an UNcommitted buffered write must also be visible to readers
+        db.assert_link(self.L("c", "d", ts=200))
+        assert len(db.get_changes_since(0)) == 2
+        assert db.count() == 2
+        db.close()
+
+    def test_batch_is_one_inner_transaction(self):
+        calls = []
+
+        class Spy(InMemoryLinkDatabase):
+            def assert_links(self, links):
+                calls.append(len(links))
+                super().assert_links(links)
+
+        db = WriteBehindLinkDatabase(Spy())
+        for i in range(5):
+            db.assert_link(self.L(f"a{i}", f"b{i}"))
+        db.commit()
+        db.drain()
+        assert calls == [5]
+        db.close()
+
+    def test_flush_failure_latches(self):
+        class Broken(InMemoryLinkDatabase):
+            def assert_links(self, links):
+                raise OSError("disk gone")
+
+        db = WriteBehindLinkDatabase(Broken())
+        db.assert_link(self.L("a", "b"))
+        db.commit()
+        with pytest.raises(RuntimeError, match="write-behind"):
+            db.drain()
+        with pytest.raises(RuntimeError, match="write-behind"):
+            db.assert_link(self.L("c", "d"))
+        db.close()
+
+    def test_close_drains(self, tmp_path):
+        inner = SqliteLinkDatabase(str(tmp_path / "links.sqlite"))
+        db = WriteBehindLinkDatabase(inner)
+        db.assert_link(self.L("a", "b", ts=42))
+        db.close()
+        reopened = SqliteLinkDatabase(str(tmp_path / "links.sqlite"))
+        assert [l.key() for l in reopened.get_all_links()] == [("a", "b")]
+        reopened.close()
+
+    def test_backpressure_bounds_queue(self):
+        release = threading.Event()
+        entered = threading.Event()
+
+        class Slow(InMemoryLinkDatabase):
+            def assert_links(self, links):
+                entered.set()
+                release.wait(10)
+                super().assert_links(links)
+
+        db = WriteBehindLinkDatabase(Slow())
+        max_pending = db._MAX_PENDING
+        db.assert_link(self.L("a0", "b0"))
+        db.commit()
+        entered.wait(10)  # flusher is now stuck inside batch 0
+        # fill the queue to the cap behind it
+        for i in range(1, max_pending + 1):
+            db.assert_link(self.L(f"a{i}", f"b{i}"))
+            db.commit()
+        # the next commit must BLOCK until the flusher frees a slot
+        db.assert_link(self.L("c", "d"))
+        done = threading.Event()
+        t = threading.Thread(target=lambda: (db.commit(), done.set()))
+        t.start()
+        assert not done.wait(0.3), "commit did not apply backpressure"
+        assert len(db._queue) <= max_pending
+        release.set()
+        t.join(10)
+        assert done.is_set()
+        db.drain()
+        assert db.count() == max_pending + 2
+        db.close()
+
+    def test_concurrent_reader_sees_complete_batches(self):
+        db = WriteBehindLinkDatabase(InMemoryLinkDatabase())
+        errors = []
+
+        def reader():
+            try:
+                for _ in range(50):
+                    rows = db.get_all_links()
+                    assert len(rows) % 10 == 0, len(rows)
+            except BaseException as e:  # surfaced below
+                errors.append(e)
+
+        t = threading.Thread(target=reader)
+        t.start()
+        for batch in range(20):
+            for i in range(10):
+                db.assert_link(self.L(f"a{batch}", f"b{i}"))
+            db.commit()
+        t.join()
+        assert not errors
+        db.close()
+
+
+class TestSqliteBatchAndCount:
+    def L(self, id1, id2, conf=0.9, status=LinkStatus.INFERRED, ts=None):
+        return Link(id1, id2, status, LinkKind.DUPLICATE, conf, ts)
+
+    def test_assert_links_matches_sequential_semantics(self, tmp_path):
+        batched = SqliteLinkDatabase(str(tmp_path / "a.sqlite"))
+        serial = SqliteLinkDatabase(str(tmp_path / "b.sqlite"))
+        links = [
+            self.L("a", "b", conf=0.9, ts=100),
+            self.L("c", "d", conf=0.8, ts=200),
+            self.L("a", "b", conf=0.9, ts=300),   # identical: no ts bump
+            self.L("a", "b", conf=0.95, ts=400),  # changed: rewrites
+            self.L("e", "f", status=LinkStatus.RETRACTED, ts=500),
+        ]
+        batched.assert_links([l.copy() for l in links])
+        for l in links:
+            serial.assert_link(l.copy())
+        assert link_rows(batched) == link_rows(serial)
+        bt = {l.key(): l.timestamp for l in batched.get_all_links()}
+        st = {l.key(): l.timestamp for l in serial.get_all_links()}
+        assert bt == st
+        assert bt[("a", "b")] == 400
+        batched.close()
+        serial.close()
+
+    def test_identical_reassert_keeps_timestamp(self, tmp_path):
+        db = SqliteLinkDatabase(str(tmp_path / "links.sqlite"))
+        db.assert_link(self.L("a", "b", conf=0.9, ts=100))
+        db.assert_links([self.L("a", "b", conf=0.9 + 1e-9, ts=999)])
+        (link,) = db.get_all_links()
+        assert link.timestamp == 100  # pollers must not see a change
+        assert db.get_changes_since(100) == []
+        db.close()
+
+    def test_count_incremental_and_correct(self, tmp_path):
+        path = str(tmp_path / "links.sqlite")
+        db = SqliteLinkDatabase(path)
+        assert db.count() == 0
+        db.assert_link(self.L("a", "b"))
+        db.assert_links([self.L("c", "d"), self.L("e", "f"),
+                         self.L("a", "b", conf=0.5)])  # update, not insert
+        assert db.count() == 3 == len(db.get_all_links())
+        # retraction is a status update: row count unchanged
+        db.assert_link(self.L("a", "b", conf=0.5,
+                              status=LinkStatus.RETRACTED))
+        assert db.count() == 3
+        db.close()
+        # a fresh handle re-counts from the table
+        db2 = SqliteLinkDatabase(path)
+        assert db2.count() == 3
+        db2.close()
+
+    def test_count_is_cached_not_rescanned(self, tmp_path):
+        db = SqliteLinkDatabase(str(tmp_path / "links.sqlite"))
+        db.assert_link(self.L("a", "b"))
+        assert db.count() == 1
+        real = db._conn
+
+        def boom():
+            raise AssertionError("count() hit the database after warm-up")
+
+        db._conn = boom
+        try:
+            assert db.count() == 1  # served from the incremental counter
+        finally:
+            db._conn = real
+        db.close()
+
+
+def test_one_to_one_conflict_prefetch_sees_batch_maybes(tmp_path):
+    """The one-to-one flush's conflict prefetch must see THIS batch's
+    pass-through maybe-link upserts (they downgraded a prior DUPLICATE
+    row), exactly as the legacy per-event writes made visible — a stale
+    DUPLICATE row must not block the batch's definite match."""
+    from sesam_duke_microservice_tpu.engine.listeners import (
+        ServiceMatchListener,
+    )
+
+    db = SqliteLinkDatabase(str(tmp_path / "links.sqlite"))
+    listener = ServiceMatchListener("wl", db, kind="recordlinkage",
+                                    one_to_one=True)
+    a = make_record("A", name="acme")
+    b = make_record("B", name="acme")
+    c = make_record("C", name="acme")
+
+    listener.batch_ready(2)
+    listener.matches(a, c, 0.9)          # batch 1: definite (A, C)
+    listener.batch_done()
+
+    listener.batch_ready(2)
+    listener.matches_perhaps(a, c, 0.65)  # downgraded to maybe...
+    listener.matches(a, b, 0.85)          # ...so (A, B) must win
+    listener.batch_done()
+
+    rows = {(l.id1, l.id2): (l.kind, l.status) for l in db.get_all_links()}
+    assert rows[("A", "B")] == (LinkKind.DUPLICATE, LinkStatus.INFERRED)
+    assert rows[("A", "C")][0] == LinkKind.MAYBE
+    db.close()
+
+
+def test_dispatch_followers_gauge_zeroed_on_mark_failed():
+    from sesam_duke_microservice_tpu import telemetry
+    from sesam_duke_microservice_tpu.parallel.dispatch import Dispatcher
+
+    telemetry.DISPATCH_FOLLOWERS.set(3)
+    d = Dispatcher.__new__(Dispatcher)
+    d._failed = None
+    d.mark_failed("test: follower lost")
+    assert telemetry.DISPATCH_FOLLOWERS._single().value == 0
+    assert telemetry.DISPATCH_DOWN._single().value == 1
+    telemetry.DISPATCH_DOWN.set(0)

@@ -89,9 +89,21 @@ def test_sqlite_persistence(tmp_path):
 
 
 def test_factory(tmp_path):
+    from sesam_duke_microservice_tpu.links import WriteBehindLinkDatabase
+
+    # the durable backend is wrapped in the write-behind flusher (unless
+    # DUKE_WRITE_BEHIND=0); the in-memory backend has nothing to overlap
+    # and stays bare (links.write_behind)
     assert isinstance(create_link_database("in-memory"), InMemoryLinkDatabase)
     db = create_link_database("h2", str(tmp_path / "wl"), is_record_linkage=True)
-    assert isinstance(db, SqliteLinkDatabase)
-    assert db.path.endswith("recordlinkdatabase.sqlite")
+    assert isinstance(db, WriteBehindLinkDatabase)
+    assert isinstance(db.inner, SqliteLinkDatabase)
+    assert db.inner.path.endswith("recordlinkdatabase.sqlite")
     with pytest.raises(ValueError):
         create_link_database("bogus")
+
+
+def test_factory_write_behind_opt_out(tmp_path, monkeypatch):
+    monkeypatch.setenv("DUKE_WRITE_BEHIND", "0")
+    db = create_link_database("h2", str(tmp_path / "wl"))
+    assert isinstance(db, SqliteLinkDatabase)
