@@ -63,6 +63,13 @@ E2E_CORPUS = int(os.environ.get("BENCH_E2E_CORPUS", "8192"))
 E2E_QUERIES = int(os.environ.get("BENCH_E2E_QUERIES", "1024"))
 E2E_GROUP = int(os.environ.get("BENCH_E2E_GROUP", "64"))
 E2E_RUNS = int(os.environ.get("BENCH_E2E_RUNS", "3"))
+# warm-resync ingest bench (this round's encode subsystem): re-POST an
+# already-ingested corpus — the reference's full-resync traffic shape —
+# and compare records/s cold (empty feature cache) vs warm (digest hits)
+# plus the hit/miss split, so BENCH_*.json tracks what the cache buys per
+# release.  BENCH_RESYNC=0 skips it.
+RESYNC = os.environ.get("BENCH_RESYNC", "1") != "0"
+RESYNC_RECORDS = int(os.environ.get("BENCH_RESYNC_RECORDS", "8192"))
 
 
 def stresstest_records(n, seed=1234, dataset="ds1"):
@@ -396,6 +403,71 @@ def e2e_ingest(schema) -> dict:
     }
 
 
+def warm_resync(schema) -> dict:
+    """Warm-resync ingest: records/s re-POSTing an already-ingested corpus.
+
+    Sesam's normal sync mode re-POSTs entire datasets of mostly-unchanged
+    entities; the corpus is append-only with digest-tracked re-upserts, so
+    the pre-PR cost of that traffic was full re-extraction per row.  Two
+    timed passes over identical record content (fresh Record objects each
+    pass, so digests are genuinely recomputed): cold ingests into an
+    empty feature cache, warm re-POSTs the same entities and should
+    encode almost entirely from cache hits.  The encode-phase split is
+    reported separately because on small corpora device scoring can
+    dominate wall time and mask the encode win the cache targets.
+    """
+    from sesam_duke_microservice_tpu.engine.device_matcher import (
+        DeviceIndex,
+        DeviceProcessor,
+    )
+    from sesam_duke_microservice_tpu.ops import feature_cache as FC
+
+    FC.reset()
+    cache_on = FC.active() is not None
+    index = DeviceIndex(schema)
+    proc = DeviceProcessor(schema, index)
+
+    # warmup on a disjoint dataset: compiles + the initial full corpus
+    # upload stay out of both timed passes
+    warm = stresstest_records(RESYNC_RECORDS, seed=321, dataset="rswarm")
+    proc.deduplicate(warm)
+    FC.reset()
+
+    def one_pass(run):
+        batch = stresstest_records(RESYNC_RECORDS, seed=777, dataset="rs")
+        encode0 = proc.phases.phase_seconds().get("encode", 0.0)
+        hits0, misses0, _, _ = FC.stats()
+        t0 = time.perf_counter()
+        proc.deduplicate(batch)
+        dt = time.perf_counter() - t0
+        hits, misses, _, _ = FC.stats()
+        return {
+            "records_per_sec": round(RESYNC_RECORDS / dt, 1),
+            "encode_seconds": round(
+                proc.phases.phase_seconds().get("encode", 0.0) - encode0, 4
+            ),
+            "cache_hits": hits - hits0,
+            "cache_misses": misses - misses0,
+        }
+
+    cold = one_pass(0)
+    warm_run = one_pass(1)
+    return {
+        "metric": "resync_records_per_sec",
+        "cache_mb": FC.budget_mb() if cache_on else 0,
+        "records": RESYNC_RECORDS,
+        "cold": cold,
+        "warm": warm_run,
+        "warm_vs_cold": round(
+            warm_run["records_per_sec"] / cold["records_per_sec"], 2
+        ),
+        "encode_speedup": round(
+            cold["encode_seconds"]
+            / max(warm_run["encode_seconds"], 1e-9), 2
+        ),
+    }
+
+
 def main():
     schema = bench_schema()
     corpus = stresstest_records(CORPUS, seed=1234)
@@ -418,6 +490,8 @@ def main():
     }
     if E2E and BACKEND == "device":
         result["e2e"] = e2e_ingest(schema)
+    if RESYNC and BACKEND == "device":
+        result["resync"] = warm_resync(schema)
     print(json.dumps(result))
     print(
         f"# cpu_baseline={cpu_rate:.0f} pairs/s, device median-of-{len(rates)}"

@@ -124,6 +124,25 @@ def _bucket_for(n: int) -> int:
     return _QUERY_BUCKETS[-1]
 
 
+def _stream_append_slice(n: int) -> Optional[int]:
+    """Slice size for the streamed extract→upload append, or None for the
+    whole-batch path (small batches have nothing to overlap).
+
+    ``DUKE_STREAM_APPEND=0`` pins the legacy whole-batch behavior.  When
+    the full batch qualifies for the shared-memory parallel extractor,
+    slices grow to its minimum slab so every slice still rides the
+    process pool — the overlap must never cost the fan-out.
+    """
+    if os.environ.get("DUKE_STREAM_APPEND", "1") == "0":
+        return None
+    slice_n = _UPDATE_SLICE
+    from ..ops import parallel_extract as PX
+
+    if PX.enabled(n):
+        slice_n = max(slice_n, PX.min_records())
+    return slice_n if n > slice_n else None
+
+
 class DeviceCorpus:
     """Host mirror + device tensors for one workload's indexed records.
 
@@ -141,6 +160,12 @@ class DeviceCorpus:
         self.granule = _CHUNK
         self.capacity = 0
         self.size = 0
+        # incremental live-row count (row_valid & ~row_deleted), maintained
+        # by append/tombstone: per-batch O(capacity) mask scans to compute
+        # it (plus the boolean fancy-index allocation) were measurable at
+        # 10M rows.  External mask mutators must recompute it
+        # (snapshot_load does), same contract as _dirty_masks.
+        self.live_rows = 0
         self.feats: Dict[str, Dict[str, np.ndarray]] = {}
         self.row_valid = np.zeros((0,), dtype=bool)
         self.row_deleted = np.zeros((0,), dtype=bool)
@@ -230,6 +255,7 @@ class DeviceCorpus:
         self.row_deleted[lo:hi] = deleted
         self.row_group[lo:hi] = group
         self.row_ids.extend(ids)
+        self.live_rows += int(n - np.asarray(deleted, dtype=bool).sum())
         old_size, self.size = self.size, self.size + n
         self._mutation_gen += 1
         if not self._dirty_full:
@@ -248,9 +274,41 @@ class DeviceCorpus:
         return rows
 
     def tombstone(self, row: int) -> None:
+        if self.row_valid[row] and not self.row_deleted[row]:
+            self.live_rows -= 1
         self.row_valid[row] = False
         self._mask_rows.append(int(row))
         self._mutation_gen += 1
+
+    def reserve(self, total_rows: int) -> None:
+        """Pre-grow capacity to fit ``total_rows`` ahead of a sliced
+        append: a capacity doubling mid-stream would set ``_dirty_full``
+        and turn every remaining slice flush into a no-op (the whole
+        corpus re-uploads at scoring time instead).  No-op before the
+        first append — tensor shapes are defined by the first batch."""
+        if self.feats and total_rows > self.capacity:
+            self._grow(total_rows)
+
+    def stream_flush(self) -> bool:
+        """Streaming-append overlap: enqueue the incremental device-mirror
+        update for the rows appended so far.  JAX dispatch is
+        asynchronous, so this returns once the jitted tree-update is
+        enqueued — the HBM copy of slice N proceeds while the host
+        extracts slice N+1 (engine.DeviceIndex._append_rows_only).
+
+        No-op (returns False) while a full upload is pending (cold
+        corpus, capacity growth, restored snapshot): re-running the
+        whole-corpus upload per slice would multiply the transfer, and
+        the scoring-time ``device_arrays()`` pays it exactly once
+        instead.  The racy unlocked flag read is writer-side only — the
+        appending thread is the one calling this, and a concurrent
+        warm-upload thread is serialized by the upload lock inside
+        ``device_arrays``.
+        """
+        if self._device is None or self._dirty_full:
+            return False
+        self.device_arrays()
+        return True
 
     # -- device mirror -------------------------------------------------------
 
@@ -692,10 +750,8 @@ class DeviceIndex(CandidateIndex):
             ))
         self._mirror_digest = h.digest()
 
-    def _append_rows_only(self, records: Sequence[Record]) -> np.ndarray:
-        """Extract + corpus append + row mapping — no record-mirror, hash,
-        or live-count updates (the streaming rebuild path, where the
-        record SET is unchanged)."""
+    def _append_chunk(self, records: Sequence[Record]) -> np.ndarray:
+        """Extract + corpus append + row mapping for one contiguous chunk."""
         feats = self._extract(records)
         deleted = np.array([r.is_deleted() for r in records], dtype=bool)
         group = np.array(
@@ -707,6 +763,53 @@ class DeviceIndex(CandidateIndex):
         for r, row in zip(records, rows):
             self.id_to_row[r.record_id] = int(row)
         return rows
+
+    def _append_rows_only(self, records: Sequence[Record]) -> np.ndarray:
+        """Extract + corpus append + row mapping — no record-mirror, hash,
+        or live-count updates (also the streaming rebuild path, where the
+        record SET is unchanged).
+
+        Batches past one update slice stream: the batch is appended in
+        ``_UPDATE_SLICE``-bucketed slices (grown to the parallel-extract
+        minimum when the slab qualifies for the process-pool fan-out, so
+        slicing never forfeits it) and each slice's jitted device update
+        is enqueued asynchronously while the NEXT slice extracts on host
+        — the HBM copy hides under Python extraction instead of
+        serializing after it at scoring time.  Host mirrors, dirty-range
+        accounting, and row mapping advance per slice, so crash/snapshot
+        consistency and the resulting host state are identical to the
+        whole-batch path (held by tests/test_feature_cache.py).
+        """
+        n = len(records)
+        slice_n = _stream_append_slice(n)
+        if slice_n is None:
+            return self._append_chunk(records)
+        corpus = self.corpus
+        # pre-grow once so no slice crosses a capacity doubling (growth
+        # forces a full re-upload, which must not run per slice)
+        corpus.reserve(corpus.size + n)
+        if corpus._device is None or corpus._dirty_full:
+            # nothing to overlap: a full upload is pending (cold corpus,
+            # rebuild, growth, restored snapshot), so every slice flush
+            # would no-op — keep the whole-batch slab (and its full-size
+            # parallel-extract fan-out); scoring pays the one full upload
+            # exactly as before this subsystem
+            return self._append_chunk(records)
+        out = np.empty((n,), dtype=np.int64)
+        done = 0
+        with tracing.span(
+            "encode.stream_append",
+            {"records": n, "slice": slice_n},
+            annotate=True,
+        ):
+            while done < n:
+                chunk = records[done:done + slice_n]
+                rows = self._append_chunk(chunk)
+                out[done:done + len(chunk)] = rows
+                done += len(chunk)
+                if corpus.stream_flush():
+                    telemetry.STREAM_APPEND_SLICES.inc()
+        return out
 
     def _old_liveness(self, records: Sequence[Record]) -> List[bool]:
         """Pre-batch liveness per record, from INDEX state (id_to_row +
@@ -1199,6 +1302,12 @@ class DeviceIndex(CandidateIndex):
         )
         corpus.row_valid[: n] = row_valid
         corpus._dirty_masks = True
+        # the direct mask overwrite above bypassed append/tombstone — the
+        # incremental live counter must be recomputed with it
+        live_count = int(
+            (np.asarray(row_valid) & ~np.asarray(row_deleted)).sum()
+        )
+        corpus.live_rows = live_count
         # corpus tensors are assembled: stream them to HBM while the rest
         # of the restore (row-map wiring below, store/link bring-up in
         # build_workload, service startup) runs on the host
@@ -1217,9 +1326,8 @@ class DeviceIndex(CandidateIndex):
             self.records = records_by_id
         # live = valid rows that are not dukeDeleted (identical to counting
         # non-deleted records, without touching the record payloads)
-        self.live_records = int(
-            (np.asarray(row_valid) & ~np.asarray(row_deleted)).sum()
-        )
+        self.live_records = live_count
+        self._prewarm_feature_cache(feats, records_by_id)
         # adopt the verified digest as the index's running hash AND the
         # store-synced stamp (the restore bypassed the incremental fold)
         self._content_hash = accepted_hash
@@ -1227,6 +1335,49 @@ class DeviceIndex(CandidateIndex):
         logger.info("corpus snapshot restored: %d rows from %s%s", n, path,
                     " (lazy record mirror)" if lazy else "")
         return True
+
+    def _prewarm_feature_cache(self, feats, records_by_id) -> None:
+        """Seed the digest-keyed feature cache from restored snapshot
+        tensors so the FIRST resync after a restart already hits.
+
+        Digests come from the durable store's raw rows (no record decode
+        — ``RecordStore.row_digests`` folds the stored serialization,
+        byte-identical to ``record_digest`` of the live record); plain
+        dict mirrors (tests) fall back to hashing the records.  Budget-
+        bounded by the cache itself; best-effort — a failure leaves the
+        cache cold, never the restore broken.
+        """
+        from ..ops import feature_cache as FC
+
+        cache = FC.active()
+        if cache is None:
+            return
+        try:
+            store = getattr(records_by_id, "_store", None)
+            if store is not None and hasattr(store, "row_digests"):
+                digest_iter = store.row_digests()
+            elif hasattr(records_by_id, "items"):
+                from ..store.records import record_digest
+
+                digest_iter = (
+                    (rid, record_digest(r))
+                    for rid, r in records_by_id.items()
+                )
+            else:
+                return
+            warmed = FC.prewarm(
+                self.plan, getattr(self, "encoder", None), feats,
+                self.id_to_row, digest_iter, cache,
+            )
+            if warmed:
+                logger.info(
+                    "feature cache pre-warmed with %d rows from the "
+                    "snapshot", warmed,
+                )
+        except Exception:  # pragma: no cover - degraded, not broken
+            logger.exception(
+                "feature-cache pre-warm failed (cache stays cold)"
+            )
 
     def warm_upload_async(self) -> None:
         """Dispatch the host-mirror -> HBM corpus upload in the background.
@@ -1851,8 +2002,10 @@ class DeviceProcessor:
         rather than reimplemented (parallel.dispatch invariant 2).
         """
         corpus = self.database.corpus
-        live_rows = int(corpus.row_valid.sum() - corpus.row_deleted[
-            corpus.row_valid].sum())
+        # incremental counter (append/tombstone-maintained): the per-batch
+        # O(capacity) mask scans + boolean fancy-index allocation this
+        # replaces were real work at 10M rows
+        live_rows = corpus.live_rows
 
         from ..utils.profiling import trace_batch
 

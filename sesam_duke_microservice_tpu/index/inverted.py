@@ -31,6 +31,7 @@ exactly as with a real IndexWriter/IndexSearcher pair.
 
 from __future__ import annotations
 
+import heapq
 import math
 import re
 from collections import Counter, defaultdict
@@ -363,18 +364,31 @@ class InvertedIndex(CandidateIndex):
                     score += best
             coord = matched / len(groups)
             scored.append((score * coord * query_norm, slot))
-        scored.sort(key=lambda s: (-s[0], s[1]))
 
         # adaptive limit loop (IncrementalLuceneDatabase.java:386-392): the
         # in-memory search is exhaustive, so "retrying with a larger limit"
-        # reduces to growing the cut-off exactly as the reference would
+        # reduces to growing the cut-off exactly as the reference would.
+        # Only the adaptive limit is ever consumed, so top-limit selection
+        # (heapq.nsmallest over the same (-score, slot) order the full sort
+        # used — identical hits, identical ordering) keeps large candidate
+        # sets at O(C log limit) instead of O(C log C); a grow-and-retry
+        # re-selects, which is the rare case by the estimator's design
+        rank = lambda s: (-s[0], s[1])  # noqa: E731 - shared sort/select key
         max_hits = self.tunables.max_search_hits
         thislimit = min(self._estimator.limit, max_hits)
         while True:
-            hits = scored[:thislimit]
+            if thislimit >= len(scored):
+                hits = sorted(scored, key=rank)
+                break
+            hits = heapq.nsmallest(thislimit, scored, key=rank)
             if len(hits) < thislimit or thislimit == max_hits:
                 break
-            thislimit *= 5
+            # clamp: ``x5`` from an estimator limit that does not divide
+            # max_hits used to skip OVER the cap and grow until the whole
+            # candidate set returned — both more hits than max_search_hits
+            # permits and a terminal full sort on exactly the large
+            # candidate sets the top-limit selection exists for
+            thislimit = min(thislimit * 5, max_hits)
 
         # the reference iterates every returned hit down to min_relevance —
         # max_search_hits caps the *search*, not the match list

@@ -57,11 +57,16 @@ def workers() -> int:
     ))
 
 
+def min_records() -> int:
+    """Smallest slab worth the process-pool fan-out.  Exported because the
+    streaming-append slicer (engine.device_matcher) sizes its extract
+    slices to at least this when the whole batch qualifies — slicing a
+    bulk slab below it would silently forfeit the parallel path."""
+    return int(os.environ.get("DEVICE_EXTRACT_PARALLEL_MIN", "8192"))
+
+
 def enabled(n_records: int) -> bool:
-    min_records = int(
-        os.environ.get("DEVICE_EXTRACT_PARALLEL_MIN", "8192")
-    )
-    return workers() >= 2 and n_records >= min_records
+    return workers() >= 2 and n_records >= min_records()
 
 
 def _pool() -> ProcessPoolExecutor:

@@ -140,6 +140,29 @@ def make_process_collector():
             "Live Python threads (threading.active_count)",
             [("", (), float(threading.active_count()))],
         ))
+        # digest-keyed feature cache (ops/feature_cache.py): scrape-time
+        # snapshots of the cache's plain counters, so the encode hot path
+        # never writes a registry child.  Emitted (at zero) even with the
+        # cache disabled — dashboards keep their series across a
+        # DUKE_FEATURE_CACHE_MB=0 rollback.
+        from ..ops import feature_cache as FC
+
+        hits, misses, evicted, cache_bytes = FC.stats()
+        out.append(FamilySnapshot(
+            "duke_encode_rows_total", "counter",
+            "Feature-encode rows by outcome: served from the digest-keyed "
+            "cache (hit), freshly extracted (miss), or evicted from the "
+            "cache by the byte budget (evicted)",
+            [("", (("outcome", "hit"),), float(hits)),
+             ("", (("outcome", "miss"),), float(misses)),
+             ("", (("outcome", "evicted"),), float(evicted))],
+        ))
+        out.append(FamilySnapshot(
+            "duke_feature_cache_bytes", "gauge",
+            "Bytes held by the digest-keyed feature cache "
+            "(DUKE_FEATURE_CACHE_MB bounds this)",
+            [("", (), float(cache_bytes))],
+        ))
         return out
 
     return collect

@@ -116,6 +116,16 @@ class RecordStore:
         for record in self.all_records():
             yield record.record_id
 
+    def row_digests(self) -> Iterator[tuple]:
+        """(record_id, canonical per-record digest) for every stored row —
+        the same bytes ``record_digest`` yields for the live record, so a
+        consumer can key caches by content without decoding records.
+        Durable backends override to fold the raw stored serialization
+        directly (the feature-cache snapshot pre-warm walks this at
+        restart; a JSON decode per row would defeat the lazy restore)."""
+        for record in self.all_records():
+            yield record.record_id, record_digest(record)
+
     def close(self) -> None:
         pass
 
@@ -389,6 +399,15 @@ class SqliteRecordStore(RecordStore):
     def all_ids(self) -> Iterator[str]:
         for (rid,) in self._conn().execute("SELECT id FROM records"):
             yield rid
+
+    def row_digests(self) -> Iterator[tuple]:
+        # raw rows, no JSON decode: the stored payload IS
+        # serialize_record(record), so _row_digest over it is
+        # byte-identical to record_digest of the live record
+        for rid, data in self._conn().execute(
+            "SELECT id, data FROM records"
+        ):
+            yield rid, _row_digest(rid, data)
 
     def get(self, record_id: str) -> Optional[Record]:
         row = self._conn().execute(

@@ -89,6 +89,19 @@ SCORER_ESCALATIONS = GLOBAL.counter(
     "K/C-escalation re-runs of the device scoring program",
 )
 
+# -- streaming encode (engine/device_matcher.py) -----------------------------
+# Unlocked: incremented by the thread holding the workload lock (same
+# discipline as QUERY_BLOCKS).  The encode-cache hit/miss/evicted rows and
+# cache-bytes gauge are scrape-time snapshots of ops.feature_cache state
+# (service/metrics.make_process_collector) — the encode path never writes
+# a registry child for them.
+STREAM_APPEND_SLICES = GLOBAL.counter(
+    "duke_stream_append_slices_total",
+    "Device-corpus append slices flushed under the extract/upload overlap "
+    "(DUKE_STREAM_APPEND)",
+    locked=False,
+)
+
 # -- multi-host dispatch (parallel/dispatch.py) ------------------------------
 DISPATCH_OPS = GLOBAL.counter(
     "duke_dispatch_ops_total",

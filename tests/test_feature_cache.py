@@ -1,0 +1,456 @@
+"""Streaming-encode tests (ISSUE 4): digest-keyed feature cache +
+extract/upload-overlap append.
+
+Contracts held here:
+
+  * the feature cache is INVISIBLE in every output: listener event
+    sequences and link rows are identical with ``DUKE_FEATURE_CACHE_MB``
+    in {0, default} on the device and ANN backends, including a resync
+    pass (re-POST of identical record content) that serves from cache;
+  * the plan fingerprint self-invalidates on value-slot widening (and
+    any extraction-shaping change) — stale rows can never scatter into a
+    corpus built under a different plan;
+  * the byte budget evicts LRU and is actually respected;
+  * slice-streamed append produces a host mirror, row mapping, and event
+    stream bit-identical to the whole-batch append;
+  * the incremental corpus live-row counter matches the mask formula it
+    replaced through appends, re-upserts, and deletes.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from sesam_duke_microservice_tpu.core import comparators as C
+from sesam_duke_microservice_tpu.core.config import (
+    DukeSchema,
+    MatchTunables,
+)
+from sesam_duke_microservice_tpu.core.records import (
+    ID_PROPERTY_NAME,
+    Property,
+    Record,
+)
+from sesam_duke_microservice_tpu.engine import device_matcher as DM
+from sesam_duke_microservice_tpu.engine.ann_matcher import (
+    AnnIndex,
+    AnnProcessor,
+)
+from sesam_duke_microservice_tpu.engine.device_matcher import (
+    DeviceIndex,
+    DeviceProcessor,
+)
+from sesam_duke_microservice_tpu.engine.listeners import (
+    LinkMatchListener,
+    MatchListener,
+)
+from sesam_duke_microservice_tpu.index.inverted import InvertedIndex
+from sesam_duke_microservice_tpu.links import InMemoryLinkDatabase
+from sesam_duke_microservice_tpu.ops import feature_cache as FC
+from sesam_duke_microservice_tpu.ops import features as F
+
+
+def dedup_schema():
+    numeric = C.Numeric()
+    numeric.min_ratio = 0.5
+    return DukeSchema(
+        threshold=0.8,
+        maybe_threshold=0.6,
+        properties=[
+            Property(ID_PROPERTY_NAME, id_property=True),
+            Property("name", C.Levenshtein(), 0.3, 0.9),
+            Property("city", C.Exact(), 0.4, 0.8),
+            Property("amount", numeric, 0.4, 0.7),
+        ],
+        data_sources=[],
+    )
+
+
+def make_record(rid, **props):
+    r = Record()
+    r.add_value(ID_PROPERTY_NAME, rid)
+    for k, v in props.items():
+        vals = v if isinstance(v, list) else [v]
+        for one in vals:
+            r.add_value(k, one)
+    return r
+
+
+NAMES = [
+    "acme corp", "acme corporation", "globex", "globex inc", "initech",
+    "initech llc", "umbrella", "umbrela", "stark industries", "stark ind",
+]
+CITIES = ["oslo", "bergen", "trondheim"]
+
+
+def random_records(n, seed, prefix="r"):
+    """Deterministic content: regenerating with the same arguments yields
+    FRESH Record objects with identical ids/values — the resync shape
+    (digests recompute, then hit)."""
+    rng = random.Random(seed)
+    records = []
+    for i in range(n):
+        base = rng.choice(NAMES)
+        if rng.random() < 0.4:
+            pos = rng.randrange(len(base))
+            base = base[:pos] + rng.choice("abcdefgh") + base[pos + 1:]
+        records.append(make_record(
+            f"{prefix}{i}",
+            name=base,
+            city=rng.choice(CITIES),
+            amount=str(rng.choice([100, 200, 200, 300, 1000])),
+        ))
+    return records
+
+
+class OrderedLog(MatchListener):
+    def __init__(self):
+        self.events = []
+
+    def matches(self, r1, r2, confidence):
+        self.events.append(
+            ("match", r1.record_id, r2.record_id, round(confidence, 12)))
+
+    def matches_perhaps(self, r1, r2, confidence):
+        self.events.append(
+            ("maybe", r1.record_id, r2.record_id, round(confidence, 12)))
+
+    def no_match_for(self, record):
+        self.events.append(("none", record.record_id))
+
+
+@pytest.fixture
+def cache_env(monkeypatch):
+    """Cache control: yields a setter that re-points the process cache;
+    always resets after the test so suite-wide state stays whatever the
+    session env says."""
+
+    def set_mb(mb):
+        monkeypatch.setenv("DUKE_FEATURE_CACHE_MB", str(mb))
+        FC.reset()
+        return FC.active()
+
+    yield set_mb
+    FC.reset()
+
+
+def _backend(kind, schema):
+    if kind == "ann":
+        index = AnnIndex(schema, dim=32)
+        return index, AnnProcessor(schema, index)
+    index = DeviceIndex(schema)
+    return index, DeviceProcessor(schema, index)
+
+
+def _pipeline(kind, schema, batches):
+    """Run ``batches`` (lists of records) through a fresh backend; returns
+    (event tape, link rows, index)."""
+    index, proc = _backend(kind, schema)
+    log = OrderedLog()
+    db = InMemoryLinkDatabase()
+    proc.add_match_listener(log)
+    proc.add_match_listener(LinkMatchListener(db))
+    for batch in batches:
+        proc.deduplicate(batch)
+    rows = sorted(
+        (l.id1, l.id2, l.status.value, l.kind.value, round(l.confidence, 12))
+        for l in db.get_all_links()
+    )
+    return log.events, rows, index
+
+
+@pytest.mark.parametrize("kind", ["device", "ann"])
+def test_cache_on_off_event_and_link_parity(kind, cache_env):
+    """Identical event streams + link rows with the cache off vs on —
+    including a resync pass that actually serves from the cache."""
+    schema = dedup_schema()
+    batches = lambda: [  # noqa: E731
+        random_records(40, seed=7),
+        random_records(12, seed=8, prefix="s"),
+        random_records(40, seed=7),  # resync: same ids, same content
+    ]
+
+    cache_env(0)
+    assert FC.active() is None
+    events_off, links_off, _ = _pipeline(kind, schema, batches())
+
+    cache = cache_env(64)
+    events_on, links_on, _ = _pipeline(kind, schema, batches())
+
+    assert events_on == events_off
+    assert links_on == links_off
+    # the resync pass re-encoded 40 unchanged records from the cache
+    assert cache.hits >= 40
+
+
+def test_resync_hits_all_rows(cache_env):
+    cache = cache_env(64)
+    schema = dedup_schema()
+    index, proc = _backend("device", schema)
+    proc.deduplicate(random_records(30, seed=3))
+    hits0, misses0 = cache.hits, cache.misses
+    proc.deduplicate(random_records(30, seed=3))
+    assert cache.hits - hits0 == 30
+    assert cache.misses == misses0
+    # re-upserts tombstone + append: corpus holds both generations
+    assert index.corpus.size == 60
+    assert index.corpus.live_rows == 30
+
+
+def test_query_probe_extraction_uses_cache(cache_env):
+    """Query-side _extract (http-transform shape) hits when the query plan
+    matches the plan rows were cached under."""
+    cache = cache_env(64)
+    schema = dedup_schema()
+    index, proc = _backend("device", schema)
+    proc.deduplicate(random_records(20, seed=5))
+    hits0 = cache.hits
+    probes = random_records(20, seed=5)
+    qplan = index._query_plan(probes)
+    out = index._extract(probes, plan=qplan)
+    # single-valued probes -> query plan == corpus plan -> all hits
+    assert cache.hits - hits0 == 20
+    direct = F._extract_direct(qplan, probes)
+    for prop, tensors in direct.items():
+        for name, arr in tensors.items():
+            np.testing.assert_array_equal(out[prop][name], arr)
+
+
+def test_plan_fingerprint_invalidates_on_widening(cache_env):
+    """Value-slot widening changes the fingerprint, so pre-widening rows
+    can never scatter into post-widening tensors — and the widened
+    extraction is correct."""
+    cache = cache_env(64)
+    schema = dedup_schema()
+    index, proc = _backend("device", schema)
+    singles = random_records(16, seed=11)
+    proc.deduplicate(singles)
+    fp_before = FC.plan_fingerprint(index.plan)
+
+    # a two-valued name widens the plan's value axis (auto-sized); the
+    # corpus rebuild re-extracts every stored record under the NEW
+    # fingerprint — all misses, no pre-widening row is ever reused
+    hits0, misses0 = cache.hits, cache.misses
+    proc.deduplicate([make_record(
+        "wide0", name=["acme corp", "acme corporation"],
+        city="oslo", amount="100",
+    )])
+    fp_after = FC.plan_fingerprint(index.plan)
+    assert fp_before != fp_after
+    assert index.plan.device_props[0].values_per_record > 1
+    assert cache.hits == hits0
+    assert cache.misses - misses0 >= 17  # 16 rebuilt + the widening record
+
+    # resync under the widened plan: served from the rebuild-warmed
+    # entries, bit-identical to a direct widened extraction
+    hits1 = cache.hits
+    fresh = random_records(16, seed=11)
+    out = F.extract_batch(index.plan, fresh)
+    assert cache.hits - hits1 == 16
+    direct = F._extract_direct(index.plan, fresh)
+    for prop, tensors in direct.items():
+        for name, arr in tensors.items():
+            np.testing.assert_array_equal(out[prop][name], arr)
+
+
+def test_threshold_only_change_keeps_fingerprint():
+    """low/high retunes (config reload) must NOT invalidate cached rows —
+    they shape scoring, not extraction."""
+    schema = dedup_schema()
+    plan_a = F.SchemaFeatures.plan(schema)
+    retuned = dedup_schema()
+    for p in retuned.properties:
+        if p.name == "name":
+            p.low, p.high = 0.25, 0.95
+    plan_b = F.SchemaFeatures.plan(retuned)
+    assert FC.plan_fingerprint(plan_a) == FC.plan_fingerprint(plan_b)
+
+
+def _fake_row(nbytes):
+    return {"p": {"t": np.zeros((max(1, nbytes // 8),), dtype=np.int64)}}
+
+
+def test_byte_budget_eviction():
+    budget = 10 * 1024
+    cache = FC.FeatureCache(budget)
+    row_bytes = 1024
+    fp = ("fp",)
+    for i in range(20):
+        cache.put_many(fp, [(b"d%02d" % i, _fake_row(row_bytes))])
+    assert cache.bytes <= budget
+    assert cache.evicted > 0
+    assert len(cache) < 20
+    # LRU: the oldest digests are the evicted ones; the newest survive
+    assert cache.get_many(fp, [b"d00"]) == {}
+    assert 0 in cache.get_many(fp, [b"d19"])
+    # a get refreshes recency: touch an old survivor, insert more, and it
+    # outlives untouched peers inserted after it
+    survivors = [d for d in (b"d%02d" % i for i in range(20))
+                 if cache.get_many(("fp",), [d])]
+    victim = survivors[0]
+    cache.get_many(fp, [victim])
+    cache.put_many(fp, [(b"x%02d" % i, _fake_row(row_bytes))
+                        for i in range(len(survivors) - 1)])
+    assert 0 in cache.get_many(fp, [victim])
+    # an over-budget single row is refused, not thrashed
+    cache.put_many(fp, [(b"huge", _fake_row(budget * 2))])
+    assert cache.get_many(fp, [b"huge"]) == {}
+
+
+def test_replacing_same_digest_does_not_leak_bytes():
+    cache = FC.FeatureCache(1 << 20)
+    for _ in range(5):
+        cache.put_many(("fp",), [(b"dig", _fake_row(2048))])
+    assert len(cache) == 1
+    assert cache.bytes < 2 * (2048 + 1024)
+
+
+def test_stream_append_equivalence(cache_env, monkeypatch):
+    """Slice-streamed append == whole-batch append: host mirror, row
+    mapping, masks, and the scored event stream are bit-identical."""
+    schema = dedup_schema()
+    cache_env(0)  # isolate streaming from the cache
+
+    monkeypatch.setenv("DUKE_STREAM_APPEND", "0")
+    events_whole, links_whole, idx_whole = _pipeline(
+        "device", schema,
+        [random_records(40, seed=21), random_records(24, seed=22, prefix="s")],
+    )
+
+    monkeypatch.setattr(DM, "_UPDATE_SLICE", 8)
+    monkeypatch.setenv("DUKE_STREAM_APPEND", "1")
+    assert DM._stream_append_slice(40) == 8
+    events_stream, links_stream, idx_stream = _pipeline(
+        "device", schema,
+        [random_records(40, seed=21), random_records(24, seed=22, prefix="s")],
+    )
+
+    assert events_stream == events_whole
+    assert links_stream == links_whole
+    assert idx_stream.id_to_row == idx_whole.id_to_row
+    a, b = idx_whole.corpus, idx_stream.corpus
+    assert a.size == b.size
+    np.testing.assert_array_equal(a.row_valid[:a.size], b.row_valid[:b.size])
+    np.testing.assert_array_equal(
+        a.row_deleted[:a.size], b.row_deleted[:b.size])
+    assert a.row_ids == b.row_ids
+    for prop, tensors in a.feats.items():
+        for name, arr in tensors.items():
+            np.testing.assert_array_equal(
+                arr[:a.size], b.feats[prop][name][:b.size])
+
+
+def test_stream_append_slice_sizing(monkeypatch):
+    monkeypatch.setenv("DUKE_STREAM_APPEND", "0")
+    assert DM._stream_append_slice(10_000) is None
+    monkeypatch.setenv("DUKE_STREAM_APPEND", "1")
+    monkeypatch.setattr(DM, "_UPDATE_SLICE", 512)
+    assert DM._stream_append_slice(512) is None  # nothing to overlap
+    assert DM._stream_append_slice(513) == 512
+    # a slab that qualifies for the process-pool fan-out keeps it: slices
+    # grow to the parallel-extract minimum
+    monkeypatch.setenv("DEVICE_EXTRACT_WORKERS", "4")
+    monkeypatch.setenv("DEVICE_EXTRACT_PARALLEL_MIN", "2048")
+    assert DM._stream_append_slice(10_000) == 2048
+
+
+def test_live_rows_counter_matches_mask_formula(cache_env):
+    cache_env(0)
+    schema = dedup_schema()
+    index, proc = _backend("device", schema)
+
+    def oracle(corpus):
+        return int(corpus.row_valid.sum()
+                   - corpus.row_deleted[corpus.row_valid].sum())
+
+    proc.deduplicate(random_records(20, seed=31))
+    assert index.corpus.live_rows == oracle(index.corpus) == 20
+    # re-upsert half (tombstone + append) and delete a few
+    proc.deduplicate(random_records(10, seed=31))
+    assert index.corpus.live_rows == oracle(index.corpus) == 20
+    for r in random_records(5, seed=31):
+        index.delete(r)
+    assert index.corpus.live_rows == oracle(index.corpus) == 15
+    # dukeDeleted records append as non-live rows
+    tomb = make_record("t0", name="acme corp", city="oslo", amount="100")
+    tomb.add_value("dukeDeleted", "true")
+    index.index(tomb)
+    index.commit()
+    assert index.corpus.live_rows == oracle(index.corpus) == 15
+
+
+def test_inverted_grow_and_retry_matches_direct_big_limit():
+    """heapq top-limit selection: the adaptive grow-and-retry loop returns
+    the same candidates, in the same order, as starting at the maximum
+    limit (the full-sort oracle)."""
+    schema = DukeSchema(
+        threshold=0.8,
+        maybe_threshold=None,
+        properties=[
+            Property(ID_PROPERTY_NAME, id_property=True),
+            Property("name", C.Levenshtein(), 0.3, 0.9),
+        ],
+        data_sources=[],
+    )
+    tunables = MatchTunables()
+    tunables.min_relevance = 0.0
+    tunables.max_search_hits = 1000
+
+    def build():
+        idx = InvertedIndex(schema, tunables=tunables)
+        rng = random.Random(99)
+        for i in range(120):
+            # shared + distinct tokens -> a large candidate set with a
+            # spread of tf-idf scores (ties broken by slot)
+            name = "shared " + " ".join(
+                rng.choice(["alpha", "beta", "gamma", "delta"])
+                for _ in range(rng.randint(1, 4))
+            )
+            idx.index(make_record(f"i{i}", name=name))
+        idx.commit()
+        return idx
+
+    probe = make_record("q0", name="shared alpha beta")
+    small = build()
+    small._estimator.limit = 2  # forces the grow-and-retry path
+    got_small = [r.record_id for r in small.find_candidate_matches(probe)]
+    big = build()
+    big._estimator.limit = 1000
+    got_big = [r.record_id for r in big.find_candidate_matches(probe)]
+    assert len(got_big) > 10
+    assert got_small == got_big
+
+
+def test_cached_extract_mixed_hit_miss_bit_identical(cache_env):
+    """A batch that is part hits, part misses assembles tensors identical
+    to a direct extraction of the whole batch."""
+    cache_env(64)
+    schema = dedup_schema()
+    plan = F.SchemaFeatures.plan(schema)
+    first = random_records(10, seed=41)
+    F.extract_batch(plan, first)  # populate
+    mixed = random_records(10, seed=41) + random_records(7, seed=42, prefix="m")
+    rng = random.Random(4)
+    rng.shuffle(mixed)
+    out = F.extract_batch(plan, mixed)
+    direct = F._extract_direct(plan, mixed)
+    assert set(out) == set(direct)
+    for prop, tensors in direct.items():
+        assert set(out[prop]) == set(tensors)
+        for name, arr in tensors.items():
+            np.testing.assert_array_equal(out[prop][name], arr)
+
+
+def test_records_without_ids_bypass_cache(cache_env):
+    cache = cache_env(64)
+    schema = dedup_schema()
+    plan = F.SchemaFeatures.plan(schema)
+    r = Record()
+    r.add_value("name", "acme corp")
+    out = F.extract_batch(plan, [r])
+    assert len(cache) == 0
+    direct = F._extract_direct(plan, [r])
+    for prop, tensors in direct.items():
+        for name, arr in tensors.items():
+            np.testing.assert_array_equal(out[prop][name], arr)
