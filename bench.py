@@ -63,6 +63,12 @@ E2E_CORPUS = int(os.environ.get("BENCH_E2E_CORPUS", "8192"))
 E2E_QUERIES = int(os.environ.get("BENCH_E2E_QUERIES", "1024"))
 E2E_GROUP = int(os.environ.get("BENCH_E2E_GROUP", "64"))
 E2E_RUNS = int(os.environ.get("BENCH_E2E_RUNS", "3"))
+# decision-observability bench (ISSUE 5): ingest records/s with decision
+# sampling on (default rate) vs the subsystem hard-disabled, asserting the
+# sampled capture stays under the 5% budget, plus p50/p95 latency of the
+# POST /explain replay path.  BENCH_EXPLAIN=0 skips it.
+EXPLAIN_BENCH = os.environ.get("BENCH_EXPLAIN", "1") != "0"
+EXPLAIN_REPLAYS = int(os.environ.get("BENCH_EXPLAIN_REPLAYS", "50"))
 # warm-resync ingest bench (this round's encode subsystem): re-POST an
 # already-ingested corpus — the reference's full-resync traffic shape —
 # and compare records/s cold (empty feature cache) vs warm (digest hits)
@@ -468,6 +474,126 @@ def warm_resync(schema) -> dict:
     }
 
 
+def _explain_arm(schema, tmpdir, *, recording: bool) -> dict:
+    """One decision-sampling ingest measurement (the _e2e_run shape, on
+    the same duplicate-heavy finalize-bound corpus — every query carries
+    ~GROUP survivors, so per-decision overhead is maximally visible)."""
+    from sesam_duke_microservice_tpu.engine.device_matcher import (
+        DeviceIndex,
+        DeviceProcessor,
+    )
+    from sesam_duke_microservice_tpu.engine.listeners import LinkMatchListener
+    from sesam_duke_microservice_tpu.links.sqlite import SqliteLinkDatabase
+    from sesam_duke_microservice_tpu.links.write_behind import (
+        WriteBehindLinkDatabase,
+    )
+    from sesam_duke_microservice_tpu.telemetry.decisions import (
+        DecisionRecorder,
+    )
+
+    from sesam_duke_microservice_tpu.ops import feature_cache as FC
+
+    # the two arms ingest identical record content; without a reset the
+    # second arm would encode entirely from the first arm's cache hits
+    # and the comparison would measure the cache, not the recorder
+    FC.reset()
+    mode = "rec" if recording else "off"
+    db = WriteBehindLinkDatabase(
+        SqliteLinkDatabase(os.path.join(tmpdir, f"links-{mode}.sqlite"))
+    )
+    index = DeviceIndex(schema)
+    proc = DeviceProcessor(schema, index, threads=(os.cpu_count() or 2))
+    if not recording:
+        # hard-disable the whole subsystem (what DUKE_DECISION_RECORD=0
+        # gives a deployment): the baseline arm
+        proc.decisions = DecisionRecorder(
+            schema.threshold, schema.maybe_threshold, enabled=False,
+        )
+    proc.add_match_listener(LinkMatchListener(db))
+
+    corpus = duplicate_group_records(E2E_CORPUS, E2E_GROUP, seed=42,
+                                     dataset="base")
+    for r in corpus:
+        index.index(r)
+    index.commit()
+    warm = duplicate_group_records(E2E_QUERIES, E2E_GROUP, seed=42,
+                                   dataset="warm")
+    proc.deduplicate(warm)
+    for r in warm:
+        index.delete(r)
+
+    t0 = time.perf_counter()
+    for run in range(E2E_RUNS):
+        batch = duplicate_group_records(
+            E2E_QUERIES, E2E_GROUP, seed=42, dataset=f"ex{mode}{run}"
+        )
+        proc.deduplicate(batch)
+        for r in batch:
+            index.delete(r)
+    db.drain()
+    dt = time.perf_counter() - t0
+    out = {
+        "records_per_sec": round(E2E_RUNS * E2E_QUERIES / dt, 1),
+        "decisions": sum(proc.decisions.outcomes.values()),
+        "ring": len(proc.decisions.ring),
+    }
+    if recording:
+        # replay latency on the live index (the POST /explain path minus
+        # the HTTP socket): p50/p95 over distinct indexed pairs
+        import threading as _threading
+
+        from sesam_duke_microservice_tpu.engine import explain as X
+
+        class _WL:
+            lock = _threading.Lock()
+            closed = False
+            name, kind = "bench", "deduplication"
+            datasources = {}
+
+        wl = _WL()
+        wl.processor, wl.index, wl.link_database = proc, index, db
+        ids = [r.record_id for r in corpus]
+        X.explain_request(wl, {"id1": ids[0], "id2": ids[1]})  # jit warm
+        lat = []
+        for i in range(EXPLAIN_REPLAYS):
+            a = ids[(2 * i) % len(ids)]
+            b = ids[(2 * i + 1) % len(ids)]
+            t1 = time.perf_counter()
+            X.explain_request(wl, {"id1": a, "id2": b})
+            lat.append(time.perf_counter() - t1)
+        lat.sort()
+        out["replay_p50_ms"] = round(lat[len(lat) // 2] * 1e3, 2)
+        out["replay_p95_ms"] = round(lat[int(len(lat) * 0.95)] * 1e3, 2)
+    db.close()
+    return out
+
+
+def explain_bench(schema) -> dict:
+    """Decision-sampling overhead + explain replay latency (ISSUE 5
+    acceptance: sampled capture costs <5% on the ingest path)."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="duke-explain-bench") as tmpdir:
+        off = _explain_arm(schema, tmpdir, recording=False)
+        on = _explain_arm(schema, tmpdir, recording=True)
+    overhead_pct = round(
+        (off["records_per_sec"] - on["records_per_sec"])
+        / off["records_per_sec"] * 100.0, 2,
+    )
+    return {
+        "metric": "decision_sampling_overhead_pct",
+        "value": overhead_pct,
+        "within_budget": overhead_pct < 5.0,
+        "records_per_sec_sampling_on": on["records_per_sec"],
+        "records_per_sec_sampling_off": off["records_per_sec"],
+        "decisions_recorded": on["decisions"],
+        "ring_records": on["ring"],
+        "replay_p50_ms": on["replay_p50_ms"],
+        "replay_p95_ms": on["replay_p95_ms"],
+        "replays": EXPLAIN_REPLAYS,
+    }
+
+
 def main():
     schema = bench_schema()
     corpus = stresstest_records(CORPUS, seed=1234)
@@ -492,6 +618,8 @@ def main():
         result["e2e"] = e2e_ingest(schema)
     if RESYNC and BACKEND == "device":
         result["resync"] = warm_resync(schema)
+    if EXPLAIN_BENCH and BACKEND == "device":
+        result["explain"] = explain_bench(schema)
     print(json.dumps(result))
     print(
         f"# cpu_baseline={cpu_rate:.0f} pairs/s, device median-of-{len(rates)}"

@@ -1,0 +1,90 @@
+#!/usr/bin/env python3
+"""Docs-drift check: every metric family the code registers must be
+documented in README.md, and every ``duke_*`` family README documents
+must exist in the code (ISSUE 5 satellite).
+
+Pure-stdlib static scan (runs in the CI lint job, no package install):
+families are string literals passed to ``counter(``/``gauge(``/
+``histogram(`` registry calls or constructed as scrape-time
+``FamilySnapshot``s — all spelled ``duke_<subsystem>_<metric>[_total]``
+per the telemetry naming scheme, so a regex over the package catches
+exactly the registration sites.  README mentions are any ``duke_*``
+token; sample-suffix forms (``_bucket``/``_sum``/``_count``) and
+label-only fragments normalize back to their family.
+
+Exit 1 with a readable diff when either direction drifts.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = ROOT / "sesam_duke_microservice_tpu"
+README = ROOT / "README.md"
+
+# registration sites: registry.counter("duke_x", ...) / GLOBAL.gauge( /
+# FamilySnapshot("duke_x", ...) — the opening call may break the line
+# before the name literal
+_REGISTRATION_RE = re.compile(
+    r"(?:\.counter|\.gauge|\.histogram|FamilySnapshot)\(\s*\n?\s*"
+    r"['\"](duke_[a-z0-9_]+)['\"]",
+)
+_README_RE = re.compile(r"\bduke_[a-z0-9_]+\b")
+
+# Prometheus sample suffixes that normalize back to the family name
+_SAMPLE_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def code_families() -> set:
+    out = set()
+    for path in sorted(PACKAGE.rglob("*.py")):
+        out |= set(_REGISTRATION_RE.findall(path.read_text(encoding="utf-8")))
+    return out
+
+
+def readme_families(code: set) -> set:
+    out = set()
+    for token in _README_RE.findall(README.read_text(encoding="utf-8")):
+        if token in code:
+            out.add(token)
+            continue
+        for suffix in _SAMPLE_SUFFIXES:
+            if token.endswith(suffix) and token[: -len(suffix)] in code:
+                token = token[: -len(suffix)]
+                break
+        out.add(token)
+    return out
+
+
+def main() -> int:
+    code = code_families()
+    if not code:
+        print("check_metrics_docs: found no registered families — the "
+              "registration regex no longer matches the code; fix me")
+        return 1
+    readme = readme_families(code)
+    undocumented = sorted(code - readme)
+    phantom = sorted(readme - code)
+    ok = True
+    if undocumented:
+        ok = False
+        print("Metric families registered in code but missing from "
+              "README.md:")
+        for name in undocumented:
+            print(f"  - {name}")
+    if phantom:
+        ok = False
+        print("Metric families documented in README.md but not "
+              "registered anywhere:")
+        for name in phantom:
+            print(f"  - {name}")
+    if ok:
+        print(f"check_metrics_docs: {len(code)} families, docs in sync")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -89,6 +89,37 @@ class AnnIndex(DeviceIndex):
             self._scorer_cache = _AnnScorerCache(self)
         return self._scorer_cache
 
+    def explain_retrieval(self, record: Record, candidate: Record,
+                          group_filtering: bool = False) -> dict:
+        """ANN retrieval provenance (ISSUE 5): embedding cosine between
+        the pair plus — when safe — the candidate's actual rank in the
+        query's top-C retrieval.  The rank re-runs the two-stage scorer
+        for this one query; in multi-host serving that would enqueue a
+        device program followers never see (collective desync), so rank
+        is skipped there and cosine alone is reported."""
+        out = super().explain_retrieval(record, candidate, group_filtering)
+        out["mode"] = "ann"
+        out["exhaustive"] = False
+        out["top_c"] = self.initial_top_c
+        e1 = self.encoder.encode(record)
+        e2 = self.encoder.encode(candidate)
+        out["cosine"] = float(np.dot(e1, e2))  # encode() normalizes
+        row = self.id_to_row.get(candidate.record_id)
+        from ..parallel import dispatch
+
+        if row is not None and dispatch.current() is None:
+            result = self.scorer_cache.score_block(
+                [record], group_filtering=group_filtering
+            )
+            positions = np.nonzero(result.top_index[0] == row)[0]
+            if positions.size:
+                out["rank"] = int(positions[0])
+                out["retrieved"] = True
+            else:
+                out["rank"] = None
+                out["retrieved"] = False
+        return out
+
 
 class _AnnScorerCache(_ScorerCache):
     """Caches jitted ANN scorers per (top_c, group_filtering) and runs the

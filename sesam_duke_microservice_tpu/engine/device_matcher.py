@@ -1048,6 +1048,33 @@ class DeviceIndex(CandidateIndex):
                 out.append(rec)
         return out
 
+    def explain_retrieval(self, record: Record, candidate: Record,
+                          group_filtering: bool = False) -> Dict:
+        """Retrieval provenance (ISSUE 5): brute force scores every live
+        corpus row, so the only ways a pair can fail to meet are corpus
+        state (not indexed / tombstoned) and the candidate-mask policy
+        (self-pair, same group) — the pair's actual f32 verdict and
+        bounds ride the ``device`` section of the explanation
+        (engine.explain.device_breakdown)."""
+        out: Dict = {
+            "mode": "device-brute",
+            "exhaustive": True,
+            "survivor_bound": self.scorer_cache._min_logit(),
+        }
+        row = self.id_to_row.get(candidate.record_id)
+        out["candidate_indexed"] = row is not None
+        if row is not None:
+            corpus = self.corpus
+            out["candidate_live"] = bool(
+                corpus.row_valid[row] and not corpus.row_deleted[row]
+            )
+        if group_filtering:
+            g1 = record.get_value(GROUP_NO_PROPERTY_NAME)
+            g2 = candidate.get_value(GROUP_NO_PROPERTY_NAME)
+            out["group_excluded"] = bool(g1 and g1 == g2)
+        out["self_pair"] = record.record_id == candidate.record_id
+        return out
+
     def delete(self, record: Record) -> None:
         from ..store.records import LazyRecordMap, record_digest, xor_fold
 
@@ -1897,6 +1924,8 @@ class DeviceProcessor:
     def __init__(self, schema: DukeSchema, database: DeviceIndex, *,
                  group_filtering: bool = False, profile: bool = False,
                  threads: int = 1):
+        from ..telemetry.decisions import DecisionRecorder
+        from .explain import host_breakdown
         from .finalize import FinalizeExecutor
 
         self.schema = schema
@@ -1908,6 +1937,15 @@ class DeviceProcessor:
         # single-writer per-batch phase durations (workload lock holds
         # the writer exclusivity; readers are lock-free scrapes)
         self.phases = PhaseRecorder()
+        # decision flight recorder + quality-drift monitors (ISSUE 5):
+        # written ONLY by the coordinating thread that emits listener
+        # events (single-writer), scraped lock-free by /metrics and
+        # served by /debug/decisions
+        self.decisions = DecisionRecorder(
+            schema.threshold, schema.maybe_threshold,
+            breakdown=lambda q, c: host_breakdown(schema, q, c),
+            resolver=database.find_record_by_id,
+        )
         self._scorers = database.scorer_cache
         # host finalization of the surviving top-K pairs fans out over
         # this executor (DUKE_FINALIZE_THREADS overrides ``threads``);
@@ -2048,6 +2086,13 @@ class DeviceProcessor:
                 if not out.events:
                     for listener in self.listeners:
                         listener.no_match_for(record)
+                if out.decisions:
+                    # drift monitors + sampled/latched ring records, on
+                    # the serial event-coordinator thread (single-writer)
+                    self.decisions.observe(
+                        record, out.decisions, prune=out.prune,
+                        margin=out.margin, host_bound=out.host_bound,
+                    )
                 self.stats.records_processed += 1
                 self.stats.candidates_retrieved += out.survivors
                 self.stats.pairs_rescored += out.rescored

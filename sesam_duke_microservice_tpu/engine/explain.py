@@ -1,0 +1,373 @@
+"""Match-decision explainability: per-pair score provenance replay.
+
+ISSUE 5 tentpole, the on-demand half: given two records — by id or raw —
+replay the full scoring pipeline in explain mode and return a structured
+breakdown answering "why did (or didn't) A link to B":
+
+  * **retrieval provenance** — how the pair would meet: inverted-index
+    terms hit with tf/idf contributions (host backend,
+    index.inverted.explain_retrieval), embedding cosine + retrieval rank
+    (ANN backends), or the exhaustive brute-force bounds (device
+    backend);
+  * **host breakdown** — per comparison property: the cleaned values,
+    per-value-pair comparator similarities, Duke's probability map, and
+    the clamped naive-Bayes logit contribution.  Contributions sum (from
+    the 0.5 prior, logit 0) to EXACTLY the pair logit
+    ``Processor.compare`` folds — same clamps, same iteration order —
+    so ``sigmoid(sum)`` reproduces the emitted probability bit-for-bit;
+  * **device verdict** — the per-property float32 logits from
+    ``ops.scoring.build_property_logits`` (the explain variant of the
+    jitted fast path: same kernels, never the same jit program), the
+    certified f32 margin, the survivor-filter and decisive-prune bounds,
+    and which band the pair lands in (filtered / pruned / rescored);
+  * **link state** — the current link row between the two ids, if any.
+
+Replay is SIDE-EFFECT FREE by construction: nothing here indexes,
+emits listener events, or writes links — held by the golden parity test
+(tests/test_explain.py).  ``explain_request`` takes the workload lock
+(read-style, 1 s timeout -> busy) for the whole assembly; the first
+explain against a schema jit-compiles a tiny 1x1 pair program under the
+lock (cached per plan after that).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.bayes import probability_logit
+from ..core.records import Record
+from ..telemetry.decisions import classify, explanation_digest
+
+__all__ = [
+    "ExplainBusy",
+    "ExplainError",
+    "host_breakdown",
+    "device_breakdown",
+    "retrieval_provenance",
+    "explain_pair",
+    "explain_request",
+    "resolve_records",
+]
+
+# value-pair rows listed per property in the breakdown; the BEST pair is
+# always reported, this only bounds the exhaustive listing for
+# pathological multi-valued records (V x V combos)
+_MAX_PAIR_ROWS = 16
+
+
+class ExplainError(Exception):
+    """4xx-shaped client error (unknown id / malformed payload)."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+
+
+class ExplainBusy(Exception):
+    """Workload lock unavailable within the read timeout."""
+
+
+# -- host breakdown -----------------------------------------------------------
+
+
+def host_breakdown(schema, r1: Record, r2: Record) -> Dict[str, Any]:
+    """Per-property provenance of ``Processor.compare(r1, r2)``.
+
+    Mirrors the host engine's fold exactly: per property the max over
+    value pairs of ``Property.compare_probability`` (strict ``>`` — the
+    first maximum wins, as in the engine), per-property logit via the
+    same clamped ``core.bayes.probability_logit``, summed from the 0.5
+    prior.  A property with values missing on either side contributes
+    nothing (and reports ``status: "missing"``).
+    """
+    props: List[Dict[str, Any]] = []
+    total = 0.0
+    for prop in schema.comparison_properties():
+        vs1 = [v for v in r1.get_values(prop.name) if v]
+        vs2 = [v for v in r2.get_values(prop.name) if v]
+        entry: Dict[str, Any] = {
+            "name": prop.name,
+            "comparator": (type(prop.comparator).__name__
+                           if prop.comparator is not None else None),
+            "low": prop.low,
+            "high": prop.high,
+            "values1": vs1,
+            "values2": vs2,
+        }
+        if not vs1 or not vs2:
+            entry.update(status="missing", probability=None,
+                         best_similarity=None, logit=0.0)
+            props.append(entry)
+            continue
+        best = 0.0
+        best_sim: Optional[float] = None
+        best_pair: Optional[Tuple[str, str]] = None
+        pair_rows: List[Dict[str, Any]] = []
+        for v1 in vs1:
+            for v2 in vs2:
+                p = prop.compare_probability(v1, v2)
+                sim = (prop.comparator.compare(v1, v2)
+                       if prop.comparator is not None else None)
+                if len(pair_rows) < _MAX_PAIR_ROWS:
+                    pair_rows.append({
+                        "value1": v1, "value2": v2,
+                        "similarity": sim, "probability": p,
+                    })
+                if p > best:
+                    best, best_sim, best_pair = p, sim, (v1, v2)
+        logit = probability_logit(best)
+        total += logit
+        entry.update(
+            status="compared", probability=best, best_similarity=best_sim,
+            best_pair=list(best_pair) if best_pair else None,
+            logit=logit, pairs=pair_rows,
+        )
+        props.append(entry)
+    probability = 1.0 / (1.0 + math.exp(-total))
+    return {
+        "properties": props,
+        "pair_logit": total,
+        "probability": probability,
+    }
+
+
+# -- device breakdown ---------------------------------------------------------
+
+# jitted 1x1 explain programs per plan identity (ops.scoring
+# .build_property_logits); tiny, but re-tracing per request would make
+# /explain latency compile-bound forever
+_SCORER_LOCK = threading.Lock()
+_SCORERS: Dict[tuple, Any] = {}
+_SCORER_CAP = 32
+
+
+def _plan_key(plan) -> tuple:
+    # id() distinguishes comparator PARAMETER changes (QGram q, numeric
+    # min_ratio, ...) that name/kind/low/high/widths would not capture.
+    # Sound only because each cache entry holds a strong reference to
+    # its plan (and so its comparators): a live entry's comparator can
+    # never be garbage-collected, so its id can never be reused by a
+    # different-parameter comparator from a config reload.
+    return tuple(
+        (s.name, s.kind, s.low, s.high, s.v, s.chars, id(s.comparator))
+        for s in plan.device_props
+    )
+
+
+def _explain_scorer(plan):
+    import jax
+
+    from ..ops import scoring as S
+
+    key = _plan_key(plan)
+    with _SCORER_LOCK:
+        entry = _SCORERS.get(key)
+        if entry is None:
+            # (fn, plan): the plan ref pins the comparators — see
+            # _plan_key's id()-soundness note
+            entry = (jax.jit(S.build_property_logits(plan)), plan)
+            if len(_SCORERS) >= _SCORER_CAP:
+                _SCORERS.pop(next(iter(_SCORERS)))
+            _SCORERS[key] = entry
+        return entry[0]
+
+
+def _frozen_plan(plan):
+    """Immutable spec copies: the live plan mutates in place under
+    ingest (value-slot growth, demotion) and a trace must never read a
+    spec mid-mutation (the _ScorerCache._frozen_plan precedent)."""
+    from dataclasses import replace
+
+    from ..ops import features as F
+
+    return F.SchemaFeatures(
+        device_props=[replace(s) for s in plan.device_props],
+        host_props=list(plan.host_props),
+    )
+
+
+def device_breakdown(index, r1: Record, r2: Record, *,
+                     decisive: bool = True) -> Optional[Dict[str, Any]]:
+    """The pair's device-path f32 verdict with per-property provenance.
+
+    Extracts both records under a frozen copy of the CORPUS plan (so
+    char truncation / value-slot caps reproduce what device pruning of
+    indexed rows actually saw) and runs the un-reduced per-property
+    logit program.  Returns None for backends without a feature plan
+    (host inverted index).
+    """
+    import numpy as np
+
+    from ..ops import scoring as S
+
+    plan = getattr(index, "plan", None)
+    if plan is None or not plan.device_props:
+        return None
+    frozen = _frozen_plan(plan)
+    device_names = {s.name for s in frozen.device_props}
+    feats = index._extract([r1, r2], plan=frozen)
+    # the ANN backend rides its embedding matrix through _extract as a
+    # pseudo-property; pair scoring wants only the kernel tensors
+    feats = {k: v for k, v in feats.items() if k in device_names}
+    qf = {prop: {name: arr[0:1] for name, arr in tensors.items()}
+          for prop, tensors in feats.items()}
+    cf = {prop: {name: arr[1:2] for name, arr in tensors.items()}
+          for prop, tensors in feats.items()}
+    per_prop = np.asarray(_explain_scorer(frozen)(qf, cf))[0, 0]
+    device_logit = float(np.asarray(per_prop, dtype=np.float64).sum())
+    schema = index.schema
+    margin = S.certified_f32_margin(frozen)
+    survivor_bound = S.emit_bound_logit(schema, frozen, 1e-3)
+    prune = S.emit_bound_logit(schema, frozen, margin)
+    if device_logit <= survivor_bound:
+        verdict = "filtered"
+    elif decisive and device_logit <= prune:
+        verdict = "pruned"
+    else:
+        verdict = "rescored"
+    return {
+        "per_property": [
+            {"name": spec.name, "logit": float(x)}
+            for spec, x in zip(frozen.device_props, per_prop)
+        ],
+        "host_properties": [p.name for p in frozen.host_props],
+        "logit": device_logit,
+        "certified_margin": margin,
+        "host_bound_logit": S.host_bound_logit(frozen.host_props),
+        "survivor_bound": survivor_bound,
+        "decisive_prune_logit": prune,
+        "decisive_band_enabled": bool(decisive),
+        "band_verdict": verdict,
+    }
+
+
+# -- retrieval provenance -----------------------------------------------------
+
+
+def retrieval_provenance(workload, r1: Record,
+                         r2: Record) -> Optional[Dict[str, Any]]:
+    """How retrieval would (or would not) surface ``r2`` as a candidate
+    for ``r1`` — dispatched to the blocking backend's
+    ``explain_retrieval`` (index.inverted / engine.device_matcher /
+    engine.ann_matcher)."""
+    explain = getattr(workload.index, "explain_retrieval", None)
+    if explain is None:
+        return None
+    gf = bool(getattr(workload.processor, "group_filtering", False))
+    try:
+        return explain(r1, r2, group_filtering=gf)
+    except ValueError as e:
+        # group-filtering precondition (missing dukeGroupNo): report
+        # instead of failing the whole explanation
+        return {"error": str(e)}
+
+
+# -- request assembly ---------------------------------------------------------
+
+
+def _resolve_one(workload, payload: Dict[str, Any], n: int) -> Record:
+    rid = payload.get(f"id{n}")
+    if rid is not None:
+        record = workload.index.find_record_by_id(str(rid))
+        if record is None:
+            raise ExplainError(
+                404, f"Unknown record id '{rid}' for workload "
+                     f"'{workload.name}'")
+        return record
+    raw = payload.get(f"record{n}")
+    if isinstance(raw, dict):
+        dataset = raw.get("dataset")
+        entity = raw.get("entity")
+        if not isinstance(entity, dict):
+            raise ExplainError(
+                400, f"record{n} must be "
+                     "{\"dataset\": <datasetId>, \"entity\": {...}}")
+        datasource = workload.datasources.get(str(dataset))
+        if datasource is None:
+            raise ExplainError(
+                404, f"Unknown dataset-id '{dataset}' for workload "
+                     f"'{workload.name}'")
+        try:
+            return datasource.record_for_entity(entity)
+        except Exception as e:
+            raise ExplainError(400, f"record{n} conversion failed: {e}")
+    raise ExplainError(
+        400, f"Provide id{n} (an indexed record id) or record{n} "
+             "({\"dataset\": ..., \"entity\": {...}})")
+
+
+def resolve_records(workload, payload: Dict[str, Any]) -> Tuple[Record, Record]:
+    return _resolve_one(workload, payload, 1), _resolve_one(workload, payload, 2)
+
+
+def _existing_link(workload, id1: str, id2: str) -> Optional[Dict[str, Any]]:
+    try:
+        for link in workload.link_database.get_all_links_for(id1):
+            if {link.id1, link.id2} == {id1, id2}:
+                return {
+                    "status": link.status.value,
+                    "kind": link.kind.value,
+                    "confidence": link.confidence,
+                    "timestamp": link.timestamp,
+                }
+    except Exception:
+        return None  # closed/raced link DB: omit rather than fail
+    return None
+
+
+def explain_pair(workload, r1: Record, r2: Record) -> Dict[str, Any]:
+    """Assemble the full explanation (call with ``workload.lock`` held)."""
+    from ..store.records import record_digest
+
+    schema = workload.processor.schema
+    host = host_breakdown(schema, r1, r2)
+    probability = host["probability"]
+    outcome = classify(probability, schema.threshold,
+                       schema.maybe_threshold)
+    finalizer = getattr(workload.processor, "finalizer", None)
+    device = device_breakdown(
+        workload.index, r1, r2,
+        decisive=finalizer.decisive if finalizer is not None else True,
+    )
+    out: Dict[str, Any] = {
+        "workload": workload.name,
+        "kind": workload.kind,
+        "id1": r1.record_id,
+        "id2": r2.record_id,
+        "thresholds": {
+            "threshold": schema.threshold,
+            "maybe_threshold": schema.maybe_threshold,
+        },
+        "retrieval": retrieval_provenance(workload, r1, r2),
+        "properties": host["properties"],
+        "pair_logit": host["pair_logit"],
+        "probability": probability,
+        "classification": outcome,
+        "link": _existing_link(workload, r1.record_id, r2.record_id),
+        "explanation_digest": explanation_digest(
+            record_digest(r1), record_digest(r2), probability),
+    }
+    if device is not None:
+        out["device"] = device
+    return out
+
+
+def explain_request(workload, payload: Dict[str, Any], *,
+                    lock_timeout: float = 1.0) -> Dict[str, Any]:
+    """``POST /explain`` entry: lock (read-timeout semantics, matching
+    the feed endpoints), resolve the two records, assemble the
+    explanation.  Raises ``ExplainBusy`` on lock timeout and
+    ``ExplainError`` for client errors."""
+    if not isinstance(payload, dict):
+        raise ExplainError(400, "Request body must be a JSON object")
+    if not workload.lock.acquire(timeout=lock_timeout):
+        raise ExplainBusy()
+    try:
+        if workload.closed:
+            raise ExplainBusy()
+        r1, r2 = resolve_records(workload, payload)
+        return explain_pair(workload, r1, r2)
+    finally:
+        workload.lock.release()

@@ -37,6 +37,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence, Tuple
 
 from ..core.records import Record
+from ..telemetry.decisions import PairDecision
 
 
 class QueryOutcome:
@@ -45,16 +46,31 @@ class QueryOutcome:
     ``events`` holds ``(event_name, candidate, probability)`` in survivor
     (descending device logit) order — exactly what the serial loop would
     have emitted; an empty list means ``no_match_for``.
+
+    ``decisions`` carries the per-pair decision inputs
+    (telemetry.decisions.PairDecision, survivor order) for the decision
+    recorder — empty when recording is disabled; ``prune``/``margin``/
+    ``host_bound`` are the block's decisive bound, certified f32 margin
+    and optimistic host-property logit (None/0 without a decisive band).
     """
 
-    __slots__ = ("events", "survivors", "rescored", "skipped")
+    __slots__ = ("events", "survivors", "rescored", "skipped",
+                 "decisions", "prune", "margin", "host_bound")
 
     def __init__(self, events: List[Tuple[str, Record, float]],
-                 survivors: int, rescored: int, skipped: int):
+                 survivors: int, rescored: int, skipped: int,
+                 decisions: Optional[list] = None,
+                 prune: Optional[float] = None,
+                 margin: Optional[float] = None,
+                 host_bound: float = 0.0):
         self.events = events
         self.survivors = survivors
         self.rescored = rescored
         self.skipped = skipped
+        self.decisions = decisions if decisions is not None else []
+        self.prune = prune
+        self.margin = margin
+        self.host_bound = host_bound
 
 
 def _resolve_threads(threads: int, use_env: bool) -> int:
@@ -127,6 +143,17 @@ class FinalizeExecutor:
         # between batches (long-text demotion) and the bound must track it
         prune = (S.decisive_prune_logit(proc.schema, database.plan)
                  if self.decisive else None)
+        # decision-recorder inputs (telemetry.decisions): the certified
+        # margin classifies near-threshold band skips, the host bound
+        # turns a device logit into the f32 filter verdict.  Collected
+        # only when the processor carries an enabled recorder — the
+        # per-pair PairDecision alloc stays off the disabled path.
+        recorder = getattr(proc, "decisions", None)
+        record_decisions = recorder is not None and recorder.enabled
+        margin = (S.certified_f32_margin(database.plan)
+                  if record_decisions and prune is not None else None)
+        host_bound = (S.host_bound_logit(database.plan.host_props)
+                      if record_decisions else 0.0)
         resolver = records_map.get
         if not isinstance(records_map, dict):
             # lazy store-backed mirrors (LazyRecordMap) mutate an LRU on
@@ -145,6 +172,7 @@ class FinalizeExecutor:
             events: List[Tuple[str, Record, float]] = []
             survivors = result.survivors(qi)
             rescored = skipped = 0
+            decisions: List[PairDecision] = []
             rec_id = record.record_id
             for row, device_logit in survivors:
                 rid = row_ids[row]
@@ -154,17 +182,24 @@ class FinalizeExecutor:
                     # upper-bound probability certifiably below the
                     # minimum emit threshold: no event possible
                     skipped += 1
+                    if record_decisions:
+                        decisions.append(
+                            PairDecision(rid, device_logit, True, None))
                     continue
                 candidate = resolver(rid)
                 if candidate is None:
                     continue
                 prob = compare(record, candidate)
                 rescored += 1
+                if record_decisions:
+                    decisions.append(
+                        PairDecision(rid, device_logit, False, prob))
                 if prob > threshold:
                     events.append(("matches", candidate, prob))
                 elif maybe is not None and maybe != 0.0 and prob > maybe:
                     events.append(("matches_perhaps", candidate, prob))
-            return QueryOutcome(events, len(survivors), rescored, skipped)
+            return QueryOutcome(events, len(survivors), rescored, skipped,
+                                decisions, prune, margin, host_bound)
 
         if self.threads <= 1 or len(block) <= 1:
             return [one(qi, r) for qi, r in enumerate(block)]

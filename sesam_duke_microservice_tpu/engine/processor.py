@@ -84,6 +84,9 @@ class Processor:
     def __init__(self, schema: DukeSchema, database: CandidateIndex,
                  *, group_filtering: bool = False, threads: int = 1,
                  profile: bool = False):
+        from ..telemetry.decisions import DecisionRecorder
+        from .explain import host_breakdown
+
         self.schema = schema
         self.database = database
         self.group_filtering = group_filtering
@@ -95,6 +98,19 @@ class Processor:
         # attribute math, no locks on the scoring path; /metrics and
         # /stats read it lock-free like the ProfileStats counters
         self.phases = PhaseRecorder()
+        # decision monitors/ring (ISSUE 5): host pairs carry no device
+        # pre-score, so only outcome counters, the pair-logit histogram
+        # and sampled ring records apply; writes serialize on the
+        # listener lock (the threaded per-record loop's existing
+        # emission barrier)
+        self.decisions = DecisionRecorder(
+            schema.threshold, schema.maybe_threshold,
+            breakdown=lambda q, c: host_breakdown(schema, q, c),
+            # bare-compare embedders (the bench CPU baseline) pass no
+            # database; sampled records then skip the breakdown
+            resolver=(database.find_record_by_id
+                      if database is not None else None),
+        )
         self._listener_lock = threading.Lock()
 
     def add_match_listener(self, listener: MatchListener) -> None:
@@ -182,11 +198,14 @@ class Processor:
         threshold = self.schema.threshold
         maybe = self.schema.maybe_threshold
         pairs = 0
+        scored = [] if self.decisions.enabled else None
         for candidate in candidates:
             if candidate.record_id == record.record_id:
                 continue
             prob = self.compare(record, candidate)
             pairs += 1
+            if scored is not None:
+                scored.append((candidate.record_id, prob))
             if prob > threshold:
                 found = True
                 self._emit("matches", record, candidate, prob)
@@ -197,6 +216,11 @@ class Processor:
             with self._listener_lock:
                 for listener in self.listeners:
                     listener.no_match_for(record)
+        if scored:
+            # the recorder is single-writer; the listener lock is the
+            # serialization point the threaded loop already has
+            with self._listener_lock:
+                self.decisions.observe_pairs(record, scored)
 
         t2 = time.monotonic()
         self.stats.records_processed += 1

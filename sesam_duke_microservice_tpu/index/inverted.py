@@ -237,13 +237,24 @@ class InvertedIndex(CandidateIndex):
 
     def find_candidate_matches(self, record: Record,
                                group_filtering: bool = False) -> List[Record]:
-        # fuzzy_search expands each token of a tokenized-comparator property
-        # into the indexed terms within 2 edits (transpositions counted, as
-        # in Lucene's FuzzyQuery automaton) — the reference's per-token
-        # FuzzyQuery (IncrementalLuceneDatabase.java:308-326; Lucene
-        # default maxEdits=2), rewritten as a term disjunction.  Each
-        # original token stays ONE scoring group whatever its expansion, so
-        # enabling fuzzy never dilutes exact-match scores via coord.
+        should, must, must_not_slots = self._query_clauses(
+            record, group_filtering
+        )
+        return self._do_query(should, must, must_not_slots)
+
+    def _query_clauses(self, record: Record, group_filtering: bool):
+        """Build the candidate query for one record: (should, must,
+        must_not_slots) — shared by ``find_candidate_matches`` and the
+        explain path so provenance can never drift from retrieval.
+
+        fuzzy_search expands each token of a tokenized-comparator property
+        into the indexed terms within 2 edits (transpositions counted, as
+        in Lucene's FuzzyQuery automaton) — the reference's per-token
+        FuzzyQuery (IncrementalLuceneDatabase.java:308-326; Lucene
+        default maxEdits=2), rewritten as a term disjunction.  Each
+        original token stays ONE scoring group whatever its expansion, so
+        enabling fuzzy never dilutes exact-match scores via coord.
+        """
         fuzzy = self.tunables.fuzzy_search
         should: List[List[Tuple[str, str]]] = []  # groups of alternatives
         must: List[List[Tuple[str, str]]] = []
@@ -270,7 +281,7 @@ class InvertedIndex(CandidateIndex):
                 )
             must_not_slots |= self._postings.get((GROUP_NO_PROPERTY_NAME, group_no), set())
 
-        return self._do_query(should, must, must_not_slots)
+        return should, must, must_not_slots
 
     def _fuzzy_terms(self, field: str, token: str) -> List[Tuple[str, str]]:
         """The query token plus indexed terms within 2 edits (OSA distance,
@@ -302,7 +313,9 @@ class InvertedIndex(CandidateIndex):
         self._fuzzy_cache[key] = out
         return out
 
-    def _do_query(self, should, must, must_not_slots) -> List[Record]:
+    def _prepare_groups(self, should, must):
+        """Dedup'd scoring groups + idf table + query norm, or None when
+        the query is empty (shared by ``_do_query`` and explain)."""
         # dedup groups by their primary (exact) term, preserving order —
         # repeated tokens score once, exactly as set(clauses) did pre-fuzzy
         groups: List[List[Tuple[str, str]]] = []
@@ -312,7 +325,7 @@ class InvertedIndex(CandidateIndex):
                 seen.add(group[0])
                 groups.append(group)
         if not groups:
-            return []
+            return None
 
         n_docs = max(len(self._docs), 1)
         flat = {alt for group in groups for alt in group}
@@ -325,6 +338,36 @@ class InvertedIndex(CandidateIndex):
         query_norm = 1.0 / math.sqrt(
             sum(idf[g[0]] ** 2 for g in groups) or 1.0
         )
+        return groups, idf, query_norm
+
+    def _group_contrib(self, doc: _Doc, group, idf):
+        """One scoring group's best contribution for one doc:
+        (contribution, (field, token, freq) of the winning alternative).
+        The ONE copy of the classic tf·idf²·fieldNorm term — retrieval
+        scoring and explain provenance can never drift apart."""
+        best = 0.0
+        best_clause = None
+        for field, token in group:
+            counts = doc.field_tokens.get(field)
+            if not counts:
+                break  # same field for every alternative
+            freq = counts.get(token, 0)
+            if freq == 0:
+                continue
+            tf = math.sqrt(freq)
+            field_norm = 1.0 / math.sqrt(doc.field_lengths[field])
+            contrib = tf * (idf[(field, token)] ** 2) * field_norm
+            if contrib > best:
+                best = contrib
+                best_clause = (field, token, freq)
+        return best, best_clause
+
+    def _do_query(self, should, must, must_not_slots) -> List[Record]:
+        prepared = self._prepare_groups(should, must)
+        if prepared is None:
+            return []
+        groups, idf, query_norm = prepared
+        flat = {alt for group in groups for alt in group}
 
         # candidate doc set; a MUST group (REQUIRED lookup) is satisfied by
         # any of its fuzzy-expanded alternatives
@@ -346,19 +389,7 @@ class InvertedIndex(CandidateIndex):
             score = 0.0
             matched = 0
             for group in groups:
-                best = 0.0
-                for field, token in group:
-                    counts = doc.field_tokens.get(field)
-                    if not counts:
-                        break  # same field for every alternative
-                    freq = counts.get(token, 0)
-                    if freq == 0:
-                        continue
-                    tf = math.sqrt(freq)
-                    field_norm = 1.0 / math.sqrt(doc.field_lengths[field])
-                    contrib = tf * (idf[(field, token)] ** 2) * field_norm
-                    if contrib > best:
-                        best = contrib
+                best, _ = self._group_contrib(doc, group, idf)
                 if best > 0.0:
                     matched += 1
                     score += best
@@ -401,6 +432,74 @@ class InvertedIndex(CandidateIndex):
         if hits:
             self._estimator.record_result(len(matches))
         return matches
+
+    def explain_retrieval(self, record: Record, candidate: Record,
+                          group_filtering: bool = False) -> Dict:
+        """Retrieval provenance for one (query, candidate) pair (ISSUE 5):
+        which analyzed terms of the query's lookup properties hit the
+        candidate's indexed fields, with the same tf·idf²·fieldNorm
+        contributions, coord and query norm the live query applies —
+        built on the exact clause/scoring helpers
+        ``find_candidate_matches`` uses.  Side-effect free: the adaptive
+        result estimator is never fed from here.
+        """
+        should, must, must_not_slots = self._query_clauses(
+            record, group_filtering
+        )
+        out: Dict = {
+            "mode": "inverted-index",
+            "min_relevance": self.tunables.min_relevance,
+        }
+        slot = self._id_to_slot.get(candidate.record_id)
+        if slot is None:
+            out["candidate_indexed"] = False
+            return out
+        out["candidate_indexed"] = True
+        out["excluded"] = slot in must_not_slots  # deleted / same group
+        prepared = self._prepare_groups(should, must)
+        if prepared is None:
+            out.update(score=0.0, terms=[], retrieved=False)
+            return out
+        groups, idf, query_norm = prepared
+        doc = self._docs[slot]
+        terms = []
+        matched = 0
+        raw_score = 0.0
+        for group in groups:
+            best, clause = self._group_contrib(doc, group, idf)
+            if best > 0.0 and clause is not None:
+                matched += 1
+                raw_score += best
+                field, token, freq = clause
+                terms.append({
+                    "field": field,
+                    "token": token,
+                    "frequency": freq,
+                    "idf": idf[(field, token)],
+                    "contribution": best,
+                    "fuzzy": token != group[0][1],
+                    "required": group in must,
+                })
+        must_ok = all(
+            any(slot in self._postings.get(alt, ()) for alt in group)
+            for group in must
+        )
+        coord = matched / len(groups)
+        score = raw_score * coord * query_norm
+        out.update(
+            terms=terms,
+            groups=len(groups),
+            matched_groups=matched,
+            coord=coord,
+            query_norm=query_norm,
+            score=score,
+            required_satisfied=must_ok,
+            # the adaptive result limit can additionally cut a low-ranked
+            # hit (EstimateResultTracker); this reports the score gate
+            retrieved=(not out["excluded"] and must_ok and matched > 0
+                       and score >= self.tunables.min_relevance),
+        )
+        return out
 
     def close(self) -> None:
         pass

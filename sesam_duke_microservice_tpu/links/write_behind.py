@@ -1,13 +1,18 @@
-"""Write-behind link persistence with a drain barrier on every read.
+"""Write-behind persistence: generic buffer + the link-database wrapper.
 
 The persist phase used to flush each batch's link upserts synchronously
 inside ``batch_done`` — serial with the next microbatch's encode phase.
-This wrapper buffers writes in arrival order and flushes them on a single
-background thread (one ``assert_links`` transaction + ``commit`` per
-batch), so the durable flush overlaps the next microbatch's encode/device
-work instead of extending the persist phase.
+``WriteBehindLinkDatabase`` buffers writes in arrival order and flushes
+them on a single background thread (one ``assert_links`` transaction +
+``commit`` per batch), so the durable flush overlaps the next
+microbatch's encode/device work instead of extending the persist phase.
 
-Consistency contract:
+The buffering/flusher/latch core is ``WriteBehindBuffer`` — extracted so
+the decision audit log (telemetry.decisions.AuditLog, ISSUE 5) rides the
+SAME machinery instead of growing a second background-flush
+implementation with subtly different drain/latch rules.
+
+Consistency contract (the link wrapper):
 
   * **Ordering** — writes apply in arrival order; ``commit()`` seals the
     current buffer as one batch and enqueues it (non-blocking).
@@ -30,30 +35,47 @@ from __future__ import annotations
 import logging
 import threading
 from collections import deque
-from typing import List, Optional
+from typing import Callable, List, Optional, Sequence
 
 from .base import Link, LinkDatabase
 
 logger = logging.getLogger("links-write-behind")
 
 
-class WriteBehindLinkDatabase(LinkDatabase):
-    # backpressure: at most this many sealed batches may be pending
-    # behind the flusher; commit() blocks past it, so a slow disk turns
-    # into ingest backpressure instead of unbounded queue growth — and
-    # every drain barrier (reads, scrapes) is bounded by a handful of
-    # flush transactions rather than an arbitrary backlog
-    _MAX_PENDING = 4
+class WriteBehindBuffer:
+    """Generic arrival-order write-behind core.
 
-    def __init__(self, inner: LinkDatabase):
-        self.inner = inner
+    Items accumulate in an open buffer; ``commit()`` seals the buffer as
+    one batch and enqueues it for the background flusher, which hands
+    each batch to ``flush`` (one call per batch — the transaction
+    boundary).  ``drain()`` is the read barrier; a flush failure latches
+    the buffer (every later ``add``/``commit``/``drain`` raises), unless
+    constructed with ``drop_on_overflow`` AND the embedder opts to treat
+    the latch as advisory by catching the error.
+
+    ``max_pending`` bounds the sealed-batch queue.  Past it, ``commit()``
+    either blocks (backpressure — the link-database stance: a slow disk
+    must throttle ingest, not grow memory) or, with
+    ``drop_on_overflow=True``, discards the oldest pending batch and
+    counts it in ``dropped`` (the audit-log stance: observability output
+    must never block scoring).
+    """
+
+    def __init__(self, flush: Callable[[List], None], *,
+                 max_pending: int = 4, drop_on_overflow: bool = False,
+                 name: str = "write-behind"):
+        self._flush = flush
+        self._max_pending = max(1, max_pending)
+        self._drop_on_overflow = drop_on_overflow
+        self._name = name
         self._cv = threading.Condition()
-        self._buf: List[Link] = []
+        self._buf: List = []
         self._queue: deque = deque()
         self._inflight = False
         self._error: Optional[BaseException] = None
         self._closed = False
         self._thread: Optional[threading.Thread] = None
+        self.dropped = 0  # batches discarded by the overflow policy
 
     # -- worker --------------------------------------------------------------
 
@@ -61,7 +83,7 @@ class WriteBehindLinkDatabase(LinkDatabase):
         # called with _cv held
         if self._thread is None or not self._thread.is_alive():
             self._thread = threading.Thread(
-                target=self._run, daemon=True, name="link-flush"
+                target=self._run, daemon=True, name=self._name
             )
             self._thread.start()
 
@@ -75,10 +97,9 @@ class WriteBehindLinkDatabase(LinkDatabase):
                 batch = self._queue.popleft()
                 self._inflight = True
             try:
-                self.inner.assert_links(batch)
-                self.inner.commit()
+                self._flush(batch)
             except BaseException as e:  # latch: readers/writers must see it
-                logger.exception("write-behind link flush failed")
+                logger.exception("%s flush failed", self._name)
                 with self._cv:
                     self._error = e
                     self._inflight = False
@@ -93,31 +114,39 @@ class WriteBehindLinkDatabase(LinkDatabase):
         # called with _cv held
         if self._error is not None:
             raise RuntimeError(
-                "link write-behind flush failed; the link store is stale "
-                "(reload the workload to recover)"
+                f"{self._name} flush failed; the backing store is stale"
             ) from self._error
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        return self._error
 
     # -- writes (buffered, arrival order) ------------------------------------
 
-    def assert_link(self, link: Link) -> None:
+    def add(self, item) -> None:
         with self._cv:
             self._raise_latched()
-            self._buf.append(link)
+            self._buf.append(item)
 
-    def assert_links(self, links: List[Link]) -> None:
+    def add_many(self, items: Sequence) -> None:
         with self._cv:
             self._raise_latched()
-            self._buf.extend(links)
+            self._buf.extend(items)
 
     def commit(self) -> None:
         """Seal the buffered writes as one batch and enqueue the flush;
-        returns immediately unless the flusher is ``_MAX_PENDING`` batches
-        behind (then it blocks — backpressure, not unbounded memory)."""
+        returns immediately unless the flusher is ``max_pending`` batches
+        behind (then it blocks — or drops the oldest pending batch under
+        ``drop_on_overflow``)."""
         with self._cv:
             self._raise_latched()
             if not self._buf:
                 return
-            while len(self._queue) >= self._MAX_PENDING:
+            while len(self._queue) >= self._max_pending:
+                if self._drop_on_overflow:
+                    self._queue.popleft()
+                    self.dropped += 1
+                    continue
                 self._cv.wait()
                 self._raise_latched()
             batch, self._buf = self._buf, []
@@ -133,6 +162,61 @@ class WriteBehindLinkDatabase(LinkDatabase):
             while (self._queue or self._inflight) and self._error is None:
                 self._cv.wait()
             self._raise_latched()
+
+    def close(self) -> None:
+        """Drain (best-effort past a latched failure) and stop the
+        flusher thread.  Does NOT close whatever ``flush`` writes to —
+        that remains the embedder's resource."""
+        try:
+            self.drain()
+        except RuntimeError:
+            pass  # latched failure: nothing left to save
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+            thread = self._thread
+        if thread is not None:
+            thread.join(timeout=10.0)
+
+
+class WriteBehindLinkDatabase(LinkDatabase):
+    # backpressure: at most this many sealed batches may be pending
+    # behind the flusher; commit() blocks past it, so a slow disk turns
+    # into ingest backpressure instead of unbounded queue growth — and
+    # every drain barrier (reads, scrapes) is bounded by a handful of
+    # flush transactions rather than an arbitrary backlog
+    _MAX_PENDING = 4
+
+    def __init__(self, inner: LinkDatabase):
+        self.inner = inner
+        self._wb = WriteBehindBuffer(
+            self._flush_batch, max_pending=self._MAX_PENDING,
+            name="link write-behind",
+        )
+
+    def _flush_batch(self, batch: List[Link]) -> None:
+        self.inner.assert_links(batch)
+        self.inner.commit()
+
+    # test/introspection compatibility: the sealed-batch queue lives in
+    # the shared buffer now
+    @property
+    def _queue(self) -> deque:
+        return self._wb._queue
+
+    # -- writes (buffered, arrival order) ------------------------------------
+
+    def assert_link(self, link: Link) -> None:
+        self._wb.add(link)
+
+    def assert_links(self, links: List[Link]) -> None:
+        self._wb.add_many(links)
+
+    def commit(self) -> None:
+        self._wb.commit()
+
+    def drain(self) -> None:
+        self._wb.drain()
 
     # -- reads (drain first) -------------------------------------------------
 
@@ -168,14 +252,5 @@ class WriteBehindLinkDatabase(LinkDatabase):
     # -- lifecycle -----------------------------------------------------------
 
     def close(self) -> None:
-        try:
-            self.drain()
-        except RuntimeError:
-            pass  # latched failure: nothing left to save
-        with self._cv:
-            self._closed = True
-            self._cv.notify_all()
-            thread = self._thread
-        if thread is not None:
-            thread.join(timeout=10.0)
+        self._wb.close()
         self.inner.close()

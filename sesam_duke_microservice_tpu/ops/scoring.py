@@ -419,6 +419,40 @@ def build_pair_logits(plan: F.SchemaFeatures) -> Callable:
     return pair_logits
 
 
+def build_property_logits(plan: F.SchemaFeatures) -> Callable:
+    """The ``explain=True`` variant of ``build_pair_logits``: returns
+    fn(qfeats, cfeats) -> (Q, C, P) with the PER-PROPERTY clamped
+    log-odds vector kept un-reduced (axis P follows
+    ``plan.device_props`` order).  Sums over P to the same pair logit
+    the fast path computes — same kernels, same probability map, same
+    clamps — but lives as a SEPARATE builder so the jitted fast path
+    (``build_pair_logits``/``scan_topk``) is never perturbed by explain
+    traffic.  Pallas tile branches are disabled (``pallas_ok=False``):
+    explain calls score a handful of pairs, where the flat XLA kernels
+    avoid compiling Mosaic programs for one-off shapes.
+
+    Used by the decision-explainability layer (engine.explain) to
+    reproduce a pair's device f32 verdict with per-property provenance.
+    """
+
+    specs = list(plan.device_props)
+
+    def property_logits(qfeats: Dict[str, Dict],
+                        cfeats: Dict[str, Dict]) -> jnp.ndarray:
+        first = next(iter(qfeats.values()))
+        q = first["valid"].shape[0]
+        firstc = next(iter(cfeats.values()))
+        c = firstc["valid"].shape[0]
+        per_prop = [
+            _property_logit(spec, qfeats[spec.name], cfeats[spec.name],
+                            q, c, pallas_ok=False)
+            for spec in specs
+        ]
+        return jnp.stack(per_prop, axis=-1)  # (Q, C, P)
+
+    return property_logits
+
+
 def candidate_mask(cvalid, cdeleted, cgroup, cidx, query_group, query_row,
                    group_filtering: bool):
     """(Q, chunk) candidate-eligibility mask shared by every retrieval path.

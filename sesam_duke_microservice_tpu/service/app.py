@@ -261,11 +261,12 @@ _ENTITY_PATH = re.compile(
 _FEED_PATH = re.compile(r"^/(deduplication|recordlinkage)/([^/]*)$")
 _REMATCH_PATH = re.compile(r"^/(deduplication|recordlinkage)/([^/]+)/rematch$")
 _DEBUG_TRACE_PATH = re.compile(r"^/debug/traces/([0-9a-f]{32})$")
+_DEBUG_DECISION_PATH = re.compile(r"^/debug/decisions/(d\d+)$")
 
 _STATIC_ROUTES = frozenset((
     "/", "/config", "/health", "/healthz", "/readyz", "/metrics", "/stats",
-    "/debug/traces", "/debug/requests", "/debug/profile",
-    "/debug/profile/reset",
+    "/debug/traces", "/debug/requests", "/debug/decisions", "/explain",
+    "/debug/profile", "/debug/profile/reset",
 ))
 
 
@@ -277,6 +278,8 @@ def _route_template(path: str) -> str:
         return path
     if _DEBUG_TRACE_PATH.match(path):
         return "/debug/traces/:id"
+    if _DEBUG_DECISION_PATH.match(path):
+        return "/debug/decisions/:id"
     if m := _REMATCH_PATH.match(path):
         return f"/{m.group(1)}/:name/rematch"
     if m := _ENTITY_PATH.match(path):
@@ -465,6 +468,10 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
             self._reply(*debug_api.handle_trace(m.group(1), fmt))
         elif path == "/debug/requests":
             self._reply(*debug_api.handle_requests())
+        elif path == "/debug/decisions":
+            self._reply(*debug_api.handle_decisions(self.app))
+        elif m := _DEBUG_DECISION_PATH.match(path):
+            self._reply(*debug_api.handle_decision(self.app, m.group(1)))
         elif path == "/debug/profile":
             self._reply(*debug_api.handle_profile_status())
         elif m := _ENTITY_PATH.match(path):
@@ -482,6 +489,8 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
         path = parsed.path
         if path == "/config":
             self._handle_config_upload(body)
+        elif path == "/explain":
+            self._reply(*debug_api.handle_explain(self.app, body))
         elif path == "/debug/profile":
             self._reply(*debug_api.handle_profile_start(
                 parse_qs(parsed.query)))
@@ -529,6 +538,31 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
             ),
             "workloads": [],
         }
+        # operator summary of the digest-keyed feature cache (PR 4):
+        # until now the hit rate existed only as raw Prometheus series
+        from ..ops import feature_cache as FC
+
+        hits, misses, evicted, cache_bytes = FC.stats()
+        looked_up = hits + misses
+        out["feature_cache"] = {
+            "hits": hits,
+            "misses": misses,
+            "evicted": evicted,
+            "bytes": cache_bytes,
+            "hit_rate": round(hits / looked_up, 4) if looked_up else None,
+        }
+        # audit-loss visibility: drop-on-overflow is by design, but an
+        # operator treating the JSONL as evidence needs to SEE the loss
+        from ..telemetry.decisions import audit_log
+
+        audit = audit_log()
+        if audit is not None:
+            out["audit_log"] = {
+                "path": audit.path,
+                "entries": audit.entries,
+                "dropped_batches": audit.dropped,
+                "disabled": audit.disabled,
+            }
         for kind, registry in (
             ("deduplication", self.app.deduplications),
             ("recordlinkage", self.app.record_linkages),
@@ -561,6 +595,27 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                         retrieval_seconds=round(stats.retrieval_seconds, 3),
                         compare_seconds=round(stats.compare_seconds, 3),
                     )
+                    # decisive-band split (PR 3): survivors rescored
+                    # host-exact vs certifiably skipped, previously only
+                    # visible as duke_finalize_pairs_total series
+                    if getattr(wl.processor, "finalizer", None) is not None:
+                        finalized = stats.pairs_rescored + stats.pairs_skipped
+                        row["finalize"] = {
+                            "rescored": stats.pairs_rescored,
+                            "skipped": stats.pairs_skipped,
+                            "skip_rate": (
+                                round(stats.pairs_skipped / finalized, 4)
+                                if finalized else None
+                            ),
+                        }
+                recorder = getattr(wl.processor, "decisions", None)
+                if recorder is not None and recorder.enabled:
+                    row["decisions"] = {
+                        "outcomes": dict(recorder.outcomes),
+                        "disagreements": recorder.disagreements,
+                        "ring": len(recorder.ring),
+                        "latched": recorder.latched,
+                    }
                 phases = getattr(wl.processor, "phases", None)
                 if phases is not None:
                     row["phase_seconds"] = {

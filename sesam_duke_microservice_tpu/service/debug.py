@@ -1,18 +1,23 @@
-"""``/debug`` surface: flight recorder + on-demand device profiling.
+"""``/debug`` surface: flight recorders, decisions, explain, profiling.
 
-Rendering helpers for the four debug endpoints (ISSUE 2):
+Rendering helpers for the debug/explainability endpoints (ISSUE 2 + 5):
 
     GET  /debug/traces              recent retained-trace summaries
     GET  /debug/traces/<id>         one tree, ?format=json|chrome
     GET  /debug/requests            always-on last-N request digests
+    GET  /debug/decisions           decision flight-recorder ring
+    GET  /debug/decisions/<id>      one full decision record
+    POST /explain                   replay a pair in explain mode
     POST /debug/profile?seconds=N   on-demand jax.profiler capture
     POST /debug/profile/reset       re-arm the PROFILE_TRACE_DIR budget
 
 Each helper returns ``(status, body_bytes, content_type)`` so the HTTP
 layer stays a thin switch (service/app.py) and the logic is unit-testable
 without a socket.  Everything here reads recorder snapshots under the
-recorder's own short lock — never engine state, never the workload locks,
-so ``/debug`` cannot stall ingest.
+recorders' own short locks — never engine state, never the workload locks
+— EXCEPT ``handle_explain``, which replays the pipeline under the target
+workload's lock with the feed endpoints' 1 s read-timeout semantics, so
+``/debug`` still cannot stall ingest.
 """
 
 from __future__ import annotations
@@ -56,6 +61,101 @@ def handle_trace(trace_id: str, fmt: str = "json",
 def handle_requests(recorder: tracing.FlightRecorder = None) -> Reply:
     recorder = recorder if recorder is not None else tracing.RECORDER
     return _reply_json(200, {"requests": recorder.digests()})
+
+
+def _decision_workloads(app):
+    for kind, registry in (("deduplication", app.deduplications),
+                           ("recordlinkage", app.record_linkages)):
+        for name, wl in list(registry.items()):
+            recorder = getattr(wl.processor, "decisions", None)
+            if recorder is not None:
+                yield kind, name, wl, recorder
+
+
+def handle_decisions(app) -> Reply:
+    """``GET /debug/decisions``: the decision flight-recorder ring across
+    every live workload, most recent first.  Full per-property payloads
+    stay behind ``/debug/decisions/<id>`` — the listing is a summary."""
+    rows = []
+    for kind, name, _wl, recorder in _decision_workloads(app):
+        for record in recorder.records():
+            rows.append({
+                "id": record["id"],
+                "kind": kind,
+                "workload": name,
+                "time_unix": record.get("time_unix"),
+                "query": record.get("query"),
+                "candidate": record.get("candidate"),
+                "outcome": record.get("outcome"),
+                "probability": record.get("probability"),
+                "device_logit": record.get("device_logit"),
+                "latched": record.get("latched"),
+                "sampled": record.get("sampled"),
+                "trace_id": record.get("trace_id"),
+            })
+    # numeric sort on the sequence part: the zero-padding runs out at
+    # 10^8 decisions and lexicographic order would put newest last
+    rows.sort(key=lambda r: int(r["id"][1:]), reverse=True)
+    return _reply_json(200, {"decisions": rows})
+
+
+def handle_decision(app, decision_id: str) -> Reply:
+    """``GET /debug/decisions/<id>``: one full decision record."""
+    for kind, name, _wl, recorder in _decision_workloads(app):
+        record = recorder.get(decision_id)
+        if record is not None:
+            out = dict(record)
+            out["kind"] = kind
+            out["workload"] = name
+            return _reply_json(200, out)
+    return _reply_json(404, {
+        "error": f"decision {decision_id!r} is not in the ring "
+                 "(evicted, unsampled, or never existed)"})
+
+
+def handle_explain(app, body: bytes) -> Reply:
+    """``POST /explain``: replay one pair through the pipeline in explain
+    mode (engine.explain).  Body selects the workload (``kind``/``name``,
+    optional when exactly one workload exists) and the two records
+    (``id1``/``id2`` or raw ``record1``/``record2``)."""
+    from ..engine import explain as X
+
+    try:
+        payload = json.loads(body.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return _reply_json(400, {"error": "Request body must be JSON"})
+    if not isinstance(payload, dict):
+        return _reply_json(400, {"error": "Request body must be a JSON object"})
+
+    registries = {"deduplication": app.deduplications,
+                  "recordlinkage": app.record_linkages}
+    kind = payload.get("kind")
+    name = payload.get("name") or payload.get("workload")
+    candidates = []
+    for k, registry in registries.items():
+        if kind is not None and k != str(kind):
+            continue
+        for n, wl in registry.items():
+            if name is None or n == str(name):
+                candidates.append((k, n, wl))
+    if not candidates:
+        return _reply_json(404, {
+            "error": f"no workload matches kind={kind!r} name={name!r}"})
+    if len(candidates) > 1:
+        return _reply_json(400, {
+            "error": "ambiguous workload — pass \"kind\" and \"name\"",
+            "workloads": [{"kind": k, "name": n} for k, n, _ in candidates],
+        })
+    _, _, workload = candidates[0]
+    try:
+        return _reply_json(200, X.explain_request(workload, payload))
+    except X.ExplainBusy:
+        return (503,
+                b"The workload is being written to, so explaining is not "
+                b"currently possible. Please wait a bit and try again "
+                b"later.", "text/plain")
+    except X.ExplainError as e:
+        return _reply_json(e.status, {"error": str(e)})
 
 
 def handle_profile_status() -> Reply:

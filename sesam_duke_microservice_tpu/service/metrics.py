@@ -198,6 +198,11 @@ def make_app_collector(app):
         warm_samples = []
         finalize_samples = []
         finalize_threads = []
+        decision_samples = []
+        disagreement_samples = []
+        pair_logit_samples = []
+        margin_slack_samples = []
+        similarity_samples = []
         for kind, name, wl in _workload_iter(app):
             labels = (("kind", kind), ("workload", name))
             proc = wl.processor
@@ -214,6 +219,23 @@ def make_app_collector(app):
                     ("", labels, stats.candidates_retrieved))
                 counter_samples["pairs"].append(
                     ("", labels, stats.pairs_compared))
+            recorder = getattr(proc, "decisions", None)
+            if recorder is not None and recorder.enabled:
+                # quality-drift monitors (ISSUE 5): single-writer
+                # engine-side state, snapshotted here at scrape time —
+                # the decision path never writes a registry child
+                for outcome, value in recorder.outcomes.items():
+                    decision_samples.append(
+                        ("", labels + (("outcome", outcome),), value))
+                disagreement_samples.append(
+                    ("", labels, recorder.disagreements))
+                pair_logit_samples.extend(
+                    recorder.pair_logit_hist.samples(labels))
+                margin_slack_samples.extend(
+                    recorder.margin_slack_hist.samples(labels))
+                for prop, hist in list(recorder.similarity_hists.items()):
+                    similarity_samples.extend(
+                        hist.samples(labels + (("property", prop),)))
             finalizer = getattr(proc, "finalizer", None)
             if finalizer is not None and stats is not None:
                 # decisive-band split: survivors rescored host-exact vs
@@ -314,6 +336,30 @@ def make_app_collector(app):
                 "duke_finalize_threads", "gauge",
                 "Worker threads in the host-finalization pool "
                 "(DUKE_FINALIZE_THREADS)", finalize_threads))
+        if decision_samples:
+            out.append(FamilySnapshot(
+                "duke_decisions_total", "counter",
+                "Match decisions by outcome (match, maybe, reject, or "
+                "pruned by the decisive band)", decision_samples))
+            out.append(FamilySnapshot(
+                "duke_decision_disagreements_total", "counter",
+                "Decisions where the float32 device verdict crossed a "
+                "threshold the exact f64 rescore did not (or vice versa)",
+                disagreement_samples))
+            out.append(FamilySnapshot(
+                "duke_pair_logit", "histogram",
+                "Distribution of finalized pair logits (log-odds of the "
+                "emitted f64 probability)", pair_logit_samples))
+            out.append(FamilySnapshot(
+                "duke_decisive_margin_slack", "histogram",
+                "Slack below the decisive-band prune bound for skipped "
+                "survivors (logit units; small slack = near-threshold "
+                "skip)", margin_slack_samples))
+            if similarity_samples:
+                out.append(FamilySnapshot(
+                    "duke_property_similarity", "histogram",
+                    "Per-property comparator similarity of sampled "
+                    "decisions (best value pair)", similarity_samples))
         return out
 
     return collect

@@ -1,0 +1,27 @@
+"""Shared env-knob parsing for the telemetry layer.
+
+Malformed values fall back to the default — several of these run at
+import time (the global trace recorder) or per-processor construction,
+and a typo'd manifest must not keep the service from starting (the
+convention every env knob in this codebase follows).
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["env_int", "env_float"]
+
+
+def env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default

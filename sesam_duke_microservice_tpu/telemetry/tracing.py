@@ -55,6 +55,7 @@ import time
 from collections import deque
 from typing import Any, Dict, Iterator, List, Optional
 
+from .env import env_int
 from .logctx import current_request_id
 
 __all__ = [
@@ -83,14 +84,9 @@ __all__ = [
 
 # -- env knobs (read at call time so tests and reloads take effect) ----------
 
-def _env_int(name: str, default: int) -> int:
-    """Malformed values fall back (this runs at import via the global
-    RECORDER — a typo'd manifest must not keep the service from
-    starting)."""
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
+# shared fallback-on-ValueError parsing (telemetry.env): this runs at
+# import via the global RECORDER
+_env_int = env_int
 
 
 def _sample_rate() -> float:
@@ -321,19 +317,22 @@ class FlightRecorder:
     Ring sizes come from ``TRACE_RING_SIZE`` (retained trees, default 128)
     and ``REQUEST_RING_SIZE`` (digests, default 512) at construction.
     All mutation happens at retention time under a short lock — never on
-    the span recording path.
+    the span recording path.  The retained-tree ring is a
+    ``rings.LatchedRing`` (the eviction/latch core shared with the
+    decision recorder): slow/errored traces are *remarkable*, so an
+    upstream stamping every request sampled cannot flush them.
     """
 
     def __init__(self, trace_capacity: Optional[int] = None,
                  digest_capacity: Optional[int] = None):
+        from .rings import LatchedRing
+
         if trace_capacity is None:
             trace_capacity = _env_int("TRACE_RING_SIZE", 128)
         if digest_capacity is None:
             digest_capacity = _env_int("REQUEST_RING_SIZE", 512)
         self._lock = threading.Lock()
-        self._order: deque = deque()
-        self._traces: Dict[str, TraceRecord] = {}
-        self._capacity = max(1, trace_capacity)
+        self._ring = LatchedRing(max(1, trace_capacity))
         self._digests: deque = deque(maxlen=max(1, digest_capacity))
 
     def finish(self, trace: _Trace, root: Span) -> bool:
@@ -360,8 +359,9 @@ class FlightRecorder:
         }
         with self._lock:
             self._digests.append(digest)
-            if retain:
-                existing = self._traces.get(trace.trace_id)
+        if retain:
+            with self._ring.lock:
+                existing = self._ring.get(trace.trace_id)
                 if existing is not None:
                     # the same trace id retained again — a follower
                     # replaying several ops of one request, or a client
@@ -382,38 +382,23 @@ class FlightRecorder:
                         existing.status = root.status
                     existing.duration_ms = max(
                         existing.duration_ms, root.duration_ns / 1e6)
+                    record = existing
                 else:
                     record = TraceRecord(trace, root, slow=slow)
-                    self._order.append(record.trace_id)
-                    self._traces[record.trace_id] = record
-                    while len(self._order) > self._capacity:
-                        self._evict_one()
+                # keeps the key's ring position on merge; eviction
+                # prefers unremarkable (fast, ok) records — rings.py
+                self._ring.put(
+                    record.trace_id, record,
+                    remarkable=record.slow or record.status != "ok",
+                )
         return retain
-
-    def _evict_one(self) -> None:
-        """Evict preferring the oldest UNREMARKABLE (sampled-only, fast,
-        ok) record: an upstream that stamps every request sampled=01
-        must not flush the slow/errored traces the tail latch exists to
-        keep.  O(capacity) scan, paid only at retention time."""
-        for tid in self._order:
-            r = self._traces.get(tid)
-            if r is None or (not r.slow and r.status == "ok"):
-                self._order.remove(tid)
-                self._traces.pop(tid, None)
-                return
-        evicted = self._order.popleft()
-        self._traces.pop(evicted, None)
 
     def summaries(self) -> List[Dict[str, Any]]:
         """Most-recent-first summary rows for ``GET /debug/traces``."""
-        with self._lock:
-            records = [self._traces[tid] for tid in self._order
-                       if tid in self._traces]
-        return [r.summary() for r in reversed(records)]
+        return [r.summary() for r in self._ring.records()]
 
     def get(self, trace_id: str) -> Optional[TraceRecord]:
-        with self._lock:
-            return self._traces.get(trace_id)
+        return self._ring.get(trace_id)
 
     def digests(self) -> List[Dict[str, Any]]:
         """Most-recent-first request digests for ``GET /debug/requests``."""
@@ -421,9 +406,8 @@ class FlightRecorder:
             return list(reversed(self._digests))
 
     def clear(self) -> None:
+        self._ring.clear()
         with self._lock:
-            self._order.clear()
-            self._traces.clear()
             self._digests.clear()
 
 

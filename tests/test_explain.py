@@ -1,0 +1,651 @@
+"""Match-decision explainability tests (ISSUE 5).
+
+The contracts under test:
+
+  * per-property host contributions sum (from the 0.5 prior) to EXACTLY
+    the pair logit ``Processor.compare`` folds (1e-6 acceptance, held to
+    1e-9), and ``sigmoid(sum)`` reproduces the emitted probability;
+  * the device explain program's per-property f32 logits sum to the
+    host-exact logit over the device properties within the certified
+    f32 margin — and match the LIVE scorer's device logit for indexed
+    pairs, across the brute-force and ANN backends;
+  * explain-mode replay is side-effect free: interleaving ``/explain``
+    calls with ingest leaves the listener event tape and the link rows
+    bit-identical to an untouched run;
+  * the decision ring's tail latch retains every disagreement and
+    near-threshold band skip at sample rate 0, and the shared
+    ``LatchedRing`` honors capacity/byte budgets while preferring
+    unremarkable evictions;
+  * the audit log writes one JSONL row per confirmed link with the
+    explanation digest ``/explain`` reproduces;
+  * the HTTP surface: ``POST /explain``, ``GET /debug/decisions[/<id>]``.
+"""
+
+import json
+import math
+import random
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from sesam_duke_microservice_tpu.core import comparators as C
+from sesam_duke_microservice_tpu.core.config import (
+    DukeSchema,
+    MatchTunables,
+    parse_config,
+)
+from sesam_duke_microservice_tpu.core.records import (
+    ID_PROPERTY_NAME,
+    Property,
+    Record,
+)
+from sesam_duke_microservice_tpu.engine import explain as X
+from sesam_duke_microservice_tpu.engine.ann_matcher import AnnIndex
+from sesam_duke_microservice_tpu.engine.device_matcher import (
+    DeviceIndex,
+    DeviceProcessor,
+)
+from sesam_duke_microservice_tpu.engine.listeners import MatchListener
+from sesam_duke_microservice_tpu.engine.workload import build_workload
+from sesam_duke_microservice_tpu.ops import scoring as S
+from sesam_duke_microservice_tpu.telemetry.decisions import (
+    DecisionRecorder,
+    PairDecision,
+    audit_log,
+)
+from sesam_duke_microservice_tpu.telemetry.rings import LatchedRing
+
+
+def dedup_schema(threshold=0.8, maybe=0.6):
+    numeric = C.Numeric()
+    numeric.min_ratio = 0.5
+    return DukeSchema(
+        threshold=threshold,
+        maybe_threshold=maybe,
+        properties=[
+            Property(ID_PROPERTY_NAME, id_property=True),
+            Property("name", C.Levenshtein(), 0.3, 0.9),
+            Property("city", C.Exact(), 0.4, 0.8),
+            Property("amount", numeric, 0.4, 0.7),
+        ],
+        data_sources=[],
+    )
+
+
+def make_record(rid, **props):
+    r = Record()
+    r.add_value(ID_PROPERTY_NAME, rid)
+    for k, v in props.items():
+        r.add_value(k, v)
+    return r
+
+
+NAMES = [
+    "acme corp", "acme corporation", "globex", "globex inc", "initech",
+    "initech llc", "umbrella", "umbrela", "stark industries", "stark ind",
+]
+CITIES = ["oslo", "bergen", "trondheim"]
+
+
+def random_records(n, seed, prefix="r"):
+    rng = random.Random(seed)
+    records = []
+    for i in range(n):
+        base = rng.choice(NAMES)
+        if rng.random() < 0.4:
+            pos = rng.randrange(len(base))
+            base = base[:pos] + rng.choice("abcdefgh") + base[pos + 1:]
+        records.append(make_record(
+            f"{prefix}{i}",
+            name=base,
+            city=rng.choice(CITIES),
+            amount=str(rng.choice([100, 200, 200, 300, 1000])),
+        ))
+    return records
+
+
+class OrderedLog(MatchListener):
+    def __init__(self):
+        self.events = []
+
+    def matches(self, r1, r2, confidence):
+        self.events.append(
+            ("match", r1.record_id, r2.record_id, round(confidence, 9)))
+
+    def matches_perhaps(self, r1, r2, confidence):
+        self.events.append(
+            ("maybe", r1.record_id, r2.record_id, round(confidence, 9)))
+
+    def no_match_for(self, record):
+        self.events.append(("none", record.record_id))
+
+
+# -- host breakdown -----------------------------------------------------------
+
+
+class TestHostBreakdown:
+    def test_contributions_sum_to_compare(self):
+        from sesam_duke_microservice_tpu.engine.processor import Processor
+        from sesam_duke_microservice_tpu.index.inverted import InvertedIndex
+
+        schema = dedup_schema()
+        proc = Processor(schema, InvertedIndex(schema))
+        records = random_records(24, seed=7)
+        for i in range(0, len(records) - 1, 2):
+            r1, r2 = records[i], records[i + 1]
+            out = X.host_breakdown(schema, r1, r2)
+            contrib_sum = sum(p["logit"] for p in out["properties"])
+            assert contrib_sum == pytest.approx(out["pair_logit"], abs=1e-9)
+            # acceptance: 1e-6 on host — held far tighter
+            assert abs(out["probability"] - proc.compare(r1, r2)) < 1e-12
+
+    def test_missing_property_contributes_nothing(self):
+        schema = dedup_schema()
+        r1 = make_record("a", name="acme corp")  # no city/amount
+        r2 = make_record("b", name="acme corp", city="oslo", amount="100")
+        out = X.host_breakdown(schema, r1, r2)
+        by_name = {p["name"]: p for p in out["properties"]}
+        assert by_name["city"]["status"] == "missing"
+        assert by_name["city"]["logit"] == 0.0
+        assert by_name["name"]["status"] == "compared"
+        assert by_name["name"]["best_similarity"] == 1.0
+
+
+# -- device breakdown ---------------------------------------------------------
+
+
+def _ingested_index(index_cls, schema, records):
+    index = index_cls(schema, tunables=MatchTunables())
+    for r in records:
+        index.index(r)
+    index.commit()
+    return index
+
+
+@pytest.mark.parametrize("index_cls", [DeviceIndex, AnnIndex])
+class TestDeviceBreakdown:
+    def test_per_property_sum_within_certified_margin(self, index_cls):
+        schema = dedup_schema()
+        records = random_records(12, seed=3)
+        index = _ingested_index(index_cls, schema, records)
+        margin = S.certified_f32_margin(index.plan)
+        for r1, r2 in zip(records[::2], records[1::2]):
+            out = X.device_breakdown(index, r1, r2)
+            per_sum = sum(p["logit"] for p in out["per_property"])
+            assert per_sum == pytest.approx(out["logit"], abs=1e-6)
+            # f32 device logit vs host-exact f64 logit over the device
+            # properties: the certified-margin acceptance bound
+            host = X.host_breakdown(schema, r1, r2)
+            host_by_name = {p["name"]: p["logit"]
+                            for p in host["properties"]}
+            device_names = {p["name"] for p in out["per_property"]}
+            host_device_logit = sum(
+                v for k, v in host_by_name.items() if k in device_names
+            )
+            assert abs(out["logit"] - host_device_logit) <= margin
+
+    def test_matches_live_scorer_logit(self, index_cls):
+        schema = dedup_schema()
+        records = random_records(10, seed=11)
+        index = _ingested_index(index_cls, schema, records)
+        margin = S.certified_f32_margin(index.plan)
+        query = records[0]
+        result = index.scorer_cache.score_block(
+            [query], group_filtering=False
+        )
+        survivors = dict(result.survivors(0))
+        checked = 0
+        for row, live_logit in survivors.items():
+            rid = index.corpus.row_ids[row]
+            candidate = index.records[rid]
+            out = X.device_breakdown(index, query, candidate)
+            # explain re-extracts under the same corpus plan and runs
+            # the same kernels: within two margins of the live scorer
+            assert abs(out["logit"] - live_logit) <= 2 * margin + 1e-5
+            checked += 1
+        assert checked > 0
+
+
+# -- golden explain parity ----------------------------------------------------
+
+
+class TestExplainParity:
+    CONFIG = """
+<DukeMicroService>
+  <Deduplication name="people" link-database-type="in-memory">
+    <duke>
+      <schema>
+        <threshold>0.8</threshold>
+        <maybe-threshold>0.6</maybe-threshold>
+        <property><name>NAME</name>
+          <comparator>levenshtein</comparator><low>0.3</low><high>0.9</high>
+        </property>
+        <property><name>CITY</name>
+          <comparator>exact</comparator><low>0.4</low><high>0.8</high>
+        </property>
+      </schema>
+      <data-source class="io.sesam.dukemicroservice.IncrementalDeduplicationDataSource">
+        <param name="dataset-id" value="crm"/>
+        <column name="name" property="NAME"/>
+        <column name="city" property="CITY"/>
+      </data-source>
+    </duke>
+  </Deduplication>
+</DukeMicroService>
+"""
+
+    def _entities(self):
+        rng = random.Random(5)
+        out = []
+        for i in range(40):
+            base = rng.choice(NAMES)
+            out.append({
+                "_id": str(i), "name": base, "city": rng.choice(CITIES),
+            })
+        return out
+
+    @pytest.mark.parametrize("backend", ["host", "device"])
+    def test_replay_leaves_pipeline_bit_identical(self, backend):
+        entities = self._entities()
+        batches = [entities[:20], entities[20:]]
+
+        def run(with_explain):
+            sc = parse_config(self.CONFIG)
+            wl = build_workload(
+                sc.deduplications["people"], sc, backend=backend,
+                persistent=False,
+            )
+            log = OrderedLog()
+            wl.processor.add_match_listener(log)
+            try:
+                with wl.lock:
+                    wl.process_batch("crm", batches[0])
+                if with_explain:
+                    # replay BETWEEN batches: by ids, by raw records,
+                    # and a mixed pair — none of it may perturb batch 2
+                    X.explain_request(wl, {
+                        "id1": "crm__0", "id2": "crm__1"})
+                    X.explain_request(wl, {
+                        "record1": {"dataset": "crm",
+                                    "entity": entities[2]},
+                        "id2": "crm__3"})
+                with wl.lock:
+                    wl.process_batch("crm", batches[1])
+                if with_explain:
+                    X.explain_request(wl, {"id1": "crm__4",
+                                           "id2": "crm__5"})
+                links = sorted(
+                    (l.id1, l.id2, l.kind.value, l.status.value,
+                     round(l.confidence, 12))
+                    for l in wl.link_database.get_all_links()
+                )
+                return log.events, links
+            finally:
+                wl.close()
+
+        base_events, base_links = run(with_explain=False)
+        explained_events, explained_links = run(with_explain=True)
+        assert explained_events == base_events
+        assert explained_links == base_links
+        assert len(base_links) > 0
+
+    def test_explain_response_consistency(self):
+        sc = parse_config(self.CONFIG)
+        wl = build_workload(
+            sc.deduplications["people"], sc, backend="device",
+            persistent=False,
+        )
+        try:
+            with wl.lock:
+                wl.process_batch("crm", self._entities()[:10])
+            out = X.explain_request(wl, {"id1": "crm__0", "id2": "crm__1"})
+            assert out["workload"] == "people"
+            contrib = sum(p["logit"] for p in out["properties"])
+            assert contrib == pytest.approx(out["pair_logit"], abs=1e-9)
+            prob = 1.0 / (1.0 + math.exp(-out["pair_logit"]))
+            assert prob == pytest.approx(out["probability"], abs=1e-12)
+            assert out["classification"] in ("match", "maybe", "reject")
+            device = out["device"]
+            per_sum = sum(p["logit"] for p in device["per_property"])
+            assert per_sum == pytest.approx(device["logit"], abs=1e-6)
+            assert device["band_verdict"] in (
+                "filtered", "pruned", "rescored")
+            assert len(out["explanation_digest"]) == 16
+            with pytest.raises(X.ExplainError):
+                X.explain_request(wl, {"id1": "nope", "id2": "crm__1"})
+        finally:
+            wl.close()
+
+
+# -- decision recorder / ring -------------------------------------------------
+
+
+class TestDecisionRecorder:
+    def _recorder(self, **kw):
+        kw.setdefault("sample_rate", 0.0)
+        kw.setdefault("enabled", True)
+        return DecisionRecorder(0.8, 0.6, **kw)
+
+    def test_disagreement_latched_at_sample_zero(self):
+        rec = self._recorder()
+        q = make_record("q", name="acme")
+        # f32 verdict says match (logit 3 -> p=0.95) but f64 rescore says
+        # reject: a disagreement, latched into the ring
+        rec.observe(q, [PairDecision("c1", 3.0, False, 0.5)])
+        # agreeing decision: not retained at sample 0
+        rec.observe(q, [PairDecision("c2", 3.0, False, 0.97)])
+        assert rec.disagreements == 1
+        records = rec.records()
+        assert len(records) == 1
+        assert records[0]["latched"] == "disagreement"
+        assert records[0]["candidate"] == "c1"
+        assert rec.outcomes["reject"] == 1
+        assert rec.outcomes["match"] == 1
+
+    def test_near_band_skip_latched(self):
+        rec = self._recorder()
+        q = make_record("q", name="acme")
+        prune, margin = 1.0, 0.01
+        # slack 0.005 <= margin: latched; slack 0.5: plain pruned
+        rec.observe(q, [
+            PairDecision("near", prune - 0.005, True, None),
+            PairDecision("far", prune - 0.5, True, None),
+        ], prune=prune, margin=margin)
+        assert rec.outcomes["pruned"] == 2
+        records = rec.records()
+        assert [r["candidate"] for r in records] == ["near"]
+        assert records[0]["latched"] == "near-band-skip"
+        assert rec.margin_slack_hist.count == 2
+
+    def test_sampling_records_breakdown(self):
+        schema = dedup_schema()
+        cand = make_record("c", name="acme corp", city="oslo")
+        rec = self._recorder(
+            sample_rate=1.0,
+            breakdown=lambda q, c: X.host_breakdown(schema, q, c),
+            resolver={"c": cand}.get,
+        )
+        q = make_record("q", name="acme corp", city="oslo")
+        rec.observe(q, [PairDecision("c", 4.0, False, 0.97)])
+        (record,) = rec.records()
+        assert record["sampled"] is True
+        assert {p["name"] for p in record["properties"]} == {
+            "name", "city", "amount"}
+        assert rec.similarity_hists["name"].count == 1
+
+    def test_disabled_recorder_is_inert(self):
+        rec = DecisionRecorder(0.8, 0.6, enabled=False)
+        rec.observe(make_record("q"), [PairDecision("c", 3.0, False, 0.5)])
+        assert rec.outcomes["reject"] == 0
+        assert len(rec.ring) == 0
+
+
+class TestLatchedRing:
+    def test_capacity_eviction_prefers_unremarkable(self):
+        ring = LatchedRing(3)
+        ring.put("a", "A", remarkable=True)
+        ring.put("b", "B")
+        ring.put("c", "C")
+        ring.put("d", "D")  # evicts b (oldest unremarkable), not a
+        assert ring.get("a") == "A"
+        assert ring.get("b") is None
+        assert [r for r in ring.records()] == ["D", "C", "A"]
+
+    def test_all_remarkable_falls_back_to_fifo(self):
+        ring = LatchedRing(2)
+        ring.put("a", "A", remarkable=True)
+        ring.put("b", "B", remarkable=True)
+        ring.put("c", "C", remarkable=True)
+        assert ring.get("a") is None
+        assert len(ring) == 2
+
+    def test_byte_budget_is_hard_bound(self):
+        ring = LatchedRing(100, byte_budget=100)
+        ring.put("a", "A", nbytes=60)
+        ring.put("b", "B", remarkable=True, nbytes=60)  # evicts a
+        assert ring.get("a") is None
+        assert ring.bytes == 60
+        # the newest record is never the victim: with only the latched
+        # record left, FIFO applies and the ring stays live — a single
+        # over-budget record survives alone
+        ring.put("c", "C", nbytes=200)
+        assert ring.get("c") == "C"
+        assert ring.get("b") is None
+        assert len(ring) == 1
+
+    def test_latched_survive_sampled_flood_under_byte_budget(self):
+        ring = LatchedRing(100, byte_budget=300)
+        ring.put("latch", "L", remarkable=True, nbytes=100)
+        for i in range(10):
+            ring.put(f"s{i}", f"S{i}", nbytes=100)
+        # byte pressure evicts the sampled records, never the latched
+        # one — and the newest sampled record is always present
+        assert ring.get("latch") == "L"
+        assert ring.get("s9") == "S9"
+        assert len(ring) == 3
+
+    def test_replace_keeps_position_and_bytes(self):
+        ring = LatchedRing(10, byte_budget=1000)
+        ring.put("a", "A1", nbytes=100)
+        ring.put("b", "B", nbytes=50)
+        ring.put("a", "A2", nbytes=10)
+        assert ring.bytes == 60
+        assert ring.records() == ["B", "A2"]  # a kept its (older) slot
+
+
+class TestEnginePathRecording:
+    def test_device_processor_records_decisions(self, monkeypatch):
+        monkeypatch.setenv("DUKE_DECISION_SAMPLE", "1.0")
+        schema = dedup_schema()
+        index = DeviceIndex(schema, tunables=MatchTunables())
+        proc = DeviceProcessor(schema, index)
+        proc.add_match_listener(OrderedLog())
+        records = random_records(16, seed=21)
+        proc.deduplicate(records)
+        rec = proc.decisions
+        total = sum(rec.outcomes.values())
+        assert total > 0
+        assert total == (proc.stats.pairs_rescored
+                         + proc.stats.pairs_skipped)
+        assert len(rec.ring) > 0
+        one = rec.records()[0]
+        assert one["query"].startswith("r")
+        assert "device_logit" in one
+
+    def test_host_processor_records_decisions(self, monkeypatch):
+        from sesam_duke_microservice_tpu.engine.processor import Processor
+        from sesam_duke_microservice_tpu.index.inverted import InvertedIndex
+
+        monkeypatch.setenv("MIN_RELEVANCE", "0.0")
+        monkeypatch.setenv("DUKE_DECISION_SAMPLE", "1.0")
+        schema = dedup_schema()
+        proc = Processor(
+            schema, InvertedIndex(schema, MatchTunables(min_relevance=0.0)))
+        proc.add_match_listener(OrderedLog())
+        proc.deduplicate(random_records(12, seed=2))
+        assert sum(proc.decisions.outcomes.values()) > 0
+        assert proc.decisions.pair_logit_hist.count > 0
+
+
+# -- retrieval provenance -----------------------------------------------------
+
+
+class TestRetrievalProvenance:
+    def test_inverted_terms(self):
+        from sesam_duke_microservice_tpu.index.inverted import InvertedIndex
+
+        schema = dedup_schema()
+        index = InvertedIndex(schema, MatchTunables(min_relevance=0.0))
+        a = make_record("a", name="acme corp", city="oslo")
+        b = make_record("b", name="acme inc", city="oslo")
+        index.index(a)
+        index.index(b)
+        index.commit()
+        out = index.explain_retrieval(a, b)
+        assert out["mode"] == "inverted-index"
+        assert out["candidate_indexed"] is True
+        tokens = {t["token"] for t in out["terms"]}
+        assert "acme" in tokens and "oslo" in tokens
+        assert out["retrieved"] is True
+        assert out["score"] > 0
+        # unindexed candidate
+        out2 = index.explain_retrieval(a, make_record("z", name="zzz"))
+        assert out2["candidate_indexed"] is False
+
+    def test_ann_rank_and_cosine(self):
+        schema = dedup_schema()
+        records = random_records(10, seed=4)
+        index = _ingested_index(AnnIndex, schema, records)
+        out = index.explain_retrieval(records[0], records[1])
+        assert out["mode"] == "ann"
+        assert -1.001 <= out["cosine"] <= 1.001
+        assert out["top_c"] == index.initial_top_c
+        assert "retrieved" in out
+        if out["retrieved"]:
+            assert isinstance(out["rank"], int)
+
+
+# -- audit log ----------------------------------------------------------------
+
+
+class TestAuditLog:
+    def test_confirmed_links_audited_with_digest(self, tmp_path,
+                                                 monkeypatch):
+        path = tmp_path / "audit.jsonl"
+        monkeypatch.setenv("DUKE_AUDIT_LOG", str(path))
+        # a 2-doc index scores below the default 0.9 relevance cut
+        monkeypatch.setenv("MIN_RELEVANCE", "0.05")
+        sc = parse_config(TestExplainParity.CONFIG)
+        wl = build_workload(
+            sc.deduplications["people"], sc, backend="host",
+            persistent=False,
+        )
+        try:
+            with wl.lock:
+                wl.process_batch("crm", [
+                    {"_id": "1", "name": "acme corp", "city": "oslo"},
+                    {"_id": "2", "name": "acme corp", "city": "oslo"},
+                ])
+            log = audit_log()
+            assert log is not None
+            log.drain()
+            rows = [json.loads(line)
+                    for line in path.read_text().splitlines()]
+            assert rows, "no audit rows written"
+            row = rows[0]
+            assert {row["id1"], row["id2"]} == {"crm__1", "crm__2"}
+            assert row["workload"] == "people"
+            assert row["link_kind"] in ("duplicate", "maybe")
+            # the explanation digest joins to a later /explain replay
+            out = X.explain_request(
+                wl, {"id1": row["id1"], "id2": row["id2"]})
+            assert out["explanation_digest"] == row["explanation_digest"]
+        finally:
+            wl.close()
+            monkeypatch.delenv("DUKE_AUDIT_LOG")
+            audit_log()  # closes the instance for the removed path
+
+
+# -- HTTP surface -------------------------------------------------------------
+
+
+@pytest.fixture()
+def server_url(monkeypatch):
+    from sesam_duke_microservice_tpu.service.app import DukeApp, serve
+
+    monkeypatch.setenv("MIN_RELEVANCE", "0.05")
+    monkeypatch.setenv("DUKE_DECISION_SAMPLE", "1.0")
+    sc = parse_config(TestExplainParity.CONFIG)
+    app = DukeApp(sc, persistent=False)
+    server = serve(app, port=0, host="127.0.0.1")
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield f"http://127.0.0.1:{server.server_address[1]}"
+    finally:
+        server.shutdown()
+        app.close()
+
+
+def _post(url, path, payload):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+def _get(url, path):
+    with urllib.request.urlopen(url + path) as resp:
+        return json.loads(resp.read())
+
+
+class TestHttpSurface:
+    def test_explain_and_decisions_endpoints(self, server_url):
+        _post(server_url, "/deduplication/people/crm", [
+            {"_id": "1", "name": "acme corp", "city": "oslo"},
+            {"_id": "2", "name": "acme corp", "city": "oslo"},
+            {"_id": "3", "name": "globex", "city": "bergen"},
+        ])
+        out = _post(server_url, "/explain",
+                    {"id1": "crm__1", "id2": "crm__2"})
+        assert out["classification"] == "match"
+        assert out["retrieval"]["mode"] == "inverted-index"
+        # raw-record variant
+        out2 = _post(server_url, "/explain", {
+            "name": "people",
+            "record1": {"dataset": "crm",
+                        "entity": {"_id": "9", "name": "acme corp",
+                                   "city": "oslo"}},
+            "id2": "crm__1",
+        })
+        assert out2["probability"] > 0.8
+        listing = _get(server_url, "/debug/decisions")
+        assert listing["decisions"], "decision ring empty"
+        row = listing["decisions"][0]
+        full = _get(server_url, f"/debug/decisions/{row['id']}")
+        assert full["outcome"] == row["outcome"]
+        assert full["workload"] == "people"
+        stats = _get(server_url, "/stats")
+        assert "feature_cache" in stats
+        wl_row = stats["workloads"][0]
+        assert wl_row["decisions"]["outcomes"]["match"] >= 2
+
+    def test_explain_error_statuses(self, server_url):
+        for payload, status in (
+            ({"id1": "nope", "id2": "also-nope"}, 404),
+            ({"name": "zzz", "id1": "a", "id2": "b"}, 404),
+            ({}, 400),
+        ):
+            req = urllib.request.Request(
+                server_url + "/explain",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req)
+            assert err.value.code == status
+        req = urllib.request.Request(
+            server_url + "/debug/decisions/d99999999")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req)
+        assert err.value.code == 404
+
+
+# -- docs drift ---------------------------------------------------------------
+
+
+def test_metrics_docs_in_sync():
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    script = (Path(__file__).resolve().parent.parent
+              / "scripts" / "check_metrics_docs.py")
+    proc = subprocess.run(
+        [sys.executable, str(script)], capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
