@@ -69,6 +69,20 @@ E2E_RUNS = int(os.environ.get("BENCH_E2E_RUNS", "3"))
 # POST /explain replay path.  BENCH_EXPLAIN=0 skips it.
 EXPLAIN_BENCH = os.environ.get("BENCH_EXPLAIN", "1") != "0"
 EXPLAIN_REPLAYS = int(os.environ.get("BENCH_EXPLAIN_REPLAYS", "50"))
+# concurrent-ingest bench (ISSUE 6): aggregate records/s and per-request
+# p50/p95 latency with 1/4/8 small-batch clients hammering one workload,
+# the continuous microbatching scheduler on vs off (DUKE_SCHEDULER=0's
+# lock-winner merge).  Link rows and event multisets must be bit-identical
+# between the modes — the scheduler changes when work runs, never what it
+# computes.  BENCH_CONC=0 skips it.
+CONC = os.environ.get("BENCH_CONC", "1") != "0"
+CONC_CORPUS = int(os.environ.get("BENCH_CONC_CORPUS", "4096"))
+CONC_BATCH = int(os.environ.get("BENCH_CONC_BATCH", "2"))
+CONC_REQUESTS = int(os.environ.get("BENCH_CONC_REQUESTS", "48"))
+CONC_CLIENTS = tuple(
+    int(c) for c in os.environ.get("BENCH_CONC_CLIENTS", "1,4,8").split(",")
+)
+
 # warm-resync ingest bench (this round's encode subsystem): re-POST an
 # already-ingested corpus — the reference's full-resync traffic shape —
 # and compare records/s cold (empty feature cache) vs warm (digest hits)
@@ -594,6 +608,210 @@ def explain_bench(schema) -> dict:
     }
 
 
+CONC_XML = """
+<DukeMicroService>
+  <Deduplication name="conc" link-database-type="in-memory">
+    <duke>
+      <schema>
+        <threshold>0.8</threshold>
+        <property><name>NAME</name>
+          <comparator>levenshtein</comparator><low>0.3</low><high>0.9</high>
+        </property>
+        <property><name>SSN</name>
+          <comparator>exact</comparator><low>0.3</low><high>0.95</high>
+        </property>
+      </schema>
+      <data-source class="io.sesam.dukemicroservice.IncrementalDeduplicationDataSource">
+        <param name="dataset-id" value="ds"/>
+        <column name="name" property="NAME"/>
+        <column name="ssn" property="SSN"/>
+      </data-source>
+    </duke>
+  </Deduplication>
+</DukeMicroService>
+"""
+
+
+def _conc_entities(client: int, round_: int) -> list:
+    """One small-batch POST body, content-deterministic by (client, round)
+    so both arms ingest identical records.  Every 4th round the first two
+    records are an exact duplicate pair (a within-request link); all
+    other names are pairwise-distant so the link set is order-independent
+    across any merge interleave."""
+    ents = []
+    dup_round = round_ % 4 == 0 and CONC_BATCH >= 2
+    for k in range(CONC_BATCH):
+        uid = f"c{client}r{round_}k{k}"
+        if dup_round and k < 2:
+            name = f"duplicated entity xq{client}zz{round_}"
+        else:
+            name = f"unique {uid} wj{client * 7919 + round_ * 104729 + k}"
+        ents.append({"_id": uid, "name": name, "ssn": uid})
+    return ents
+
+
+class _ConcEventLog:
+    """Order-insensitive event tape (multiset): under concurrency the
+    interleave is nondeterministic, but WHAT the engine decides is not."""
+
+    def __init__(self):
+        import threading
+
+        self.events = []
+        self._lock = threading.Lock()
+
+    def start_processing(self):
+        pass
+
+    def batch_ready(self, size):
+        pass
+
+    def batch_done(self):
+        pass
+
+    def end_processing(self):
+        pass
+
+    def matches(self, r1, r2, confidence):
+        with self._lock:
+            self.events.append(
+                ("match", r1.record_id, r2.record_id, repr(confidence)))
+
+    def matches_perhaps(self, r1, r2, confidence):
+        with self._lock:
+            self.events.append(
+                ("maybe", r1.record_id, r2.record_id, repr(confidence)))
+
+    def no_match_for(self, record):
+        with self._lock:
+            self.events.append(("none", record.record_id))
+
+
+def _conc_corpus(n: int) -> list:
+    """Background corpus for the concurrent arms (schema property names,
+    pairwise-distant values — the queries never match it, so link volume
+    stays request-local and order-independent)."""
+    from sesam_duke_microservice_tpu.core.records import (
+        DATASET_ID_PROPERTY_NAME,
+        ID_PROPERTY_NAME,
+        ORIGINAL_ENTITY_ID_PROPERTY_NAME,
+        Record,
+    )
+
+    rng = random.Random(7)
+    records = []
+    for i in range(n):
+        r = Record()
+        r.add_value(ID_PROPERTY_NAME, f"ds__base{i}")
+        r.add_value(ORIGINAL_ENTITY_ID_PROPERTY_NAME, f"base{i}")
+        r.add_value(DATASET_ID_PROPERTY_NAME, "ds")
+        r.add_value("NAME", f"corpus row {i} vb{rng.randint(0, 999999)}")
+        r.add_value("SSN", f"base{i}")
+        records.append(r)
+    return records
+
+
+def _conc_arm(sc, clients: int, *, scheduled: bool) -> tuple:
+    """One concurrent-ingest measurement: ``clients`` threads each POST
+    ``CONC_REQUESTS`` batches of ``CONC_BATCH`` records.  ``scheduled``
+    routes through the IngestScheduler; off is the lock-winner merge in
+    ``Workload.submit_batch`` (exactly what DUKE_SCHEDULER=0 serves)."""
+    import threading
+
+    from sesam_duke_microservice_tpu.engine.scheduler import IngestScheduler
+    from sesam_duke_microservice_tpu.engine.workload import build_workload
+
+    wl = build_workload(sc.deduplications["conc"], sc, backend="device",
+                        persistent=False)
+    log = _ConcEventLog()
+    wl.processor.add_match_listener(log)
+    sched = IngestScheduler(lambda kind, name: wl) if scheduled else None
+    try:
+        # warm the bucket shape + corpus upload outside the timed region
+        for r in _conc_corpus(CONC_CORPUS):
+            wl.index.index(r)
+        wl.index.commit()
+        wl.submit_batch("ds", _conc_entities(99, 99))
+        latencies = []
+        lat_lock = threading.Lock()
+
+        def client(c):
+            mine = []
+            for round_ in range(CONC_REQUESTS):
+                ents = _conc_entities(c, round_)
+                t0 = time.perf_counter()
+                if sched is not None:
+                    sched.submit("deduplication", "conc", "ds", ents)
+                else:
+                    wl.submit_batch("ds", ents)
+                mine.append(time.perf_counter() - t0)
+            with lat_lock:
+                latencies.extend(mine)
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        latencies.sort()
+        total = clients * CONC_REQUESTS * CONC_BATCH
+        out = {
+            "records_per_sec": round(total / dt, 1),
+            "p50_ms": round(latencies[len(latencies) // 2] * 1e3, 2),
+            "p95_ms": round(latencies[int(len(latencies) * 0.95)] * 1e3, 2),
+        }
+        if sched is not None:
+            (q,) = sched.queues()
+            out["microbatches"] = q.microbatches
+            out["avg_fill_records"] = round(
+                q.dispatched_records / max(1, q.microbatches), 2)
+        # parity material: warmup request (99) excluded from neither arm —
+        # both ingest it, so tapes stay comparable
+        links = sorted(
+            (l.id1, l.id2, l.status.value, l.kind.value, repr(l.confidence))
+            for l in wl.link_database.get_changes_since(0)
+        )
+        return out, sorted(log.events), links
+    finally:
+        if sched is not None:
+            sched.shutdown()
+        wl.close()
+
+
+def concurrent_bench() -> dict:
+    """Scheduler-on vs scheduler-off aggregate ingest under 1/4/8
+    small-batch clients (the ISSUE 6 acceptance: >=2x at 8 clients with
+    bit-identical link rows)."""
+    from sesam_duke_microservice_tpu.core.config import parse_config
+
+    sc = parse_config(CONC_XML)
+    out = {
+        "metric": "concurrent_ingest_speedup",
+        "corpus": CONC_CORPUS,
+        "batch_records": CONC_BATCH,
+        "requests_per_client": CONC_REQUESTS,
+        "clients": {},
+    }
+    for clients in CONC_CLIENTS:
+        off, off_events, off_links = _conc_arm(sc, clients, scheduled=False)
+        on, on_events, on_links = _conc_arm(sc, clients, scheduled=True)
+        out["clients"][str(clients)] = {
+            "off": off,
+            "on": on,
+            "speedup": round(
+                on["records_per_sec"] / off["records_per_sec"], 2),
+            "links_bit_identical": on_links == off_links,
+            "events_bit_identical": on_events == off_events,
+        }
+    top = str(max(CONC_CLIENTS))
+    out["value"] = out["clients"][top]["speedup"]
+    out["vs_unscheduled_at_max_clients"] = out["clients"][top]["speedup"]
+    return out
+
+
 def main():
     schema = bench_schema()
     corpus = stresstest_records(CORPUS, seed=1234)
@@ -620,6 +838,8 @@ def main():
         result["resync"] = warm_resync(schema)
     if EXPLAIN_BENCH and BACKEND == "device":
         result["explain"] = explain_bench(schema)
+    if CONC and BACKEND == "device":
+        result["concurrent"] = concurrent_bench()
     print(json.dumps(result))
     print(
         f"# cpu_baseline={cpu_rate:.0f} pairs/s, device median-of-{len(rates)}"

@@ -53,6 +53,8 @@ from ..index.base import CandidateIndex
 from ..ops import features as F
 from ..ops.features import CHARS as _F_CHARS, CHARS_WEIGHTED as _F_CHARS_W
 from ..telemetry import tracing
+from ..telemetry.env import env_int_tuple
+from .scheduler import DEFAULT_QUERY_BUCKETS
 from ..utils.jit_cache import record_cache_hit, record_compile
 from .listeners import MatchListener
 from .processor import (
@@ -77,10 +79,8 @@ logger = logging.getLogger("device-matcher")
 # blocks — an 8192-query batch runs 86.7M pairs/s end-to-end at bucket
 # 4096 vs 67.8M at 1024 (per-block dispatch/fetch overhead halves twice);
 # intermediate 2048 keeps mid-size batches from over-padding.
-_QUERY_BUCKETS = tuple(
-    int(b) for b in os.environ.get(
-        "DEVICE_QUERY_BUCKETS", "16,128,1024,2048,4096"
-    ).split(",")
+_QUERY_BUCKETS = env_int_tuple(
+    "DEVICE_QUERY_BUCKETS", DEFAULT_QUERY_BUCKETS
 )
 _CHUNK = int(os.environ.get("DEVICE_CHUNK", "8192"))
 # Incremental device-update slices bucket independently of the scan chunk:
@@ -117,7 +117,15 @@ _CHARS_CAP = int(os.environ.get("DEVICE_MAX_CHARS_CAP", "1024"))
 _DEMOTE_CHARS = int(os.environ.get("DEVICE_DEMOTE_CHARS", "256"))
 
 
-def _bucket_for(n: int) -> int:
+def query_buckets() -> tuple:
+    """The query-padding ladder (public: the ingest scheduler coalesces
+    cross-request microbatches toward these boundaries so device launches
+    ride already-compiled shapes with minimal padding)."""
+    return _QUERY_BUCKETS
+
+
+def bucket_for(n: int) -> int:
+    """Padded query-block size for an ``n``-record batch."""
     for b in _QUERY_BUCKETS:
         if n <= b:
             return b
@@ -1728,7 +1736,7 @@ class _ScorerCache:
         import jax.numpy as jnp
 
         index = self.index
-        bucket = _bucket_for(len(records))
+        bucket = bucket_for(len(records))
         # padding-bucket visibility: which static shapes blocks land on
         # and how many padded rows they carry (unlocked counters — this
         # is the scoring path; see telemetry.QUERY_BLOCKS)

@@ -26,6 +26,7 @@ from __future__ import annotations
 import contextlib
 import logging
 import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
 from ..core.config import ServiceConfig, WorkloadConfig
@@ -85,6 +86,12 @@ class Workload:
         # held while acquiring self.lock)
         self._mb_mutex = threading.Lock()
         self._mb_queue: List[_BatchRequest] = []
+        # recent write-side lock-hold EWMA (seconds): busy-503s derive
+        # their Retry-After from it, so a reader told to come back gets a
+        # hint shaped by how long writers actually hold this workload.
+        # Written under self.lock (every observed hold IS a lock hold),
+        # read lock-free by the HTTP layer.
+        self._hold_ewma: Optional[float] = None
         # Sticky store/index divergence latch: set when a record_store
         # write committed but its index application (tombstone indexing /
         # link retraction / scoring pass) then failed.  While set, the
@@ -98,6 +105,27 @@ class Workload:
             ds.dataset_id: IncrementalDataSource(ds)
             for ds in config.duke.data_sources
         }
+
+    # -- lock-hold observations ---------------------------------------------
+
+    def note_lock_hold(self, seconds: float) -> None:
+        """Fold one write-side lock-hold duration into the EWMA (call with
+        ``self.lock`` held — batch paths and the scheduler dispatcher)."""
+        from .scheduler import fold_ewma
+
+        self._hold_ewma = fold_ewma(self._hold_ewma, seconds)
+
+    def busy_retry_after(self) -> int:
+        """Whole-second Retry-After hint for lock-timeout busy replies:
+        the recent write hold, ceil'd and clamped (ONE policy copy —
+        engine.scheduler.retry_after_seconds — for every Retry-After
+        source)."""
+        from .scheduler import retry_after_seconds
+
+        ewma = self._hold_ewma
+        if ewma is None:
+            return 1
+        return retry_after_seconds(ewma)
 
     # -- ingest + match -----------------------------------------------------
 
@@ -141,7 +169,11 @@ class Workload:
                             return None
                     work, self._mb_queue = self._mb_queue, []
                 if work:
-                    self._run_merged(work)
+                    t0 = time.monotonic()
+                    try:
+                        self._run_merged(work)
+                    finally:
+                        self.note_lock_hold(time.monotonic() - t0)
         if not req.event.is_set():  # withdrawn post-close without a leader
             return None
         if req.error is not None:
@@ -287,6 +319,7 @@ class Workload:
                       http_transform: bool = False) -> List[dict]:
         """Ingest a batch and run matching; returns the transform response
         rows (input entities + duke_links) when ``http_transform``."""
+        t_hold = time.monotonic()
         datasource = self.datasources[dataset_id]
         records = datasource.records_for_batch(entities)
         live = [r for r in records if not r.is_deleted()]
@@ -330,6 +363,7 @@ class Workload:
                 self._store_dirty = True
             raise
         finally:
+            self.note_lock_hold(time.monotonic() - t_hold)
             self.index.set_indexing_disabled(False)
             self.listener.set_link_database_updates_disabled(False)
 

@@ -41,6 +41,14 @@ from urllib.parse import parse_qs, urlparse
 
 from .. import telemetry
 from ..core.config import ConfigError, ServiceConfig, load_default_config, parse_config
+from ..engine.scheduler import (
+    DatasetGone,
+    IngestScheduler,
+    SchedulerClosed,
+    SchedulerReject,
+    WorkloadGone,
+    scheduler_enabled,
+)
 from ..engine.workload import Workload, build_workload
 from ..telemetry import tracing
 from ..telemetry.logctx import new_request_id, request_id_var
@@ -124,7 +132,30 @@ class DukeApp:
         self.http_metrics = HttpMetrics(self.metrics)
         self.metrics.register_collector(make_app_collector(self))
         self.metrics.register_collector(make_process_collector())
+        # feed-stream abort visibility (ISSUE 6 satellite): the mid-stream
+        # bail-outs (bounded lock-starvation retries exhausted; workload
+        # removed by reload) truncate the chunked framing, which a scrape
+        # can't see — plain counters surfaced by the app collector and
+        # /stats.  Handler threads increment under the lock (rare events).
+        self.feed_aborts = {"lock_starved": 0, "workload_removed": 0}
+        self._feed_abort_lock = threading.Lock()
         self.apply_config(config)
+        # continuous cross-request microbatching (ISSUE 6): queues are
+        # keyed by (kind, name) and dispatch re-resolves from the live
+        # registries, so a hot reload retargets queued requests at the
+        # replacement workload.  DUKE_SCHEDULER=0 restores the
+        # lock-winner merge inside Workload.submit_batch.
+        self.scheduler = (IngestScheduler(self._resolve_workload)
+                          if scheduler_enabled() else None)
+
+    def _resolve_workload(self, kind: str, name: str) -> Optional[Workload]:
+        registry = (self.deduplications if kind == "deduplication"
+                    else self.record_linkages)
+        return registry.get(name)
+
+    def count_feed_abort(self, reason: str) -> None:
+        with self._feed_abort_lock:
+            self.feed_aborts[reason] = self.feed_aborts.get(reason, 0) + 1
 
     def readiness(self) -> Tuple[bool, Dict[str, bool]]:
         """GET /readyz substance: config parsed, every configured workload
@@ -226,6 +257,12 @@ class DukeApp:
         saves device-corpus snapshots).  Called by the CLI's signal
         handlers — the reference has no shutdown hook at all (state safety
         there rests on Lucene/H2 syncing every commit)."""
+        # drain the ingest scheduler FIRST: queued requests complete
+        # against still-open workloads (no lost requests), and the
+        # dispatcher must be able to take the workload locks this method
+        # is about to hold
+        if getattr(self, "scheduler", None) is not None:
+            self.scheduler.shutdown()
         with self._swap_lock:
             workloads = (list(self.deduplications.values())
                          + list(self.record_linkages.values()))
@@ -240,19 +277,28 @@ class DukeApp:
 
 
 class _HttpError(Exception):
-    def __init__(self, status: int, message: str, content_type: str = "text/plain"):
+    def __init__(self, status: int, message: str, content_type: str = "text/plain",
+                 extra_headers: Optional[dict] = None):
         self.status = status
         self.message = message
         self.content_type = content_type
+        self.extra_headers = dict(extra_headers or {})
 
 
 class _BusyError(_HttpError):
     """503 from a workload-lock read timeout (the reference's busy reply,
     App.java:718-725) — its own type so the busy counter counts exactly
-    lock-pressure 503s, never e.g. an unready /readyz."""
+    lock-pressure 503s, never e.g. an unready /readyz.
 
-    def __init__(self, kind_label: str):
-        super().__init__(503, _BUSY_TEMPLATE.format(kind=kind_label))
+    ``retry_after`` (seconds, from the workload's recent write-hold EWMA)
+    rides a ``Retry-After`` header; the reference reply body is
+    unchanged."""
+
+    def __init__(self, kind_label: str, retry_after: Optional[int] = None):
+        headers = ({"Retry-After": str(retry_after)}
+                   if retry_after is not None else None)
+        super().__init__(503, _BUSY_TEMPLATE.format(kind=kind_label),
+                         extra_headers=headers)
 
 
 _ENTITY_PATH = re.compile(
@@ -268,6 +314,12 @@ _STATIC_ROUTES = frozenset((
     "/debug/traces", "/debug/requests", "/debug/decisions", "/explain",
     "/debug/profile", "/debug/profile/reset",
 ))
+
+
+def _kind_label(kind: str) -> str:
+    """User-facing workload-kind label in error bodies (the reference
+    camel-cases recordLinkage — App.java:718)."""
+    return "deduplication" if kind == "deduplication" else "recordLinkage"
 
 
 def _route_template(path: str) -> str:
@@ -340,7 +392,8 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                     route_fn(parsed)
                 except _HttpError as e:
                     busy = isinstance(e, _BusyError)
-                    self._reply_text(e.status, e.message)
+                    self._reply(e.status, e.message.encode("utf-8"),
+                                e.content_type, e.extra_headers or None)
                 except Exception:
                     logger.exception("Error serving %s %s", method, self.path)
                     self._reply_text(500, "Internal server error")
@@ -555,6 +608,14 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
         # operator treating the JSONL as evidence needs to SEE the loss
         from ..telemetry.decisions import audit_log
 
+        # ingest-scheduler health (ISSUE 6): queue depths, admission
+        # split, microbatch fill and the live Retry-After hint per tenant
+        if self.app.scheduler is not None:
+            out["scheduler"] = self.app.scheduler.stats_snapshot()
+        # feed-stream abort visibility (satellite): mid-stream bail-outs
+        # truncate chunked framing, invisible to any scrape until now
+        with self.app._feed_abort_lock:
+            out["feed_aborts"] = dict(self.app.feed_aborts)
         audit = audit_log()
         if audit is not None:
             out["audit_log"] = {
@@ -631,7 +692,7 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
 
     def _validate_entity_path(self, m) -> Tuple[str, Workload, str, bool]:
         kind, name, dataset_id, transform = m.group(1), m.group(2), m.group(3), bool(m.group(4))
-        label = "deduplication" if kind == "deduplication" else "recordLinkage"
+        label = _kind_label(kind)
         if not name:
             raise _HttpError(404, f"The {label}Name cannot be an empty string!")
         if not dataset_id:
@@ -664,21 +725,65 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
             if not isinstance(entity, dict):
                 raise _HttpError(400, "Batch elements must be JSON objects")
 
-        while True:
-            # re-resolve until a live workload accepts the batch: a config
-            # reload can replace the registry entry between lookup and lock
-            # (submit_batch returns None for a replaced workload); ingest
-            # requests merge into per-workload device microbatches inside
-            # submit_batch
-            kind, workload, dataset_id, transform = self._validate_entity_path(m)
+        kind, workload, dataset_id, transform = self._validate_entity_path(m)
+        sched = self.app.scheduler
+        if sched is not None and not transform:
+            # continuous microbatching (ISSUE 6): the scheduler coalesces
+            # concurrent POSTs into device-shaped microbatches, applies
+            # queue-depth admission control, and dispatches fairly across
+            # workloads.  Transforms stay on the direct lock path — their
+            # response rows are per-request state on the shared listener.
+            name, label = m.group(2), _kind_label(kind)
             try:
-                rows = workload.submit_batch(dataset_id, batch,
-                                             http_transform=transform)
+                sched.submit(kind, name, dataset_id, batch)
+            except SchedulerReject as e:
+                raise _HttpError(
+                    429,
+                    f"The {label} '{name}' ingest queue is full "
+                    f"({e.depth} requests pending). Please retry after "
+                    f"{e.retry_after}s.",
+                    extra_headers={"Retry-After": str(e.retry_after)},
+                )
+            except WorkloadGone:
+                raise _HttpError(
+                    404,
+                    f"Unknown {label} '{name}'! (All {label}s must be "
+                    f"specified in the configuration)",
+                )
+            except DatasetGone as e:
+                # a reload replaced the workload with one lacking the
+                # dataset after admission validated it — same 404 the
+                # up-front validation answers
+                raise _HttpError(
+                    404,
+                    f"Unknown dataset-id '{e.dataset_id}' for the "
+                    f"{label} '{name}'!",
+                )
+            except SchedulerClosed:
+                raise _HttpError(503, "The service is shutting down.")
+            except _HttpError:
+                raise
             except Exception as e:
                 logger.exception("Batch processing failed")
                 raise _HttpError(500, f"Batch processing failed: {e}")
-            if rows is not None:
-                break
+            rows = []
+        else:
+            while True:
+                # re-resolve until a live workload accepts the batch: a
+                # config reload can replace the registry entry between
+                # lookup and lock (submit_batch returns None for a replaced
+                # workload); ingest requests merge into per-workload device
+                # microbatches inside submit_batch
+                kind, workload, dataset_id, transform = \
+                    self._validate_entity_path(m)
+                try:
+                    rows = workload.submit_batch(dataset_id, batch,
+                                                 http_transform=transform)
+                except Exception as e:
+                    logger.exception("Batch processing failed")
+                    raise _HttpError(500, f"Batch processing failed: {e}")
+                if rows is not None:
+                    break
 
         if transform:
             out = rows[0] if single and len(rows) == 1 else rows
@@ -699,7 +804,7 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
         (same bytes as the reference's single array).
         """
         kind, name = m.group(1), m.group(2)
-        label = "deduplication" if kind == "deduplication" else "recordLinkage"
+        label = _kind_label(kind)
         if not name:
             raise _HttpError(400, f"The {label}Name cannot be an empty string!")
         since = 0
@@ -734,6 +839,7 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                             "Aborting %s feed stream: workload removed "
                             "by config reload mid-stream", name,
                         )
+                        self.app.count_feed_abort("workload_removed")
                         self.close_connection = True
                         return
                     raise _HttpError(
@@ -743,7 +849,7 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                     )
                 if not workload.lock.acquire(timeout=READ_LOCK_TIMEOUT_SECONDS):
                     if not started:
-                        raise _BusyError(label)
+                        raise _BusyError(label, workload.busy_retry_after())
                     # mid-stream contention: retry (no in-band error exists
                     # once streaming), but bounded — a wedged writer must
                     # not pin this handler thread forever.  Truncating the
@@ -754,6 +860,7 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                             "Aborting %s feed stream: workload lock "
                             "unavailable for >120 s mid-stream", name,
                         )
+                        self.app.count_feed_abort("lock_starved")
                         self.close_connection = True
                         return
                     continue
@@ -819,7 +926,7 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                     f"specified in the configuration)",
                 )
             if not workload.lock.acquire(timeout=READ_LOCK_TIMEOUT_SECONDS):
-                raise _BusyError(label)
+                raise _BusyError(label, workload.busy_retry_after())
             try:
                 if workload.closed:
                     continue
@@ -843,7 +950,7 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                 _ENTITY_PATH.match(f"/{kind}/{name}/rematch"), body
             )
             return
-        label = "deduplication" if kind == "deduplication" else "recordLinkage"
+        label = _kind_label(kind)
         if workload is None:
             raise _HttpError(
                 404,

@@ -282,6 +282,32 @@ def make_app_collector(app):
                 warm_samples.append(
                     ("", labels, getattr(cache, "_warm_compiled", 0)))
 
+        # ingest-scheduler families (ISSUE 6): scrape-time snapshots of
+        # the scheduler's single-writer tenant-queue counters — the
+        # dispatch path never writes a registry child, and queues for
+        # reloaded-away workloads age out with their traffic
+        sched_depth = []
+        sched_records = []
+        sched_admission = []
+        sched_batches = []
+        sched_merged = []
+        sched_wait = []
+        sched_fill = []
+        scheduler = getattr(app, "scheduler", None)
+        if scheduler is not None:
+            for q in scheduler.queues():
+                labels = (("kind", q.kind), ("workload", q.name))
+                sched_depth.append(("", labels, len(q.pending)))
+                sched_records.append(("", labels, q.queued_records()))
+                sched_admission.append(
+                    ("", labels + (("outcome", "admitted"),), q.admitted))
+                sched_admission.append(
+                    ("", labels + (("outcome", "rejected"),), q.rejected))
+                sched_batches.append(("", labels, q.microbatches))
+                sched_merged.append(("", labels, q.merged_requests))
+                sched_wait.extend(q.wait_hist.samples(labels))
+                sched_fill.extend(q.fill_hist.samples(labels))
+
         out = [
             FamilySnapshot("duke_uptime_seconds", "gauge",
                            "Seconds since this DukeApp was constructed",
@@ -312,6 +338,45 @@ def make_app_collector(app):
                            "Rows in the workload's link store",
                            link_samples),
         ]
+        if scheduler is not None:
+            out.append(FamilySnapshot(
+                "duke_sched_queue_depth", "gauge",
+                "Requests pending in the ingest-scheduler queue",
+                sched_depth))
+            out.append(FamilySnapshot(
+                "duke_sched_queue_records", "gauge",
+                "Records pending in the ingest-scheduler queue",
+                sched_records))
+            out.append(FamilySnapshot(
+                "duke_sched_admission_total", "counter",
+                "Ingest requests admitted to vs rejected (429) by the "
+                "scheduler's DUKE_SCHED_QUEUE_MAX bound", sched_admission))
+            out.append(FamilySnapshot(
+                "duke_sched_microbatches_total", "counter",
+                "Coalesced microbatches dispatched to the engine",
+                sched_batches))
+            out.append(FamilySnapshot(
+                "duke_sched_merged_requests_total", "counter",
+                "Ingest requests completed through dispatched microbatches",
+                sched_merged))
+            out.append(FamilySnapshot(
+                "duke_sched_wait_seconds", "histogram",
+                "Queue wait from request enqueue to microbatch dispatch",
+                sched_wait))
+            out.append(FamilySnapshot(
+                "duke_sched_microbatch_records", "histogram",
+                "Records per dispatched microbatch (coalesced fill toward "
+                "the query-padding buckets)", sched_fill))
+        with app._feed_abort_lock:
+            abort_counts = dict(app.feed_aborts)
+        out.append(FamilySnapshot(
+            "duke_feed_aborts_total", "counter",
+            "Feed streams aborted mid-response (chunked framing truncated) "
+            "by reason: workload-lock starvation past the bounded retries, "
+            "or workload removal by config reload",
+            [("", (("reason", reason),), float(count))
+             for reason, count in sorted(abort_counts.items())],
+        ))
         if capacity_samples:
             out.append(FamilySnapshot(
                 "duke_corpus_capacity_rows", "gauge",

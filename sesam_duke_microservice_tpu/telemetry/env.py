@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["env_int", "env_float"]
+__all__ = ["env_int", "env_float", "env_int_tuple"]
 
 
 def env_int(name: str, default: int) -> int:
@@ -18,6 +18,17 @@ def env_int(name: str, default: int) -> int:
         return int(os.environ.get(name, default))
     except ValueError:
         return default
+
+
+def env_int_tuple(name: str, default: str) -> tuple:
+    """Comma-separated int list knob (e.g. DEVICE_QUERY_BUCKETS).  ONE
+    copy of the parse + default so every consumer (the device matcher's
+    ladder, the ingest scheduler's jax-less fallback) stays in sync."""
+    raw = os.environ.get(name) or default
+    try:
+        return tuple(int(b) for b in raw.split(","))
+    except ValueError:
+        return tuple(int(b) for b in default.split(","))
 
 
 def env_float(name: str, default: float) -> float:
