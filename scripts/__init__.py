@@ -1,0 +1,3 @@
+# scripts/ is a namespace for repo tooling; the __init__ makes
+# `python -m scripts.dukecheck` work from a checkout without installing
+# anything (the dukecheck suite is stdlib-only by design).

@@ -1,0 +1,128 @@
+"""dukecheck — project-native static analysis for concurrency + telemetry
+invariants (ISSUE 7 tentpole).
+
+Five checkers over ``sesam_duke_microservice_tpu/`` (stdlib ``ast`` only,
+no installs — runs in the CI lint job like scripts/check_metrics_docs.py):
+
+  DK101  lock-order cycle in the inter-lock acquisition graph
+  DK190  stale generated docs/LOCK_HIERARCHY.md
+  DK201  write to a ``# guarded by:``-annotated field outside its lock
+  DK202  read of a fully-guarded field outside its lock
+  DK203  conflicting ``# guarded by:`` annotations for one field name
+  DK301  raw os.environ access outside telemetry/env.py
+  DK401  impure call (time/random/environ/global-mutation) in
+         jit-reachable code
+  DK402  cache keyed on bare ``id(...)``
+  DK501  ``.labels(...)`` child lookup on an engine hot path
+  DK502  direct registry write on an engine hot path
+
+Usage:
+
+    python -m scripts.dukecheck                # check (CI gate)
+    python -m scripts.dukecheck --write-docs   # regenerate LOCK_HIERARCHY
+    python -m scripts.dukecheck --list         # print every finding,
+                                               # baselined or not
+
+Exit 0 iff every finding is inline-suppressed or baselined AND no
+baseline entry is stale (the baseline only shrinks).
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+from typing import List
+
+from . import envknob, guardedby, jitpurity, lockorder, metricwrite
+from .core import (
+    Finding,
+    apply_baseline,
+    filter_suppressed,
+    load_baseline,
+    load_modules,
+)
+
+BASELINE_RELPATH = "scripts/dukecheck/baseline.txt"
+
+CHECKERS = (
+    ("lock-order", lockorder.check),
+    ("guarded-by", guardedby.check),
+    ("env-knob", envknob.check),
+    ("jit-purity", jitpurity.check),
+    ("metrics", metricwrite.check),
+)
+
+
+def collect_findings(root: Path, modules=None) -> List[Finding]:
+    if modules is None:
+        modules = load_modules(root)
+    by_rel = {m.rel: m for m in modules}
+    findings: List[Finding] = []
+    for _, fn in CHECKERS:
+        findings.extend(fn(modules, root))
+    findings = filter_suppressed(by_rel, findings)
+    findings.sort(key=lambda f: (f.rel, f.line, f.code))
+    return findings
+
+
+def run(root: Path, *, write_docs: bool = False,
+        list_all: bool = False) -> int:
+    modules = load_modules(root)
+    if write_docs:
+        graph = lockorder.build_graph(modules)
+        doc = root / lockorder.DOC_RELPATH
+        doc.parent.mkdir(parents=True, exist_ok=True)
+        doc.write_text(lockorder.render_doc(graph), encoding="utf-8")
+        print(f"wrote {lockorder.DOC_RELPATH} "
+              f"({len(graph.locks)} locks, {len(graph.edges)} edges)")
+        return 0
+    findings = collect_findings(root, modules)
+    baseline = load_baseline(root / BASELINE_RELPATH)
+    new, stale = apply_baseline(findings, baseline)
+    if list_all:
+        for f in findings:
+            mark = " [baselined]" if f.key in baseline else ""
+            print(f.render() + mark)
+        print(f"{len(findings)} findings "
+              f"({len(findings) - len(new)} baselined)")
+    ok = True
+    if new:
+        ok = False
+        print(f"dukecheck: {len(new)} new finding(s) "
+              "(fix, suppress inline with a justification, or — last "
+              "resort — baseline):")
+        for f in new:
+            print("  " + f.render())
+    if stale:
+        ok = False
+        print(f"dukecheck: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} — the violation is "
+              "gone; delete the line(s) (the baseline only shrinks):")
+        for key in stale:
+            print("  " + key)
+    if ok and not list_all:
+        print(f"dukecheck: clean ({len(findings)} finding(s), all "
+              f"baselined; {len(baseline)} baseline entr"
+              f"{'y' if len(baseline) == 1 else 'ies'})")
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m scripts.dukecheck",
+        description="project-native static analysis "
+                    "(lock order, guarded-by, env knobs, jit purity, "
+                    "metrics discipline)",
+    )
+    parser.add_argument("--write-docs", action="store_true",
+                        help="regenerate docs/LOCK_HIERARCHY.md and exit")
+    parser.add_argument("--list", action="store_true", dest="list_all",
+                        help="print every finding including baselined")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: two levels above this "
+                             "package)")
+    args = parser.parse_args(argv)
+    root = Path(args.root) if args.root else (
+        Path(__file__).resolve().parent.parent.parent
+    )
+    return run(root, write_docs=args.write_docs, list_all=args.list_all)

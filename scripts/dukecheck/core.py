@@ -1,0 +1,163 @@
+"""dukecheck framework: modules, findings, suppressions, baseline.
+
+Every checker consumes parsed ``Module`` objects and yields ``Finding``s
+spelled ``file:line: CODE message``.  Two escape hatches keep the committed
+baseline near zero:
+
+  * **inline suppression** — a trailing ``# dukecheck: ignore[DK301] why``
+    comment on the finding's line silences exactly those codes there (the
+    justification text is required by convention, not parsed);
+  * **baseline** — ``scripts/dukecheck/baseline.txt`` lists findings that
+    are known, justified, and grandfathered.  Baseline keys deliberately
+    carry NO line numbers (they must survive unrelated edits): the key is
+    ``CODE path :: detail`` where ``detail`` is the checker's stable
+    identifier for the site (lock pair, attribute, env-var name, ...).
+    New findings fail; baseline entries that no longer match fail too —
+    the baseline only shrinks.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+# package under analysis, relative to the repo root
+PACKAGE = "sesam_duke_microservice_tpu"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dukecheck:\s*ignore(?:\[([A-Za-z0-9_,\s]+)\])?"
+)
+_HOLDS_RE = re.compile(r"#\s*dukecheck:\s*holds\s+([^#]+?)\s*$")
+
+
+class Finding:
+    """One ``file:line: CODE message`` result with a line-stable key."""
+
+    __slots__ = ("code", "rel", "line", "message", "detail")
+
+    def __init__(self, code: str, rel: str, line: int, message: str,
+                 detail: str):
+        self.code = code
+        self.rel = rel
+        self.line = line
+        self.message = message
+        # stable identifier for baseline matching (never a line number)
+        self.detail = detail
+
+    @property
+    def key(self) -> str:
+        return f"{self.code} {self.rel} :: {self.detail}"
+
+    def render(self) -> str:
+        return f"{self.rel}:{self.line}: {self.code} {self.message}"
+
+
+class Module:
+    """One parsed source file plus its comment-derived metadata."""
+
+    def __init__(self, path: Path, rel: str):
+        self.path = path
+        self.rel = rel
+        self.source = path.read_text(encoding="utf-8")
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=str(path))
+        # line -> set of suppressed codes ({"*"} suppresses everything)
+        self.suppressions: Dict[int, Set[str]] = {}
+        # line -> lock expression the surrounding def asserts is held
+        self.holds: Dict[int, str] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                codes = m.group(1)
+                self.suppressions[i] = (
+                    {c.strip() for c in codes.split(",") if c.strip()}
+                    if codes else {"*"}
+                )
+            m = _HOLDS_RE.search(text)
+            if m:
+                self.holds[i] = m.group(1).strip()
+
+    def suppressed(self, line: int, code: str) -> bool:
+        codes = self.suppressions.get(line)
+        if not codes:
+            return False
+        return "*" in codes or code in codes or code[:3] in codes
+
+
+def load_modules(root: Path, subdir: str = PACKAGE) -> List[Module]:
+    base = root / subdir
+    mods = []
+    for path in sorted(base.rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        mods.append(Module(path, rel))
+    return mods
+
+
+def filter_suppressed(mods_by_rel: Dict[str, Module],
+                      findings: Iterable[Finding]) -> List[Finding]:
+    out = []
+    for f in findings:
+        mod = mods_by_rel.get(f.rel)
+        if mod is not None and mod.suppressed(f.line, f.code):
+            continue
+        out.append(f)
+    return out
+
+
+# -- baseline -----------------------------------------------------------------
+
+
+def load_baseline(path: Path) -> Dict[str, str]:
+    """``{key: justification}`` from baseline.txt (``key  # justification``
+    lines; blank lines and full-line comments skipped)."""
+    out: Dict[str, str] = {}
+    if not path.exists():
+        return out
+    for raw in path.read_text(encoding="utf-8").splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, why = line.partition("  #")
+        out[key.strip()] = why.strip()
+    return out
+
+
+def apply_baseline(findings: Sequence[Finding], baseline: Dict[str, str]):
+    """Split findings into (new, baselined) and report stale entries.
+
+    Returns ``(new_findings, stale_keys)`` — both must be empty for a
+    passing run: stale entries mean the violation was fixed, so the
+    baseline must shrink to match (delete the line), keeping it honest.
+    """
+    keys = {f.key for f in findings}
+    new = [f for f in findings if f.key not in baseline]
+    stale = [k for k in baseline if k not in keys]
+    return new, stale
+
+
+# -- small AST helpers shared by the checkers ---------------------------------
+
+
+def expr_text(node: ast.AST) -> str:
+    """Canonical source text for guard/lock expressions (``self._cv``)."""
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse covers all our exprs
+        return ""
+
+
+def receiver_name(node: ast.expr) -> Optional[str]:
+    """The variable/attribute name an attribute hangs off: for
+    ``wl.lock`` -> ``wl``; for ``self.link_database.commit`` ->
+    ``link_database``; for bare ``self.x`` -> ``self``."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            return node.attr if node.attr else None
+        return receiver_name(node.value) or node.attr
+    if isinstance(node, ast.Call):
+        return receiver_name(node.func)
+    return None

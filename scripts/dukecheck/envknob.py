@@ -1,0 +1,82 @@
+"""Checker 3 — env-knob discipline (DK301).
+
+Every environment knob must be read through ``telemetry/env.py``
+(``env_int``/``env_float``/``env_str``/``env_flag``/...), which carry the
+codebase-wide convention: malformed values fall back to the default
+instead of killing the service at import time.  A raw ``os.environ`` /
+``os.getenv`` touch anywhere else in the package is a finding — the
+handful of justified raw uses (subprocess env composition, the config
+parser's injectable ``env=`` seam) carry inline
+``# dukecheck: ignore[DK301]`` suppressions with their reasons.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence
+
+from .core import Finding, Module
+
+ALLOWED = ("sesam_duke_microservice_tpu/telemetry/env.py",)
+
+
+def _env_var_hint(node: ast.AST, parents_parent: ast.AST = None) -> str:
+    """Best-effort knob name for the baseline key (first string literal
+    argument of the enclosing call/subscript, else 'environ')."""
+    target = parents_parent
+    if isinstance(target, ast.Call):
+        for arg in target.args:
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                return arg.value
+    if isinstance(target, ast.Subscript):
+        sl = target.slice
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+            return sl.value
+    return "environ"
+
+
+def check(modules: Sequence[Module], root=None) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in modules:
+        if mod.rel in ALLOWED:
+            continue
+        parents = {}
+        for parent in ast.walk(mod.tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+        for node in ast.walk(mod.tree):
+            hit = None
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in ("os", "_os")
+                    and node.attr in ("environ", "getenv")):
+                hit = node
+            elif (isinstance(node, ast.Name)
+                  and node.id in ("environ", "getenv")
+                  and isinstance(node.ctx, ast.Load)
+                  and not isinstance(parents.get(node), ast.Attribute)):
+                # `from os import environ` style (none today; keep the
+                # checker closed under the obvious dodge)
+                imported = any(
+                    isinstance(n, ast.ImportFrom) and n.module == "os"
+                    and any(a.name in ("environ", "getenv")
+                            for a in n.names)
+                    for n in mod.tree.body
+                )
+                if imported:
+                    hit = node
+            if hit is None:
+                continue
+            # climb to the expression that names the knob
+            up = parents.get(hit)
+            while isinstance(up, ast.Attribute):
+                up = parents.get(up)
+            var = _env_var_hint(hit, up)
+            findings.append(Finding(
+                "DK301", mod.rel, hit.lineno,
+                f"raw environment access ({var!r}) — use the "
+                "telemetry.env helpers (env_int/env_float/env_str/"
+                "env_flag) instead",
+                f"env:{var}",
+            ))
+    return findings

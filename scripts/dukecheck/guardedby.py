@@ -1,0 +1,231 @@
+"""Checker 2 — ``# guarded by:`` field annotations (DK201/DK202/DK203).
+
+The convention (see README "Static analysis & concurrency invariants"):
+
+    self._queue = deque()   # guarded by: self._cv
+    self.queued = 0         # guarded by: self._cv [writes]
+    self.hits = 0           # single-writer: dispatcher thread
+
+* ``guarded by: <lock-expr>`` — every access to the attribute in this
+  MODULE must sit lexically inside ``with <lock-expr>:`` (the expression
+  is matched textually against the enclosing with-items), or inside a
+  function annotated ``# dukecheck: holds <lock-expr>`` (the documented
+  caller contract), or in ``__init__``/the defining method (construction
+  happens-before publication).
+* ``[writes]`` — only writes are checked: stores, augmented assigns,
+  deletes, subscript stores through the attribute, and calls to known
+  mutating methods (``append``/``popleft``/``clear``/...).  Lock-free
+  reads are the codebase's documented scrape-path stance.
+* ``single-writer: <who>`` — documentation only (no static check); the
+  attribute is written by exactly one thread and read lock-free.
+
+Scope is deliberately per-module: the annotated hot classes are accessed
+through their owning module's code paths, and module-locality is what
+keeps a textual with-match sound (one ``self._cv`` name space).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, Module, expr_text
+
+_GUARD_RE = re.compile(
+    r"#\s*guarded by:\s*([A-Za-z_][A-Za-z0-9_.]*)\s*(\[writes\])?"
+)
+
+# method names that mutate their receiver (a `q.pending.append(x)` is a
+# WRITE to `pending` even though the attribute load context is Load)
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "pop",
+    "popleft", "popitem", "remove", "discard", "clear", "add", "update",
+    "setdefault", "move_to_end", "sort", "reverse",
+}
+
+
+class _GuardSpec:
+    __slots__ = ("attr", "lock", "writes_only", "line", "owner",
+                 "def_func")
+
+    def __init__(self, attr: str, lock: str, writes_only: bool, line: int,
+                 owner: Optional[str], def_func: str):
+        self.attr = attr
+        self.lock = lock
+        self.writes_only = writes_only
+        self.line = line
+        self.owner = owner  # class name, or None for module globals
+        self.def_func = def_func  # function holding the defining assign
+
+
+def _collect_specs(mod: Module) -> List[_GuardSpec]:
+    specs: List[_GuardSpec] = []
+
+    def scan_assign(node, owner: Optional[str],
+                    def_func: str = "") -> None:
+        # the annotation may sit on any line the (possibly wrapped)
+        # assignment spans
+        last = getattr(node, "end_lineno", node.lineno) or node.lineno
+        m = None
+        for lineno in range(node.lineno, min(last, len(mod.lines)) + 1):
+            m = _GUARD_RE.search(mod.lines[lineno - 1])
+            if m:
+                break
+        if not m:
+            return
+        lock, writes_only = m.group(1), bool(m.group(2))
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for tgt in targets:
+            if (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                specs.append(_GuardSpec(tgt.attr, lock, writes_only,
+                                        node.lineno, owner, def_func))
+            elif isinstance(tgt, ast.Name) and owner is None:
+                specs.append(_GuardSpec(tgt.id, lock, writes_only,
+                                        node.lineno, None, def_func))
+
+    for node in mod.tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            scan_assign(node, None)
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef):
+                    for sub in ast.walk(item):
+                        if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                            scan_assign(sub, node.name, item.name)
+    return specs
+
+
+def _function_holds(mod: Module, func: ast.FunctionDef) -> Set[str]:
+    """Lock expressions a ``# dukecheck: holds <expr>`` comment on the
+    def line (or the first body lines, next to the docstring) asserts."""
+    held: Set[str] = set()
+    last = func.body[0].lineno if func.body else func.lineno
+    for line in range(func.lineno, last + 1):
+        if line in mod.holds:
+            held.update(e.strip() for e in mod.holds[line].split(","))
+    return held
+
+
+def _is_write(node: ast.expr, parents: Dict[ast.AST, ast.AST]) -> bool:
+    if isinstance(node.ctx, (ast.Store, ast.Del)):
+        return True
+    parent = parents.get(node)
+    # self.attr[k] = v  /  del self.attr[k]
+    if (isinstance(parent, ast.Subscript)
+            and isinstance(parent.ctx, (ast.Store, ast.Del))
+            and parent.value is node):
+        return True
+    # self.attr.append(x) and friends
+    if (isinstance(parent, ast.Attribute) and parent.value is node
+            and parent.attr in _MUTATORS):
+        grand = parents.get(parent)
+        if isinstance(grand, ast.Call) and grand.func is parent:
+            return True
+    return False
+
+
+def check(modules: Sequence[Module], root=None) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in modules:
+        specs = _collect_specs(mod)
+        if not specs:
+            continue
+        # the textual with-match is per-module by NAME, so two annotations
+        # for the same attribute name must agree — a silent "last one
+        # wins" would check half the accesses against the wrong lock
+        by_attr: Dict[str, _GuardSpec] = {}
+        for s in specs:
+            prev = by_attr.setdefault(s.attr, s)
+            if prev is not s and (prev.lock != s.lock
+                                  or prev.writes_only != s.writes_only):
+                findings.append(Finding(
+                    "DK203", mod.rel, s.line,
+                    f"conflicting `# guarded by:` annotations for "
+                    f"`{s.attr}`: `{s.lock}`"
+                    f"{' [writes]' if s.writes_only else ''} here vs "
+                    f"`{prev.lock}`"
+                    f"{' [writes]' if prev.writes_only else ''} at "
+                    f"{mod.rel}:{prev.line} — rename one field or unify "
+                    "the lock",
+                    f"{s.owner or 'module'}.{s.attr}:conflict",
+                ))
+        parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(mod.tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+
+        class Walker(ast.NodeVisitor):
+            def __init__(self):
+                self.with_stack: List[str] = []
+                self.func_stack: List[Tuple[str, Set[str]]] = []
+
+            def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+                # a def's body does NOT run under the with-blocks that
+                # lexically enclose it — it runs when called (thread
+                # target, callback).  Its own `# dukecheck: holds`
+                # contract is the only way in.
+                outer_with = self.with_stack
+                self.with_stack = []
+                self.func_stack.append(
+                    (node.name, _function_holds(mod, node)))
+                self.generic_visit(node)
+                self.func_stack.pop()
+                self.with_stack = outer_with
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_With(self, node: ast.With) -> None:
+                texts = [expr_text(item.context_expr)
+                         for item in node.items]
+                self.with_stack.extend(texts)
+                self.generic_visit(node)
+                del self.with_stack[len(self.with_stack) - len(texts):]
+
+            def _guards_held(self) -> Set[str]:
+                held = set(self.with_stack)
+                for _, extra in self.func_stack:
+                    held |= extra
+                return held
+
+            def _check_access(self, attr: str, node: ast.AST,
+                              write: bool) -> None:
+                spec = by_attr.get(attr)
+                if spec is None:
+                    return
+                if spec.writes_only and not write:
+                    return
+                func = self.func_stack[-1][0] if self.func_stack else ""
+                # construction happens-before publication; the defining
+                # site must match on enclosing function too — an
+                # unrelated access can share a line NUMBER with it
+                if func == "__init__" or (node.lineno == spec.line
+                                          and func == spec.def_func):
+                    return
+                if spec.lock in self._guards_held():
+                    return
+                code = "DK201" if write else "DK202"
+                kind = "write to" if write else "read of"
+                findings.append(Finding(
+                    code, mod.rel, node.lineno,
+                    f"{kind} `{attr}` outside `with {spec.lock}` "
+                    f"(annotated guarded-by at {mod.rel}:{spec.line})",
+                    f"{spec.owner or 'module'}.{attr}@{func}",
+                ))
+
+            def visit_Attribute(self, node: ast.Attribute) -> None:
+                self._check_access(node.attr, node,
+                                   _is_write(node, parents))
+                self.generic_visit(node)
+
+            def visit_Name(self, node: ast.Name) -> None:
+                spec = by_attr.get(node.id)
+                if spec is not None and spec.owner is None:
+                    self._check_access(node.id, node,
+                                       _is_write(node, parents))
+
+        Walker().visit(mod.tree)
+    return findings

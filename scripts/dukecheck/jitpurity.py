@@ -1,0 +1,197 @@
+"""Checker 4 — jit purity (DK401) + jit-cache key identity (DK402).
+
+DK401: a function that reaches ``jax.jit``/``pjit`` (decorated, passed as
+the jit argument, or — for jit *factories* like
+``ops.scoring.build_property_logits`` — having its result jitted) and its
+statically-resolvable same-module callees must not read wall clock
+(``time.*``), nondeterminism (``random.*``, ``np.random.*``), the
+environment (``os.environ``/``os.getenv``), or mutate module globals
+(``global X; X = ...``).  All of these burn the value observed at TRACE
+time into the compiled program: the knob/clock silently stops mattering
+until the next retrace, which is exactly the class of bug the PR 5 review
+cycles kept catching by hand.
+
+DK402: a cache/memo/scorer dict keyed directly with ``id(...)`` at the
+use site (``_SCORERS[id(plan)]``).  ``id()`` is reuse-prone the moment
+the referent is garbage collected — the PR 5 explain-cache aliasing bug,
+generalized.  (Keys built by a helper that PINS the referent alongside
+the entry — the fixed explain.py pattern — do not match.)
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Sequence, Set
+
+from .config import IMPURE_MODULES
+from .core import Finding, Module
+
+_CACHE_NAME_RE = re.compile(r"cache|memo|scorer", re.IGNORECASE)
+
+
+def _is_jit_expr(node: ast.expr) -> bool:
+    """``jax.jit`` / ``jit`` / ``pjit`` / ``jax.pjit`` (bare or inside
+    ``partial(...)``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("jit", "pjit")
+    if isinstance(node, ast.Name):
+        return node.id in ("jit", "pjit")
+    return False
+
+
+def _jit_roots(mod: Module) -> Set[str]:
+    """Names of functions whose bodies are jit-reachable: decorated
+    (``@jax.jit`` / ``@partial(jit, ...)``), wrapped (``jit(f)``), or
+    *factories* whose RESULT is jitted (``jax.jit(build(...))``) — a
+    factory's closures trace, so its whole body is jit-reachable too."""
+    roots: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_expr(dec):
+                    roots.add(node.name)
+                elif (isinstance(dec, ast.Call)
+                      and (_is_jit_expr(dec.func)
+                           or (isinstance(dec.func, ast.Name)
+                               and dec.func.id == "partial"
+                               and dec.args
+                               and _is_jit_expr(dec.args[0])))):
+                    roots.add(node.name)
+        elif isinstance(node, ast.Call) and _is_jit_expr(node.func):
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Name):
+                roots.add(arg.id)
+            elif (isinstance(arg, ast.Call)
+                  and isinstance(arg.func, ast.Name)):
+                roots.add(arg.func.id)
+            elif (isinstance(arg, ast.Call)
+                  and isinstance(arg.func, ast.Attribute)):
+                roots.add(arg.func.attr)
+    return roots
+
+
+def _impure_calls(func: ast.AST, mod: Module,
+                  rel: str) -> List[Finding]:
+    out: List[Finding] = []
+    fname = getattr(func, "name", "<lambda>")
+    globals_written: Set[str] = set()
+    for node in ast.walk(func):
+        if isinstance(node, ast.Global):
+            globals_written.update(node.names)
+    for node in ast.walk(func):
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            if (isinstance(base, ast.Name)
+                    and base.id in IMPURE_MODULES):
+                out.append(Finding(
+                    "DK401", rel, node.lineno,
+                    f"jit-reachable `{fname}` calls "
+                    f"`{base.id}.{node.attr}` — traced once, burned into "
+                    "the compiled program",
+                    f"{fname}:{base.id}.{node.attr}",
+                ))
+            elif (isinstance(base, ast.Attribute)
+                  and base.attr == "random"
+                  and isinstance(base.value, ast.Name)
+                  and base.value.id in ("np", "numpy")):
+                out.append(Finding(
+                    "DK401", rel, node.lineno,
+                    f"jit-reachable `{fname}` calls `np.random.{node.attr}`"
+                    " — nondeterminism at trace time",
+                    f"{fname}:np.random.{node.attr}",
+                ))
+            elif (isinstance(base, ast.Name)
+                  and base.id in ("os", "_os")
+                  and node.attr in ("environ", "getenv")):
+                out.append(Finding(
+                    "DK401", rel, node.lineno,
+                    f"jit-reachable `{fname}` reads the environment — the "
+                    "knob freezes at trace time",
+                    f"{fname}:os.environ",
+                ))
+        elif (isinstance(node, ast.Name)
+              and isinstance(node.ctx, ast.Store)
+              and node.id in globals_written):
+            out.append(Finding(
+                "DK401", rel, node.lineno,
+                f"jit-reachable `{fname}` mutates module global "
+                f"`{node.id}`",
+                f"{fname}:global {node.id}",
+            ))
+    return out
+
+
+def check(modules: Sequence[Module], root=None) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in modules:
+        # same-module function defs (nested closures included — jitted
+        # functions in this codebase are mostly factory closures).  A bare
+        # name can be defined more than once (same-named methods on two
+        # classes, branch-dependent defs): keep EVERY def and treat a
+        # reachable name as reaching all of them — over-approximate rather
+        # than silently analyzing only the first definition
+        defs: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+        roots = _jit_roots(mod)
+        if roots:
+            reach: Set[str] = set()
+            frontier = [name for name in roots if name in defs]
+            while frontier:
+                name = frontier.pop()
+                if name in reach:
+                    continue
+                reach.add(name)
+                for body in defs[name]:
+                    for node in ast.walk(body):
+                        if isinstance(node, ast.Call):
+                            callee = None
+                            if isinstance(node.func, ast.Name):
+                                callee = node.func.id
+                            elif (isinstance(node.func, ast.Attribute)
+                                  and isinstance(node.func.value, ast.Name)
+                                  and node.func.value.id == "self"):
+                                callee = node.func.attr
+                            if callee in defs and callee not in reach:
+                                frontier.append(callee)
+            for name in sorted(reach):
+                for body in defs[name]:
+                    findings.extend(_impure_calls(body, mod, mod.rel))
+        # DK402 — id()-keyed cache access at the use site
+        for node in ast.walk(mod.tree):
+            key_expr = None
+            base_name = None
+            if isinstance(node, ast.Subscript):
+                key_expr = node.slice
+                base_name = (node.value.id
+                             if isinstance(node.value, ast.Name) else
+                             getattr(node.value, "attr", None))
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr in ("get", "setdefault", "pop")
+                  and node.args):
+                key_expr = node.args[0]
+                bv = node.func.value
+                base_name = (bv.id if isinstance(bv, ast.Name)
+                             else getattr(bv, "attr", None))
+            if key_expr is None or not base_name:
+                continue
+            if not _CACHE_NAME_RE.search(base_name):
+                continue
+            for sub in ast.walk(key_expr):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Name)
+                        and sub.func.id == "id"):
+                    findings.append(Finding(
+                        "DK402", mod.rel, node.lineno,
+                        f"cache `{base_name}` keyed on bare `id(...)` — "
+                        "ids alias after GC; key on the object (pinning "
+                        "it) like engine/explain.py's per-plan cache",
+                        f"{base_name}:id-key",
+                    ))
+                    break
+    return findings

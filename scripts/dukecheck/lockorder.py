@@ -1,0 +1,631 @@
+"""Checker 1 — lock acquisition order (DK101) + hierarchy doc (DK190).
+
+Extracts every lock *definition* (``self._lock = threading.Lock()`` /
+``RLock`` / ``Condition``, module-level or local) and every *acquisition
+site* (``with <lock>:``, ``<lock>.acquire()``), resolves cross-object
+receivers through the reviewed tables in ``config.py``, folds nested
+closures into their enclosing function, and propagates
+"locks-possibly-acquired" through the static call graph to a fixpoint.
+The result is the inter-lock acquisition graph: an edge ``A -> B`` means
+"somewhere, B is (possibly transitively) acquired while A is held".
+
+Findings:
+
+  * **DK101** — a cycle in the acquisition graph: two lock classes are
+    taken in both orders somewhere, i.e. a potential deadlock.
+  * **DK190** — the committed ``docs/LOCK_HIERARCHY.md`` no longer
+    matches the graph (regenerate with ``--write-docs``).
+
+Self-edges (``A -> A``) are dropped: our lock identities are per-class,
+and the only same-class nesting in this codebase is across *instances*
+(hot-reload's hand-over-hand over distinct workloads), which is ordered
+by the swap lock, not by class identity.
+
+The graph (``build_graph``) is also the contract the ``DUKE_LOCKCHECK=1``
+runtime sanitizer (utils/lockcheck.py) asserts real executions against.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .config import (
+    CALL_RETURNS_LOCK,
+    CALLBACK_TARGETS,
+    MANUAL_EDGES,
+    RECEIVER_TYPES,
+)
+from .core import Finding, Module, receiver_name
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+# Names carried by builtin collections (dict/list/deque/set) or too
+# generic to trust: the unique-method fallback must never resolve these —
+# `self._records.pop(...)` is a dict pop, not LazyRecordMap.pop, even
+# when exactly one class happens to define the name.  Table-resolved
+# receivers (config.RECEIVER_TYPES) are unaffected.
+_GENERIC_METHODS = {
+    "get", "pop", "put", "add", "append", "appendleft", "extend",
+    "extendleft", "insert", "remove", "discard", "clear", "update",
+    "setdefault", "items", "keys", "values", "popleft", "popitem",
+    "sort", "reverse", "count", "index", "copy", "move_to_end", "set",
+    "inc", "dec", "observe", "wait", "notify", "notify_all", "join",
+    "start", "write", "read", "send", "recv", "flush",
+}
+
+
+class LockDef:
+    __slots__ = ("name", "rel", "line", "kind")
+
+    def __init__(self, name: str, rel: str, line: int, kind: str):
+        self.name = name
+        self.rel = rel
+        self.line = line
+        self.kind = kind
+
+
+class LockGraph:
+    def __init__(self):
+        self.locks: Dict[str, LockDef] = {}
+        # (A, B) -> (rel, line) witness: B acquired while A held
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    def add_edge(self, a: str, b: str, rel: str, line: int) -> None:
+        if a == b:
+            return  # per-class identity; see module docstring
+        self.edges.setdefault((a, b), (rel, line))
+
+    def successors(self) -> Dict[str, Set[str]]:
+        out: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges:
+            out.setdefault(a, set()).add(b)
+        return out
+
+    def reachable(self) -> Dict[str, Set[str]]:
+        """Transitive closure: ``reachable[a]`` = locks acquirable with
+        ``a`` held (used by the runtime sanitizer's inversion check)."""
+        succ = self.successors()
+        out: Dict[str, Set[str]] = {}
+
+        def visit(node: str) -> Set[str]:
+            if node in out:
+                return out[node]
+            out[node] = set()  # cycle guard; cycles are findings anyway
+            acc: Set[str] = set()
+            for nxt in succ.get(node, ()):
+                acc.add(nxt)
+                acc |= visit(nxt)
+            out[node] = acc
+            return acc
+
+        for node in succ:
+            visit(node)
+        return out
+
+    def cycles(self) -> List[List[str]]:
+        """Elementary cycles via SCC decomposition (one finding per SCC —
+        the fix is re-ordering, not enumerating every loop)."""
+        succ = self.successors()
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        onstack: Set[str] = set()
+        stack: List[str] = []
+        sccs: List[List[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            onstack.add(v)
+            for w in sorted(succ.get(v, ())):
+                if w not in index:
+                    strongconnect(w)
+                    low[v] = min(low[v], low[w])
+                elif w in onstack:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                if len(scc) > 1:
+                    sccs.append(sorted(scc))
+        for v in sorted(succ):
+            if v not in index:
+                strongconnect(v)
+        return sccs
+
+
+class _FuncInfo:
+    """Per-function facts: direct acquisitions + calls, each with the
+    locks lexically held at that point."""
+
+    __slots__ = ("qual", "rel", "direct", "calls")
+
+    def __init__(self, qual: str, rel: str):
+        self.qual = qual
+        self.rel = rel
+        # (lockname, held-tuple, line)
+        self.direct: List[Tuple[str, Tuple[str, ...], int]] = []
+        # (callee-qual, held-tuple, line)
+        self.calls: List[Tuple[str, Tuple[str, ...], int]] = []
+
+
+def _terminates(stmts: Sequence[ast.stmt]) -> bool:
+    """True when a statement list cannot fall through (the
+    `if not lock.acquire(False): return` idiom's failure branch)."""
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+class _Analyzer:
+    def __init__(self, modules: Sequence[Module]):
+        self.modules = modules
+        self.graph = LockGraph()
+        # (class, attr) -> lock name
+        self.class_locks: Dict[Tuple[str, str], str] = {}
+        # attr -> lock names (for unique-attr fallback)
+        self.attr_index: Dict[str, Set[str]] = {}
+        # class -> base class names (single package-wide namespace)
+        self.bases: Dict[str, List[str]] = {}
+        # (class, method) -> qual;  (modkey, func) -> qual
+        self.methods: Dict[Tuple[str, str], str] = {}
+        self.functions: Dict[Tuple[str, str], str] = {}
+        self.method_index: Dict[str, Set[str]] = {}
+        self.funcs: Dict[str, _FuncInfo] = {}
+
+    # -- pass 1: definitions -------------------------------------------------
+
+    @staticmethod
+    def _modkey(mod: Module) -> str:
+        # package-qualified (links/base.py -> "links.base") so same-named
+        # modules in different subpackages never share an identity
+        # namespace; the root package component is dropped for brevity
+        parts = mod.rel.split("/")
+        parts[-1] = parts[-1].removesuffix(".py")
+        if parts[-1] == "__init__" and len(parts) >= 2:
+            # package init: the package directory path itself
+            # (native/__init__.py -> "native", not an ambiguous __init__)
+            parts = parts[:-1]
+        if len(parts) > 1:
+            parts = parts[1:]
+        return ".".join(parts)
+
+    @staticmethod
+    def _lock_ctor(value: ast.AST) -> Optional[str]:
+        """'Lock'/'RLock'/'Condition' when ``value`` constructs one
+        (including inside a conditional expression)."""
+        for node in ast.walk(value):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "threading"
+                    and node.func.attr in _LOCK_CTORS):
+                return node.func.attr
+        return None
+
+    def collect_defs(self) -> None:
+        for mod in self.modules:
+            modkey = self._modkey(mod)
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef):
+                    self.bases[node.name] = [
+                        b.id for b in node.bases if isinstance(b, ast.Name)
+                    ]
+                    for item in node.body:
+                        if isinstance(item, ast.FunctionDef):
+                            self.methods[(node.name, item.name)] = (
+                                f"{node.name}.{item.name}"
+                            )
+                            self.method_index.setdefault(
+                                item.name, set()
+                            ).add(f"{node.name}.{item.name}")
+                            self._collect_assigns(
+                                mod, modkey, node.name, item
+                            )
+                elif isinstance(node, ast.FunctionDef):
+                    pass  # module functions registered below
+            for node in mod.tree.body:
+                if isinstance(node, ast.FunctionDef):
+                    self.functions[(modkey, node.name)] = (
+                        f"{modkey}.{node.name}"
+                    )
+                elif isinstance(node, ast.Assign):
+                    kind = self._lock_ctor(node.value)
+                    if kind:
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                name = f"{modkey}.{tgt.id}"
+                                self._define(name, mod.rel, node.lineno,
+                                             kind, tgt.id)
+
+    def _collect_assigns(self, mod: Module, modkey: str, cls: str,
+                         func: ast.FunctionDef) -> None:
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Assign):
+                continue
+            kind = self._lock_ctor(node.value)
+            if not kind:
+                continue
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    name = f"{cls}.{tgt.attr}"
+                    self.class_locks.setdefault((cls, tgt.attr), name)
+                    self._define(name, mod.rel, node.lineno, kind,
+                                 tgt.attr)
+                elif isinstance(tgt, ast.Name):
+                    # function-local lock (engine/finalize.py's resolver
+                    # serializer): scoped by the enclosing function
+                    name = f"{modkey}.{func.name}.{tgt.id}"
+                    self._define(name, mod.rel, node.lineno, kind, tgt.id)
+
+    def _define(self, name: str, rel: str, line: int, kind: str,
+                attr: str) -> None:
+        if name not in self.graph.locks:
+            self.graph.locks[name] = LockDef(name, rel, line, kind)
+        self.attr_index.setdefault(attr, set()).add(name)
+
+    # -- lock resolution -----------------------------------------------------
+
+    def _class_attr_lock(self, cls: Optional[str],
+                         attr: str) -> Optional[str]:
+        seen = set()
+        while cls and cls not in seen:
+            seen.add(cls)
+            hit = self.class_locks.get((cls, attr))
+            if hit:
+                return hit
+            parents = self.bases.get(cls, [])
+            cls = parents[0] if parents else None
+        # unique-attribute fallback: one class in the whole package
+        # defines a lock under this attribute name
+        names = self.attr_index.get(attr, set())
+        if len(names) == 1:
+            return next(iter(names))
+        return None
+
+    def resolve_lock(self, expr: ast.AST, modkey: str, cls: Optional[str],
+                     func: str) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            local = f"{modkey}.{func}.{expr.id}"
+            if local in self.graph.locks:
+                return local
+            return self.module_lock(modkey, expr.id)
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                return self._class_attr_lock(cls, expr.attr)
+            recv = receiver_name(base)
+            for candidate in RECEIVER_TYPES.get(recv or "", ()):
+                hit = self.class_locks.get((candidate, expr.attr))
+                if hit:
+                    return hit
+            names = self.attr_index.get(expr.attr, set())
+            if len(names) == 1:
+                return next(iter(names))
+        if isinstance(expr, ast.Call):
+            # `with self._mesh_op_lock():` — reviewed lock-returning calls
+            fn = expr.func
+            if isinstance(fn, ast.Attribute):
+                return CALL_RETURNS_LOCK.get(fn.attr)
+        return None
+
+    def module_lock(self, modkey: str, name: str) -> Optional[str]:
+        full = f"{modkey}.{name}"
+        return full if full in self.graph.locks else None
+
+    # -- call resolution -----------------------------------------------------
+
+    def resolve_call(self, call: ast.Call, modkey: str,
+                     cls: Optional[str]) -> List[str]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            qual = self.functions.get((modkey, fn.id))
+            if qual:
+                return [qual]
+            return []
+        if not isinstance(fn, ast.Attribute):
+            return []
+        meth = fn.attr
+        base = fn.value
+        if isinstance(base, ast.Name) and base.id == "self":
+            if cls and (cls, meth) in self.methods:
+                return [self.methods[(cls, meth)]]
+            targets = CALLBACK_TARGETS.get((cls or "", meth))
+            if targets:
+                return list(targets)
+            # inherited method
+            parents = self.bases.get(cls or "", [])
+            for p in parents:
+                if (p, meth) in self.methods:
+                    return [self.methods[(p, meth)]]
+        recv = receiver_name(base)
+        out = []
+        for candidate in RECEIVER_TYPES.get(recv or "", ()):
+            if (candidate, meth) in self.methods:
+                out.append(self.methods[(candidate, meth)])
+        if out:
+            return out
+        # unique-method fallback: exactly one class defines this name
+        # (never for collection-protocol/generic names — see
+        # _GENERIC_METHODS)
+        names = self.method_index.get(meth, set())
+        if (len(names) == 1 and meth not in _GENERIC_METHODS
+                and not isinstance(base, ast.Name)):
+            return list(names)
+        if isinstance(base, ast.Name):
+            # module alias: features.extract_batch(...) etc.
+            qual = self.functions.get((base.id, meth))
+            if qual:
+                return [qual]
+        return []
+
+    # -- pass 2: per-function held-region walk -------------------------------
+
+    def analyze_functions(self) -> None:
+        for mod in self.modules:
+            modkey = self._modkey(mod)
+            for node in mod.tree.body:
+                if isinstance(node, ast.FunctionDef):
+                    self._analyze_one(mod, modkey, None, node)
+                elif isinstance(node, ast.ClassDef):
+                    for item in node.body:
+                        if isinstance(item, ast.FunctionDef):
+                            self._analyze_one(mod, modkey, node.name, item)
+
+    def _analyze_one(self, mod: Module, modkey: str, cls: Optional[str],
+                     func: ast.FunctionDef) -> None:
+        qual = f"{cls}.{func.name}" if cls else f"{modkey}.{func.name}"
+        info = _FuncInfo(qual, mod.rel)
+        self.funcs[qual] = info
+        held: List[str] = []
+
+        def lockname_of_acquire(call: ast.Call) -> Optional[str]:
+            fn = call.func
+            if isinstance(fn, ast.Attribute) and fn.attr in (
+                    "acquire", "release"):
+                return self.resolve_lock(fn.value, modkey, cls, func.name)
+            return None
+
+        def handle_expr(node: ast.AST) -> None:
+            """Record calls + bare acquire()/release() inside one
+            expression/statement (no with-scoping at this level)."""
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                fn = sub.func
+                if isinstance(fn, ast.Attribute) and fn.attr == "acquire":
+                    lock = lockname_of_acquire(sub)
+                    if lock and lock not in held:
+                        for h in held:
+                            self.graph.add_edge(h, lock, mod.rel,
+                                                sub.lineno)
+                        held.append(lock)
+                    continue
+                if isinstance(fn, ast.Attribute) and fn.attr == "release":
+                    lock = lockname_of_acquire(sub)
+                    if lock and lock in held:
+                        held.remove(lock)
+                    continue
+                for callee in self.resolve_call(sub, modkey, cls):
+                    info.calls.append((callee, tuple(held), sub.lineno))
+
+        def walk_body(stmts: Sequence[ast.stmt]) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, ast.With):
+                    entered: List[str] = []
+                    for item in stmt.items:
+                        handle_expr(item.context_expr)
+                        lock = self.resolve_lock(
+                            item.context_expr, modkey, cls, func.name)
+                        if lock:
+                            info.direct.append(
+                                (lock, tuple(held), stmt.lineno))
+                            for h in held:
+                                self.graph.add_edge(h, lock, mod.rel,
+                                                    stmt.lineno)
+                            held.append(lock)
+                            entered.append(lock)
+                    walk_body(stmt.body)
+                    for lock in reversed(entered):
+                        if lock in held:
+                            held.remove(lock)
+                elif isinstance(stmt, ast.If):
+                    # `if not X.acquire(...):` — the lock is held AFTER
+                    # the statement (the body is the failure path);
+                    # `if X.acquire(...):` — held inside the body.
+                    test = stmt.test
+                    negated = (isinstance(test, ast.UnaryOp)
+                               and isinstance(test.op, ast.Not))
+                    inner = test.operand if negated else test
+                    cond_lock = None
+                    if (isinstance(inner, ast.Call)
+                            and isinstance(inner.func, ast.Attribute)
+                            and inner.func.attr == "acquire"):
+                        cond_lock = self.resolve_lock(
+                            inner.func.value, modkey, cls, func.name)
+                    if cond_lock:
+                        if negated:
+                            # body is the FAILURE path (lock not held);
+                            # orelse and the fall-through are the success
+                            # path.  Claim the hold past the statement only
+                            # when the failure path terminates — otherwise
+                            # the merge point is ambiguous and phantom
+                            # edges could manufacture a spurious cycle.
+                            walk_body(stmt.body)
+                            took = cond_lock not in held
+                            if took:
+                                for h in held:
+                                    self.graph.add_edge(
+                                        h, cond_lock, mod.rel, stmt.lineno)
+                                info.direct.append(
+                                    (cond_lock, tuple(held), stmt.lineno))
+                                held.append(cond_lock)
+                            walk_body(stmt.orelse)
+                            if took and not _terminates(stmt.body):
+                                held.remove(cond_lock)
+                        else:
+                            for h in held:
+                                self.graph.add_edge(h, cond_lock, mod.rel,
+                                                    stmt.lineno)
+                            info.direct.append(
+                                (cond_lock, tuple(held), stmt.lineno))
+                            held.append(cond_lock)
+                            walk_body(stmt.body)
+                            if cond_lock in held:
+                                held.remove(cond_lock)
+                            walk_body(stmt.orelse)
+                    else:
+                        handle_expr(test)
+                        walk_body(stmt.body)
+                        walk_body(stmt.orelse)
+                elif isinstance(stmt, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    # closures run within the enclosing function's lock
+                    # context in this codebase (flush(), resolver(), ...)
+                    walk_body(stmt.body)
+                elif isinstance(stmt, (ast.For, ast.While)):
+                    handle_expr(getattr(stmt, "iter", None)
+                                or getattr(stmt, "test", None))
+                    walk_body(stmt.body)
+                    walk_body(stmt.orelse)
+                elif isinstance(stmt, ast.Try):
+                    walk_body(stmt.body)
+                    for handler in stmt.handlers:
+                        walk_body(handler.body)
+                    walk_body(stmt.orelse)
+                    walk_body(stmt.finalbody)
+                else:
+                    handle_expr(stmt)
+
+        walk_body(func.body)
+
+    # -- pass 3: fixpoint propagation ---------------------------------------
+
+    def propagate(self) -> None:
+        closure: Dict[str, Set[str]] = {
+            q: {lock for lock, _, _ in f.direct}
+            for q, f in self.funcs.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for q, f in self.funcs.items():
+                acc = closure[q]
+                before = len(acc)
+                for callee, _, _ in f.calls:
+                    acc |= closure.get(callee, set())
+                if len(acc) != before:
+                    changed = True
+        for q, f in self.funcs.items():
+            for callee, held, line in f.calls:
+                if not held:
+                    continue
+                for lock in closure.get(callee, ()):
+                    for h in held:
+                        self.graph.add_edge(h, lock, f.rel, line)
+
+
+def build_graph(modules: Sequence[Module]) -> LockGraph:
+    a = _Analyzer(modules)
+    a.collect_defs()
+    a.analyze_functions()
+    a.propagate()
+    # reviewed runtime-observed edges (config.MANUAL_EDGES): folded into
+    # the same graph so the cycle check and the generated doc cover them
+    for held, acquired, why in MANUAL_EDGES:
+        a.graph.edges.setdefault(
+            (held, acquired), ("scripts/dukecheck/config.py", 0))
+    return a.graph
+
+
+# -- the generated hierarchy doc ----------------------------------------------
+
+DOC_RELPATH = "docs/LOCK_HIERARCHY.md"
+
+_DOC_HEADER = """\
+# Lock hierarchy (generated — do not edit)
+
+Regenerate with `python -m scripts.dukecheck --write-docs`; CI fails
+(DK190) when this file is stale.  An edge `A -> B` means code somewhere
+acquires `B` while holding `A` (possibly through calls); the checker
+fails (DK101) if the graph ever contains a cycle, and the
+`DUKE_LOCKCHECK=1` runtime sanitizer asserts observed acquisition order
+against this same graph.
+
+Rules of the hierarchy:
+
+* acquire locks **downward** only (toward leaves of the edge table);
+* never call into an engine/workload entry point while holding a leaf
+  lock (telemetry, cache, store locks are leaves by design);
+* a new nesting that adds an edge here is a reviewed event — regenerate
+  the doc in the same PR and make sure no cycle appears.
+"""
+
+
+def render_doc(graph: LockGraph) -> str:
+    lines = [_DOC_HEADER]
+    lines.append("## Locks\n")
+    lines.append("| lock | kind | defined at |")
+    lines.append("|---|---|---|")
+    for name in sorted(graph.locks):
+        d = graph.locks[name]
+        lines.append(f"| `{name}` | {d.kind} | {d.rel}:{d.line} |")
+    lines.append("")
+    lines.append("## Acquisition-order edges\n")
+    if not graph.edges:
+        lines.append("(no nested acquisitions found)")
+    else:
+        lines.append("| held | acquires | witness |")
+        lines.append("|---|---|---|")
+        for (a, b) in sorted(graph.edges):
+            rel, line = graph.edges[(a, b)]
+            lines.append(f"| `{a}` | `{b}` | {rel}:{line} |")
+    lines.append("")
+    roots = sorted({a for a, _ in graph.edges}
+                   - {b for _, b in graph.edges})
+    if roots:
+        lines.append("## Top-level (outermost) locks\n")
+        for r in roots:
+            lines.append(f"* `{r}`")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def check(modules: Sequence[Module], root: Path) -> List[Finding]:
+    graph = build_graph(modules)
+    findings: List[Finding] = []
+    for scc in graph.cycles():
+        witnesses = []
+        n = len(scc)
+        for i, a in enumerate(scc):
+            b = scc[(i + 1) % n]
+            w = graph.edges.get((a, b))
+            if w:
+                witnesses.append(f"{a}->{b} @ {w[0]}:{w[1]}")
+        first = graph.locks.get(scc[0])
+        findings.append(Finding(
+            "DK101", first.rel if first else "scripts/dukecheck",
+            first.line if first else 0,
+            "lock-order cycle: " + " / ".join(witnesses),
+            "cycle:" + "|".join(scc),
+        ))
+    doc_path = root / DOC_RELPATH
+    want = render_doc(graph)
+    have = doc_path.read_text(encoding="utf-8") if doc_path.exists() else ""
+    if have != want:
+        findings.append(Finding(
+            "DK190", DOC_RELPATH, 1,
+            "lock hierarchy doc is stale — run "
+            "`python -m scripts.dukecheck --write-docs`",
+            "stale-doc",
+        ))
+    return findings

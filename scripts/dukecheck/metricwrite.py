@@ -1,0 +1,106 @@
+"""Checker 5 — single-writer metrics discipline (DK501/DK502).
+
+The registry contract (telemetry/registry.py): engine hot paths write
+PLAIN single-writer counters that scrape-time collectors turn into
+``FamilySnapshot``s (service/metrics.py); direct registry-child traffic
+belongs to the HTTP/telemetry layers.  In the hot modules
+(``config.HOT_MODULE_PREFIXES``):
+
+  * **DK501** — ``.labels(...)`` on a registry family: child lookup takes
+    the family lock on every miss and allocates the key tuple on every
+    call; hot paths must pre-resolve children at init (see
+    device_matcher's per-bucket children).
+  * **DK502** — a direct child write (``.inc``/``.observe``/``.set``/
+    ``.dec``) on a registry metric: rare-event sites (corpus growth,
+    mesh failure latches) carry inline justifications; per-record/
+    per-op sites must move to the snapshot pattern.
+
+Metric objects are recognized by name: module-level assignments from
+``*.counter(...)`` / ``*.gauge(...)`` / ``*.histogram(...)`` anywhere in
+the package build the metric-name set; writes are flagged when the
+receiver is ``<METRIC>`` or ``telemetry.<METRIC>`` (or a ``.labels()``
+chain on one).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence, Set
+
+from .config import HOT_MODULE_PREFIXES
+from .core import Finding, Module
+
+_WRITES = ("inc", "observe", "set", "dec")
+_FACTORIES = ("counter", "gauge", "histogram")
+
+
+def metric_names(modules: Sequence[Module]) -> Set[str]:
+    names: Set[str] = set()
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            if (isinstance(value, ast.Call)
+                    and isinstance(value.func, ast.Attribute)
+                    and value.func.attr in _FACTORIES):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        names.add(tgt.id)
+                    elif isinstance(tgt, ast.Attribute):
+                        names.add(tgt.attr)
+    return names
+
+
+def _metric_receiver(node: ast.expr, names: Set[str]) -> str:
+    """The metric name when ``node`` is ``METRIC`` / ``telemetry.METRIC``
+    / ``mod.METRIC`` / a ``.labels(...)`` call on one of those."""
+    if isinstance(node, ast.Name) and node.id in names:
+        return node.id
+    if isinstance(node, ast.Attribute) and node.attr in names:
+        return node.attr
+    if (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "labels"):
+        return _metric_receiver(node.func.value, names)
+    return ""
+
+
+def check(modules: Sequence[Module], root=None) -> List[Finding]:
+    names = metric_names(modules)
+    findings: List[Finding] = []
+    for mod in modules:
+        if not mod.rel.startswith(HOT_MODULE_PREFIXES):
+            continue
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            if attr == "labels":
+                metric = _metric_receiver(node.func.value, names)
+                if metric:
+                    findings.append(Finding(
+                        "DK501", mod.rel, node.lineno,
+                        f"label-child lookup `{metric}.labels(...)` on an "
+                        "engine hot path — pre-resolve the child at init "
+                        "or use the scrape-time snapshot pattern",
+                        f"labels:{metric}",
+                    ))
+            elif attr in _WRITES:
+                recv = node.func.value
+                # `.labels(...).inc()` already reported as DK501
+                if (isinstance(recv, ast.Call)
+                        and isinstance(recv.func, ast.Attribute)
+                        and recv.func.attr == "labels"):
+                    continue
+                metric = _metric_receiver(recv, names)
+                if metric:
+                    findings.append(Finding(
+                        "DK502", mod.rel, node.lineno,
+                        f"registry write `{metric}.{attr}(...)` on an "
+                        "engine hot path — single-writer counters + "
+                        "scrape-time snapshots are the contract here",
+                        f"write:{metric}.{attr}",
+                    ))
+    return findings

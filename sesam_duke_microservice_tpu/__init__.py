@@ -21,3 +21,10 @@ models    Flax record-encoder (embedding-ANN blocking) + training.
 """
 
 __version__ = "0.1.0"
+
+# DUKE_LOCKCHECK=1 runtime lock-order sanitizer: must install before any
+# package module creates a lock, so it lives at the top of the package
+# import (no-op — not even a wrapper — when the flag is unset)
+from .utils import lockcheck as _lockcheck  # noqa: E402
+
+_lockcheck.install_if_enabled()

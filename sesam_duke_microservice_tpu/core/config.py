@@ -103,7 +103,7 @@ class MatchTunables:
     max_search_hits: int = 10
 
     @classmethod
-    def from_env(cls, env=os.environ) -> "MatchTunables":
+    def from_env(cls, env=os.environ) -> "MatchTunables":  # dukecheck: ignore[DK301] injectable env= seam (tests pass dicts); reference parity requires raw strings
         t = cls()
         if env.get("MIN_RELEVANCE"):
             t.min_relevance = float(env["MIN_RELEVANCE"])
@@ -394,7 +394,7 @@ def _link_database_type(el: ET.Element, name: str) -> str:
     return ldt
 
 
-def parse_config(config_string: str, env=os.environ) -> ServiceConfig:
+def parse_config(config_string: str, env=os.environ) -> ServiceConfig:  # dukecheck: ignore[DK301] injectable env= seam
     """Parse a full service config string (the POST /config payload shape)."""
     try:
         root = ET.fromstring(config_string)
@@ -492,7 +492,7 @@ DEFAULT_CONFIG_RESOURCE = os.path.join(
 )
 
 
-def load_default_config(env=os.environ) -> ServiceConfig:
+def load_default_config(env=os.environ) -> ServiceConfig:  # dukecheck: ignore[DK301] injectable env= seam
     """Load CONFIG_STRING from the environment, else the bundled demo config
     (mirrors App.java:200-224)."""
     config_string = env.get("CONFIG_STRING")

@@ -27,7 +27,6 @@ the incremental device-mirror update apply to it unchanged.
 from __future__ import annotations
 
 import logging
-import os
 from typing import Optional, Sequence
 
 import numpy as np
@@ -35,6 +34,7 @@ import numpy as np
 from ..core.config import DukeSchema, MatchTunables
 from ..core.records import Record
 from ..ops import encoder as E
+from ..telemetry.env import env_int
 from .device_matcher import (
     DeviceIndex,
     DeviceProcessor,
@@ -45,8 +45,8 @@ from .device_matcher import (
 
 logger = logging.getLogger("ann-matcher")
 
-_ANN_DIM = int(os.environ.get("DEVICE_ANN_DIM", "256"))
-_ANN_TOP_C = int(os.environ.get("DEVICE_ANN_CANDIDATES", "64"))
+_ANN_DIM = env_int("DEVICE_ANN_DIM", 256)
+_ANN_TOP_C = env_int("DEVICE_ANN_CANDIDATES", 64)
 
 
 class AnnIndex(DeviceIndex):

@@ -53,7 +53,7 @@ from ..index.base import CandidateIndex
 from ..ops import features as F
 from ..ops.features import CHARS as _F_CHARS, CHARS_WEIGHTED as _F_CHARS_W
 from ..telemetry import tracing
-from ..telemetry.env import env_int_tuple
+from ..telemetry.env import env_flag, env_int, env_int_tuple, env_str
 from .scheduler import DEFAULT_QUERY_BUCKETS
 from ..utils.jit_cache import record_cache_hit, record_compile
 from .listeners import MatchListener
@@ -82,23 +82,23 @@ logger = logging.getLogger("device-matcher")
 _QUERY_BUCKETS = env_int_tuple(
     "DEVICE_QUERY_BUCKETS", DEFAULT_QUERY_BUCKETS
 )
-_CHUNK = int(os.environ.get("DEVICE_CHUNK", "8192"))
+_CHUNK = env_int("DEVICE_CHUNK", 8192)
 # Incremental device-update slices bucket independently of the scan chunk:
 # a steady-state commit of a few hundred rows must not pay a chunk-sized
 # (8192-row) transfer.
-_UPDATE_SLICE = int(os.environ.get("DEVICE_UPDATE_SLICE", "512"))
+_UPDATE_SLICE = env_int("DEVICE_UPDATE_SLICE", 512)
 # Pre-sized corpus capacity (rows) for deployments that know their corpus
 # scale: capacity-doubling growth transiently needs old + new tensors
 # resident, so a corpus near half of HBM cannot double its way up (e.g.
 # 10M rows would try to allocate a 16.8M-row copy).  Pre-sizing allocates
 # once at the target and never grows through the danger zone.
-_INITIAL_CAPACITY = int(os.environ.get("DEVICE_INITIAL_CAPACITY", "0"))
-_INITIAL_TOP_K = int(os.environ.get("DEVICE_TOP_K", "64"))
+_INITIAL_CAPACITY = env_int("DEVICE_INITIAL_CAPACITY", 0)
+_INITIAL_TOP_K = env_int("DEVICE_TOP_K", 64)
 # Value-slot auto-growth cap: pair scoring is O(V^2) combos per property, so
 # the per-property value axis stops doubling here; records with more values
 # score their first MAX slots on device (host finalization still sees every
 # value, so only *pruning* can be affected beyond the cap).
-_VALUE_SLOTS_MAX = int(os.environ.get("DEVICE_VALUE_SLOTS_MAX", "8"))
+_VALUE_SLOTS_MAX = env_int("DEVICE_VALUE_SLOTS_MAX", 8)
 # Per-property char-width auto-growth (CHARS-kind properties): when
 # DEVICE_MAX_CHARS is NOT pinned, each property's char tensors start at
 # the 32-char Myers width and double to fit the data — so ONE long-text
@@ -113,8 +113,8 @@ _VALUE_SLOTS_MAX = int(os.environ.get("DEVICE_VALUE_SLOTS_MAX", "8"))
 # dragging every corpus pair onto the ~86K pairs/s scan-DP kernel.
 # DEVICE_DEMOTE_CHARS=0 disables demotion; widths then grow to
 # DEVICE_MAX_CHARS_CAP and truncate beyond it.
-_CHARS_CAP = int(os.environ.get("DEVICE_MAX_CHARS_CAP", "1024"))
-_DEMOTE_CHARS = int(os.environ.get("DEVICE_DEMOTE_CHARS", "256"))
+_CHARS_CAP = env_int("DEVICE_MAX_CHARS_CAP", 1024)
+_DEMOTE_CHARS = env_int("DEVICE_DEMOTE_CHARS", 256)
 
 
 def query_buckets() -> tuple:
@@ -132,6 +132,18 @@ def bucket_for(n: int) -> int:
     return _QUERY_BUCKETS[-1]
 
 
+# Pre-resolved registry children (dukecheck DK501/DK502): the padding
+# ladder is a closed set, so per-bucket children resolve once at import
+# and the scoring path writes plain single-writer child counters with no
+# family-lock lookup or key-tuple allocation per block.
+_BUCKET_CHILDREN = {
+    b: (telemetry.QUERY_BLOCKS.labels(bucket=str(b)),  # dukecheck: ignore[DK501] init-time pre-resolution
+        telemetry.QUERY_PAD_ROWS.labels(bucket=str(b)))  # dukecheck: ignore[DK501] init-time pre-resolution
+    for b in _QUERY_BUCKETS
+}
+_STREAM_SLICES_CHILD = telemetry.STREAM_APPEND_SLICES.single()
+
+
 def _stream_append_slice(n: int) -> Optional[int]:
     """Slice size for the streamed extract→upload append, or None for the
     whole-batch path (small batches have nothing to overlap).
@@ -141,7 +153,7 @@ def _stream_append_slice(n: int) -> Optional[int]:
     slices grow to its minimum slab so every slice still rides the
     process pool — the overlap must never cost the fan-out.
     """
-    if os.environ.get("DUKE_STREAM_APPEND", "1") == "0":
+    if not env_flag("DUKE_STREAM_APPEND", True):
         return None
     slice_n = _UPDATE_SLICE
     from ..ops import parallel_extract as PX
@@ -223,7 +235,7 @@ class DeviceCorpus:
             # a doubling of an existing corpus: the next device_arrays
             # call re-uploads everything (observability: capacity events
             # explain latency spikes and justify DEVICE_INITIAL_CAPACITY)
-            telemetry.CORPUS_GROWTHS.inc()
+            telemetry.CORPUS_GROWTHS.inc()  # dukecheck: ignore[DK502] rare event: capacity doubling, not per-record
         self.row_valid = _grow_1d(self.row_valid, cap, False)
         self.row_deleted = _grow_1d(self.row_deleted, cap, False)
         self.row_group = _grow_1d(self.row_group, cap, -1)
@@ -374,7 +386,7 @@ class DeviceCorpus:
         # bumps _mutation_gen) — the retry loop in device_arrays then
         # applies it, instead of a post-read clear() silently eating it.
         if self._device is None or self._dirty_full:
-            telemetry.CORPUS_FULL_UPLOADS.inc()
+            telemetry.CORPUS_FULL_UPLOADS.inc()  # dukecheck: ignore[DK502] rare event: growth/restore re-upload
             self._device = {
                 prop: {name: self._place(arr) for name, arr in tensors.items()}
                 for prop, tensors in self.feats.items()
@@ -571,12 +583,12 @@ class DeviceIndex(CandidateIndex):
         # a record whose *second* value is the matching one must still be
         # visible to device pruning).  An explicit ctor arg or
         # DEVICE_VALUE_SLOTS env pins the width instead.
-        env_v = os.environ.get("DEVICE_VALUE_SLOTS")
+        env_v = env_str("DEVICE_VALUE_SLOTS")
         self._auto_value_slots = values_per_record is None and env_v is None
         # char widths auto-grow per property unless the operator pinned a
         # global width (tests pin small shapes; long-text deployments let
         # the data size each property's tensors)
-        self._auto_chars = os.environ.get("DEVICE_MAX_CHARS") is None
+        self._auto_chars = env_str("DEVICE_MAX_CHARS") is None
         v = values_per_record or int(env_v or "1")
         self.plan = F.SchemaFeatures.plan(schema, values_per_record=v)
         if not self.plan.device_props:
@@ -816,7 +828,7 @@ class DeviceIndex(CandidateIndex):
                 out[done:done + len(chunk)] = rows
                 done += len(chunk)
                 if corpus.stream_flush():
-                    telemetry.STREAM_APPEND_SLICES.inc()
+                    _STREAM_SLICES_CHILD.inc()
         return out
 
     def _old_liveness(self, records: Sequence[Record]) -> List[bool]:
@@ -1129,11 +1141,11 @@ class DeviceIndex(CandidateIndex):
         spec = repr((
             [(s.name, s.kind, s.low, s.high)
              for s in self.plan.device_props],
-            os.environ.get("DEVICE_MAX_CHARS", ""),
-            os.environ.get("DEVICE_MAX_CHARS_CAP", ""),
-            os.environ.get("DEVICE_DEMOTE_CHARS", ""),
-            os.environ.get("DEVICE_MAX_GRAMS", ""),
-            os.environ.get("DEVICE_MAX_TOKENS", ""),
+            env_str("DEVICE_MAX_CHARS", ""),
+            env_str("DEVICE_MAX_CHARS_CAP", ""),
+            env_str("DEVICE_DEMOTE_CHARS", ""),
+            env_str("DEVICE_MAX_GRAMS", ""),
+            env_str("DEVICE_MAX_TOKENS", ""),
             getattr(self, "dim", None),          # ANN embedding width
             getattr(self, "emb_storage", None),  # ANN embedding dtype
             # char-tensor storage dtype (r5: uint16 UTF-16 code units) —
@@ -1178,7 +1190,7 @@ class DeviceIndex(CandidateIndex):
         # corpus (10M rows ≈ 9 GB with embeddings) takes minutes, so large
         # deployments set SNAPSHOT_COMPRESS=0 and pay disk instead
         savez = (np.savez_compressed
-                 if os.environ.get("SNAPSHOT_COMPRESS", "1") != "0"
+                 if env_flag("SNAPSHOT_COMPRESS", True)
                  else np.savez)
         try:
             savez(
@@ -1436,7 +1448,7 @@ class DeviceIndex(CandidateIndex):
         # clearly harmful there (remote compiles contend with everything)
         # and stays opt-in via RESTART_PREWARM in the bench.  Numbers and
         # the (large) host variance: BASELINE.md "Restart".
-        if os.environ.get("DEVICE_WARM_UPLOAD", "1") == "0":
+        if not env_flag("DEVICE_WARM_UPLOAD", True):
             return
 
         def _upload():
@@ -1546,7 +1558,7 @@ class _ScorerCache:
         compiles.  ``lower().compile()`` also seeds the persistent XLA
         compile cache, making restarts compile-free.  Safe to call often:
         no-ops while the shape fingerprint is unchanged."""
-        if os.environ.get("DEVICE_PREWARM", "1") == "0":
+        if not env_flag("DEVICE_PREWARM", True):
             return
         # the warm compiles land in the persistent XLA cache (private jit
         # instances; the live scorer reads the cache on first contact) —
@@ -1584,7 +1596,9 @@ class _ScorerCache:
     def _lower_args(self, row_feats, cap: int, bucket: int):
         import jax
 
-        sds = lambda a: jax.ShapeDtypeStruct((cap,) + a.shape[1:], a.dtype)
+        def sds(a):
+            return jax.ShapeDtypeStruct((cap,) + a.shape[1:], a.dtype)
+
         cfeats = {
             prop: {name: sds(arr) for name, arr in tensors.items()}
             for prop, tensors in row_feats.items()
@@ -1740,11 +1754,10 @@ class _ScorerCache:
         # padding-bucket visibility: which static shapes blocks land on
         # and how many padded rows they carry (unlocked counters — this
         # is the scoring path; see telemetry.QUERY_BLOCKS)
-        telemetry.QUERY_BLOCKS.labels(bucket=str(bucket)).inc()
+        blocks_child, pad_child = _BUCKET_CHILDREN[bucket]
+        blocks_child.inc()
         if bucket > len(records):
-            telemetry.QUERY_PAD_ROWS.labels(bucket=str(bucket)).inc(
-                bucket - len(records)
-            )
+            pad_child.inc(bucket - len(records))
         # (a block larger than the biggest bucket is split by the caller)
         rows = [index.id_to_row.get(r.record_id, -1) for r in records]
         from_rows = self.queries_from_rows and all(row >= 0 for row in rows)
@@ -1866,7 +1879,7 @@ def _count_escalation() -> None:
         ESCALATIONS += 1
     # mirrored on /metrics; escalations are rare by construction (each
     # doubles K), so the registry update is off the steady-state path
-    telemetry.SCORER_ESCALATIONS.inc()
+    telemetry.SCORER_ESCALATIONS.inc()  # dukecheck: ignore[DK502] rare by construction (each escalation doubles K)
 
 
 def resolve_block(pending) -> _BlockResult:

@@ -31,13 +31,13 @@ makes that loop parallel, bounded, and mostly skippable:
 from __future__ import annotations
 
 import logging
-import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence, Tuple
 
 from ..core.records import Record
 from ..telemetry.decisions import PairDecision
+from ..telemetry.env import env_flag, env_str
 
 
 class QueryOutcome:
@@ -75,7 +75,7 @@ class QueryOutcome:
 
 def _resolve_threads(threads: int, use_env: bool) -> int:
     if use_env:
-        env = os.environ.get("DUKE_FINALIZE_THREADS")
+        env = env_str("DUKE_FINALIZE_THREADS")
         if env:
             try:
                 return max(1, int(env))
@@ -102,10 +102,9 @@ class FinalizeExecutor:
                  use_env: bool = True):
         self.threads = _resolve_threads(threads, use_env)
         if decisive is None:
-            decisive = (not use_env
-                        or os.environ.get("DUKE_DECISIVE_BAND", "1") != "0")
+            decisive = not use_env or env_flag("DUKE_DECISIVE_BAND", True)
         self.decisive = decisive
-        self._pool: Optional[ThreadPoolExecutor] = None
+        self._pool: Optional[ThreadPoolExecutor] = None  # guarded by: self._pool_lock
         self._pool_lock = threading.Lock()
 
     def _get_pool(self) -> ThreadPoolExecutor:

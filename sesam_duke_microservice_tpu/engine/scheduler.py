@@ -54,7 +54,7 @@ from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from ..telemetry import tracing
 from ..telemetry.decisions import _MonitorHist
-from ..telemetry.env import env_float, env_int
+from ..telemetry.env import env_flag, env_float, env_int
 
 logger = logging.getLogger("ingest-scheduler")
 
@@ -70,9 +70,7 @@ __all__ = [
 
 def scheduler_enabled() -> bool:
     """``DUKE_SCHEDULER=0`` restores the pre-scheduler ingest path."""
-    import os
-
-    return os.environ.get("DUKE_SCHEDULER", "1") != "0"
+    return env_flag("DUKE_SCHEDULER", True)
 
 
 # The query-padding ladder default, here (jax-import-free) so BOTH
@@ -186,19 +184,19 @@ class _TenantQueue:
     def __init__(self, kind: str, name: str):
         self.kind = kind
         self.name = name
-        self.pending: Deque[_SchedRequest] = deque()
+        self.pending: Deque[_SchedRequest] = deque()  # guarded by: self._cv [writes]
         # record count mirror of ``pending``, maintained under the
         # scheduler condition — /metrics and /stats read it (and
         # len(pending)) lock-free, so they must never ITERATE the deque
         # (a concurrent append would raise "deque mutated during
         # iteration" and 500 the scrape)
-        self.queued = 0
-        self.deficit = 0
-        self.admitted = 0
-        self.rejected = 0
-        self.microbatches = 0
-        self.merged_requests = 0
-        self.dispatched_records = 0
+        self.queued = 0  # guarded by: self._cv [writes]
+        self.deficit = 0  # guarded by: self._cv [writes]
+        self.admitted = 0  # guarded by: self._cv [writes]
+        self.rejected = 0  # guarded by: self._cv [writes]
+        self.microbatches = 0  # single-writer: dispatcher thread
+        self.merged_requests = 0  # single-writer: dispatcher thread
+        self.dispatched_records = 0  # single-writer: dispatcher thread
         self.wait_hist = _MonitorHist(_WAIT_BOUNDS)
         self.fill_hist = _MonitorHist(_FILL_BOUNDS)
 
@@ -232,10 +230,10 @@ class IngestScheduler:
                  start: bool = True):
         self._resolve = resolve
         self._cv = threading.Condition()
-        self._queues: Dict[Tuple[str, str], _TenantQueue] = {}
-        self._order: List[Tuple[str, str]] = []  # DRR rotation order
-        self._rr_index = 0
-        self._closed = False
+        self._queues: Dict[Tuple[str, str], _TenantQueue] = {}  # guarded by: self._cv
+        self._order: List[Tuple[str, str]] = []  # DRR rotation order; guarded by: self._cv
+        self._rr_index = 0  # guarded by: self._cv
+        self._closed = False  # guarded by: self._cv
         self._thread: Optional[threading.Thread] = None
         self.window_seconds = max(
             0.0, env_float("DUKE_SCHED_WINDOW_MS", 5.0) / 1000.0)
@@ -245,7 +243,7 @@ class IngestScheduler:
         # sec/record EWMA over dispatched microbatches (dispatcher-written,
         # admission-read): the Retry-After estimator.  Starts None — the
         # first rejections before any dispatch fall back to 1s.
-        self._ewma_sec_per_record: Optional[float] = None
+        self._ewma_sec_per_record: Optional[float] = None  # guarded by: self._cv [writes]
         if start:
             self.start()
 
@@ -540,8 +538,12 @@ class IngestScheduler:
                 q.merged_requests += len(batch)
                 q.dispatched_records += total
                 q.fill_hist.observe(float(total))
-                self._ewma_sec_per_record = fold_ewma(
-                    self._ewma_sec_per_record, hold / max(1, total))
+                # once per microbatch, and admission reads the estimator
+                # under _cv — fold under the same lock so a Retry-After
+                # computed mid-fold can never mix old/new EWMA state
+                with self._cv:
+                    self._ewma_sec_per_record = fold_ewma(
+                        self._ewma_sec_per_record, hold / max(1, total))
                 return True
         except Exception as e:  # never lose a request on dispatcher errors
             logger.exception("microbatch dispatch failed for %s/%s",

@@ -82,7 +82,7 @@ def serving_mesh():
             _MESH = multihost.global_corpus_mesh()
             from .. import telemetry
 
-            telemetry.MESH_DEVICES.set(_MESH.size)
+            telemetry.MESH_DEVICES.set(_MESH.size)  # dukecheck: ignore[DK502] once per process: mesh construction
             logger.info(
                 "serving mesh: %d device(s), axis %r",
                 _MESH.size, _MESH.axis_names,

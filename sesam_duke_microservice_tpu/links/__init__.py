@@ -1,3 +1,4 @@
+from ..telemetry.env import env_flag
 from .base import Link, LinkStatus, LinkKind, LinkDatabase
 from .memory import InMemoryLinkDatabase
 from .sqlite import SqliteLinkDatabase
@@ -37,7 +38,7 @@ def create_link_database(link_database_type: str, data_folder=None,
         name = "recordlinkdatabase" if is_record_linkage else "linkdatabase"
         os.makedirs(data_folder, exist_ok=True)
         db = SqliteLinkDatabase(os.path.join(data_folder, name + ".sqlite"))
-        if os.environ.get("DUKE_WRITE_BEHIND", "1") == "0":
+        if not env_flag("DUKE_WRITE_BEHIND", True):
             return db
         return WriteBehindLinkDatabase(db)
     raise ValueError(f"Got an unknown 'link-database-type' value: '{link_database_type}'")

@@ -11,6 +11,7 @@ served by GET /deduplication/:name?since=N — App.java:843).
 from __future__ import annotations
 
 import enum
+import threading
 import time
 from typing import List, Optional
 
@@ -29,7 +30,7 @@ class LinkKind(enum.Enum):
 
 
 _last_millis = 0
-_millis_lock = __import__("threading").Lock()
+_millis_lock = threading.Lock()
 
 
 def now_millis() -> int:

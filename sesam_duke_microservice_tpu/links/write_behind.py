@@ -69,18 +69,19 @@ class WriteBehindBuffer:
         self._drop_on_overflow = drop_on_overflow
         self._name = name
         self._cv = threading.Condition()
-        self._buf: List = []
-        self._queue: deque = deque()
-        self._inflight = False
-        self._error: Optional[BaseException] = None
-        self._closed = False
-        self._thread: Optional[threading.Thread] = None
-        self.dropped = 0  # batches discarded by the overflow policy
+        self._buf: List = []  # guarded by: self._cv
+        self._queue: deque = deque()  # guarded by: self._cv
+        self._inflight = False  # guarded by: self._cv
+        self._error: Optional[BaseException] = None  # guarded by: self._cv [writes]
+        self._closed = False  # guarded by: self._cv
+        self._thread: Optional[threading.Thread] = None  # guarded by: self._cv
+        # batches discarded by the overflow policy; read lock-free by stats
+        self.dropped = 0  # guarded by: self._cv [writes]
 
     # -- worker --------------------------------------------------------------
 
     def _ensure_thread(self) -> None:
-        # called with _cv held
+        # dukecheck: holds self._cv
         if self._thread is None or not self._thread.is_alive():
             self._thread = threading.Thread(
                 target=self._run, daemon=True, name=self._name
@@ -111,7 +112,7 @@ class WriteBehindBuffer:
                 self._cv.notify_all()
 
     def _raise_latched(self) -> None:
-        # called with _cv held
+        # dukecheck: holds self._cv
         if self._error is not None:
             raise RuntimeError(
                 f"{self._name} flush failed; the backing store is stale"
@@ -202,7 +203,7 @@ class WriteBehindLinkDatabase(LinkDatabase):
     # the shared buffer now
     @property
     def _queue(self) -> deque:
-        return self._wb._queue
+        return self._wb._queue  # dukecheck: ignore[DK202] test introspection handle; callers must hold _wb._cv to iterate
 
     # -- writes (buffered, arrival order) ------------------------------------
 

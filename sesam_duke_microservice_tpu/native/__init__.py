@@ -19,6 +19,8 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..telemetry.env import env_flag
+
 logger = logging.getLogger("duke-tpu-native")
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -56,7 +58,7 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if os.environ.get("DUKE_TPU_NATIVE", "1") == "0":
+        if not env_flag("DUKE_TPU_NATIVE", True):
             return None
         if (not os.path.exists(_LIB)
                 or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):

@@ -21,13 +21,13 @@ the encoder is deterministic from the schema alone.
 
 from __future__ import annotations
 
-import os
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Sequence
 
 import ml_dtypes
 import numpy as np
 
 from ..core.records import Record
+from ..telemetry.env import env_flag, env_float, env_int
 from . import features as F
 
 # Pseudo-property under which the corpus embedding matrix rides inside the
@@ -196,7 +196,7 @@ def _fused_retrieval(q_emb, corpus_emb, corpus_valid, corpus_deleted,
 
     n, d = corpus_emb.shape
     q = q_emb.shape[0]
-    seg = int(os.environ.get("DEVICE_ANN_SEG", "64"))
+    seg = env_int("DEVICE_ANN_SEG", 64)
     if d % 128 != 0 or seg <= 0 or seg & (seg - 1) or n < 2 * seg:
         return None
     # corpus tile: sized so the (TC, QP) f32 score tile stays ~<=8 MB of
@@ -304,7 +304,7 @@ def retrieval_scan(q_emb, corpus_emb, corpus_valid, corpus_deleted,
     349-358 ``max_search_hits``): both trade bounded blocking recall for
     retrieval speed, and both rescore survivors exactly.
     """
-    wide = int(os.environ.get("DEVICE_ANN_RETRIEVAL_CHUNK", "65536"))
+    wide = env_int("DEVICE_ANN_RETRIEVAL_CHUNK", 65536)
     cap_total = corpus_valid.shape[0]
     while chunk < wide and chunk * 2 <= cap_total and cap_total % (chunk * 2) == 0:
         chunk *= 2
@@ -326,19 +326,14 @@ def retrieval_scan(q_emb, corpus_emb, corpus_valid, corpus_deleted,
     # exact full-sort merge when forced, or when the chunk is so narrow
     # (escalated C approaching chunk width) that the bin reduction cannot
     # shrink anything worth the second merge step
-    exact = (
-        os.environ.get("DEVICE_ANN_EXACT_TOPK", "0") == "1"
-        or top_c * 4 >= chunk
-    )
-    recall_target = float(
-        os.environ.get("DEVICE_ANN_RECALL_TARGET", "0.99")
-    )
+    exact = env_flag("DEVICE_ANN_EXACT_TOPK", False) or top_c * 4 >= chunk
+    recall_target = env_float("DEVICE_ANN_RECALL_TARGET", 0.99)
 
     from . import pallas_kernels as pk
 
     if (
         not exact
-        and os.environ.get("DEVICE_ANN_FUSED", "1") != "0"
+        and env_flag("DEVICE_ANN_FUSED", True)
         and pk.pallas_enabled()
     ):
         fused = _fused_retrieval(

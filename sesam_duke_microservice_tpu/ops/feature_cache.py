@@ -45,11 +45,12 @@ the restart warm path runs outside it, so the cache must not rely on it.
 from __future__ import annotations
 
 import collections
-import os
 import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from ..telemetry.env import env_int
 
 DEFAULT_MB = 256
 
@@ -67,18 +68,19 @@ class FeatureCache:
         self.budget_bytes = int(budget_bytes)
         self._lock = threading.Lock()
         self._rows: "collections.OrderedDict[tuple, Tuple[RowDict, int]]" = (
-            collections.OrderedDict()
+            collections.OrderedDict()  # guarded by: self._lock
         )
-        self.bytes = 0
+        self.bytes = 0  # guarded by: self._lock [writes]
         # monotonic, single-writer-per-increment under self._lock; scraped
         # lock-free by the /metrics process collector (torn reads of a
         # plain int are fine for visibility counters)
-        self.hits = 0
-        self.misses = 0
-        self.evicted = 0
+        self.hits = 0  # guarded by: self._lock [writes]
+        self.misses = 0  # guarded by: self._lock [writes]
+        self.evicted = 0  # guarded by: self._lock [writes]
 
     def __len__(self) -> int:
-        return len(self._rows)
+        with self._lock:
+            return len(self._rows)
 
     def get_many(self, fp, digests: Sequence[Optional[bytes]]
                  ) -> Dict[int, RowDict]:
@@ -134,8 +136,7 @@ _CACHE_LOCK = threading.Lock()
 
 
 def budget_mb() -> int:
-    raw = os.environ.get("DUKE_FEATURE_CACHE_MB", "").strip()
-    return int(raw) if raw else DEFAULT_MB
+    return env_int("DUKE_FEATURE_CACHE_MB", DEFAULT_MB)
 
 
 def active() -> Optional[FeatureCache]:

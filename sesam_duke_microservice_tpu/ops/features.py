@@ -33,11 +33,11 @@ from ..core.records import Record
 # MAX_CHARS defaults to 32 so edit distance rides the Myers bit-parallel
 # kernel (one uint32 word per pattern, ~100x the scan-DP throughput);
 # DEVICE_MAX_CHARS=64 restores 64-char fidelity via the general DP.
-import os as _os
+from ..telemetry.env import env_int
 
-MAX_CHARS = int(_os.environ.get("DEVICE_MAX_CHARS", "32"))
-MAX_GRAMS = int(_os.environ.get("DEVICE_MAX_GRAMS", "64"))
-MAX_TOKENS = int(_os.environ.get("DEVICE_MAX_TOKENS", "16"))
+MAX_CHARS = env_int("DEVICE_MAX_CHARS", 32)
+MAX_GRAMS = env_int("DEVICE_MAX_GRAMS", 64)
+MAX_TOKENS = env_int("DEVICE_MAX_TOKENS", 16)
 
 _FNV_OFFSET = 0xCBF29CE484222325
 _FNV_PRIME = 0x100000001B3

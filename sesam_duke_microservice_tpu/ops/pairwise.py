@@ -28,9 +28,6 @@ kernel has a differential test against them).  TPU-first design notes:
 
 from __future__ import annotations
 
-from functools import partial
-
-import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -169,7 +166,6 @@ def weighted_levenshtein_sim(
     cw2 = jnp.cumsum(w2, axis=1)
     zeros = jnp.zeros((p, 1), jnp.float32)
     prefix2 = jnp.concatenate([zeros, cw2], axis=1)  # (P, L+1) = row 0
-    big = jnp.float32(3.4e38)
 
     def step(carry, i):
         prev, row0_prev, result = carry
@@ -190,7 +186,6 @@ def weighted_levenshtein_sim(
         (prefix2, jnp.zeros((p,), jnp.float32), init_result),
         jnp.arange(l, dtype=jnp.int32),
     )
-    del big
     shorter = jnp.minimum(l1, l2).astype(jnp.float32)
     dist = jnp.minimum(result, shorter)
     sim = 1.0 - dist / jnp.maximum(shorter, 1.0)

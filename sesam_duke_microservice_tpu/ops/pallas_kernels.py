@@ -43,12 +43,13 @@ non-TPU backends kernels run in interpreter mode (slow, test-only).
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+
+from ..telemetry.env import env_flag
 
 try:  # pltpu is importable on all platforms; guard anyway
     from jax.experimental.pallas import tpu as pltpu
@@ -68,12 +69,7 @@ def _backend() -> str:
 
 def pallas_enabled() -> bool:
     """Should the scoring program route char kernels through Pallas?"""
-    flag = os.environ.get("DUKE_TPU_PALLAS", "").strip().lower()
-    if flag in ("1", "true", "yes", "on"):
-        return True
-    if flag in ("0", "false", "no", "off"):
-        return False
-    return _backend() == "tpu"
+    return env_flag("DUKE_TPU_PALLAS", _backend() == "tpu")
 
 
 def _interpret() -> bool:

@@ -38,6 +38,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..telemetry.env import env_int
+
 _POOL: Optional[ProcessPoolExecutor] = None
 _POOL_WORKERS = 0
 # guards pool creation/replacement AND serializes map calls: two
@@ -52,9 +54,7 @@ def workers() -> int:
     bench environment here — nproc=1) every process pool loses by
     construction, exactly what the r4 measurements observed, so the
     pipeline self-disables; multi-core deployments get cores/2."""
-    return int(os.environ.get(
-        "DEVICE_EXTRACT_WORKERS", str(min(8, (os.cpu_count() or 1) // 2))
-    ))
+    return env_int("DEVICE_EXTRACT_WORKERS", min(8, (os.cpu_count() or 1) // 2))
 
 
 def min_records() -> int:
@@ -62,7 +62,7 @@ def min_records() -> int:
     streaming-append slicer (engine.device_matcher) sizes its extract
     slices to at least this when the whole batch qualifies — slicing a
     bulk slab below it would silently forfeit the parallel path."""
-    return int(os.environ.get("DEVICE_EXTRACT_PARALLEL_MIN", "8192"))
+    return env_int("DEVICE_EXTRACT_PARALLEL_MIN", 8192)
 
 
 def enabled(n_records: int) -> bool:
@@ -104,7 +104,7 @@ def _shutdown() -> None:
 def _worker_init() -> None:
     # workers never touch an accelerator; belt-and-braces in case a
     # transitive import ever reaches jax in a future refactor
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")  # dukecheck: ignore[DK301] spawned-worker env WRITE, not a knob read
 
 
 def _worker_extract(task) -> None:

@@ -24,7 +24,7 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass
 from functools import partial
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict
 
 import jax
 import jax.numpy as jnp
@@ -267,18 +267,20 @@ def _property_sim(spec: F.PropertyFeatureSpec, qf: Dict, cf: Dict,
         # Pallas tiled path: (TQ, TC) similarity tiles computed in VMEM
         # from O(T*L) operands — no expanded (Q*C, L) pair arrays in HBM.
         if isinstance(cmp, C.JaroWinkler):
-            tile = lambda a, b, eq: pk.jaro_winkler_sim_tiles(
-                qf["chars"][:, a], qf["length"][:, a],
-                cf["chars"][:, b], cf["length"][:, b], eq,
-                prefix_scale=cmp.prefix_scale,
-                boost_threshold=cmp.boost_threshold,
-                max_prefix=int(cmp.max_prefix),
-            )
+            def tile(a, b, eq):
+                return pk.jaro_winkler_sim_tiles(
+                    qf["chars"][:, a], qf["length"][:, a],
+                    cf["chars"][:, b], cf["length"][:, b], eq,
+                    prefix_scale=cmp.prefix_scale,
+                    boost_threshold=cmp.boost_threshold,
+                    max_prefix=int(cmp.max_prefix),
+                )
         else:
-            tile = lambda a, b, eq: pk.levenshtein_sim_tiles(
-                qf["chars"][:, a], qf["length"][:, a],
-                cf["chars"][:, b], cf["length"][:, b], eq,
-            )
+            def tile(a, b, eq):
+                return pk.levenshtein_sim_tiles(
+                    qf["chars"][:, a], qf["length"][:, a],
+                    cf["chars"][:, b], cf["length"][:, b], eq,
+                )
         sim = _tiled_combo_sim(
             tile,
             qf["valid"].shape[0], cf["valid"].shape[0],

@@ -69,8 +69,10 @@ def main() -> None:
         print("SMOKE_FOLLOWER_OK", flush=True)
         return
 
-    os.environ["CONFIG_STRING"] = SMOKE_XML
-    os.environ.setdefault("MIN_RELEVANCE", "0.05")
+    # the smoke harness seeds its own process env before create_app —
+    # env WRITES for the child config, not knob reads
+    os.environ["CONFIG_STRING"] = SMOKE_XML  # dukecheck: ignore[DK301] smoke-harness env write
+    os.environ.setdefault("MIN_RELEVANCE", "0.05")  # dukecheck: ignore[DK301] smoke-harness env write
     from ..service.app import create_app
     from .dispatch import start_dispatcher
 

@@ -32,8 +32,9 @@ laptop, one TPU VM, or a multi-host slice job.
 from __future__ import annotations
 
 import logging
-import os
 from typing import Optional
+
+from ..telemetry.env import env_str
 
 logger = logging.getLogger("multihost")
 
@@ -59,7 +60,7 @@ def initialize(coordinator_address: Optional[str] = None,
     if _dist.global_state.client is not None:
         return jax.process_count() > 1
 
-    coordinator_address = coordinator_address or os.environ.get(
+    coordinator_address = coordinator_address or env_str(
         "JAX_COORDINATOR_ADDRESS"
     )
     if coordinator_address is None:
@@ -67,7 +68,7 @@ def initialize(coordinator_address: Optional[str] = None,
         # jax.distributed.initialize() auto-detects from the TPU/cluster
         # metadata.  Only attempt it when that metadata is plainly present,
         # so laptops/CI stay single-process without a failed probe.
-        if any(os.environ.get(v) for v in (
+        if any(env_str(v) for v in (
             "TPU_WORKER_HOSTNAMES", "TPU_WORKER_ID", "CLOUD_TPU_TASK_ID",
             "MEGASCALE_COORDINATOR_ADDRESS",
         )):
@@ -122,7 +123,7 @@ def initialize(coordinator_address: Optional[str] = None,
 
 
 def _int_env(name: str) -> Optional[int]:
-    raw = os.environ.get(name)
+    raw = env_str(name)
     return int(raw) if raw and raw.isdigit() else None
 
 

@@ -88,7 +88,9 @@ def build_ring_scorer(
         carry_index = jnp.full((qlocal, top_k), -1, jnp.int32)
         carry_count = jnp.zeros((qlocal,), jnp.int32)
 
-        rotate = lambda a: lax.ppermute(a, SHARD_AXIS, perm)
+        def rotate(a):
+            return lax.ppermute(a, SHARD_AXIS, perm)
+
         qf, qg, qr = qfeats, query_group, query_row
         tl, ti, cnt = carry_logit, carry_index, carry_count
         # D is small and static: unroll the ring so each step's ppermute

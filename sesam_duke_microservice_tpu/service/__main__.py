@@ -7,20 +7,20 @@ CONFIG_STRING or the bundled demo config and serves on port 4567 (PORT env /
 
 import argparse
 import logging
-import os
 
+from ..telemetry.env import env_int, env_str
 from .app import DEFAULT_PORT, create_app, serve
 
 
 def main() -> None:
     parser = argparse.ArgumentParser(description="TPU-native Duke record-matching microservice")
     parser.add_argument("--port", type=int,
-                        default=int(os.environ.get("PORT", DEFAULT_PORT)))
+                        default=env_int("PORT", DEFAULT_PORT))
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--backend",
                         choices=["host", "device", "ann", "sharded",
                                  "sharded-brute"],
-                        default=os.environ.get("DUKE_TPU_BACKEND", "host"))
+                        default=env_str("DUKE_TPU_BACKEND", "host"))
     parser.add_argument("--ephemeral", action="store_true",
                         help="keep all state in memory (no data folder writes)")
     args = parser.parse_args()

@@ -29,7 +29,6 @@ from __future__ import annotations
 
 import json
 import logging
-import os
 import re
 import threading
 import time
@@ -51,6 +50,7 @@ from ..engine.scheduler import (
 )
 from ..engine.workload import Workload, build_workload
 from ..telemetry import tracing
+from ..telemetry.env import env_str
 from ..telemetry.logctx import new_request_id, request_id_var
 from . import debug as debug_api
 from .homepage import render_homepage
@@ -80,7 +80,7 @@ DEFAULT_MAX_REQUEST_BYTES = 64 * 1024 * 1024
 
 
 def _max_request_bytes() -> int:
-    raw = os.environ.get("MAX_REQUEST_BYTES")
+    raw = env_str("MAX_REQUEST_BYTES")
     if not raw:
         return DEFAULT_MAX_REQUEST_BYTES
     try:
@@ -103,7 +103,7 @@ DEFAULT_FEED_PAGE_SIZE = 5000
 
 
 def _feed_page_size() -> int:
-    raw = os.environ.get("FEED_PAGE_SIZE")
+    raw = env_str("FEED_PAGE_SIZE")
     try:
         value = int(raw) if raw else DEFAULT_FEED_PAGE_SIZE
     except ValueError:

@@ -59,7 +59,6 @@ import itertools
 import json
 import logging
 import math
-import os
 import random
 import threading
 import time
@@ -67,7 +66,7 @@ from bisect import bisect_left
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..core.bayes import probability_logit
-from .env import env_float, env_int
+from .env import env_flag, env_float, env_int, env_str
 from .logctx import current_request_id
 from .registry import histogram_snapshot
 from .rings import LatchedRing
@@ -193,7 +192,7 @@ class DecisionRecorder:
                  enabled: Optional[bool] = None,
                  workload: str = "", kind: str = ""):
         if enabled is None:
-            enabled = os.environ.get("DUKE_DECISION_RECORD", "1") != "0"
+            enabled = env_flag("DUKE_DECISION_RECORD", True)
         self.enabled = enabled
         self.threshold = float(threshold)
         self.maybe = maybe
@@ -435,7 +434,7 @@ def audit_log() -> Optional[AuditLog]:
     env var is re-read so tests can point at a fresh temp file.
     """
     global _AUDIT, _AUDIT_PATH
-    path = os.environ.get("DUKE_AUDIT_LOG") or None
+    path = env_str("DUKE_AUDIT_LOG") or None
     with _AUDIT_LOCK:
         if path != _AUDIT_PATH:
             if _AUDIT is not None:

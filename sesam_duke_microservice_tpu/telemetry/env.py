@@ -9,8 +9,9 @@ convention every env knob in this codebase follows).
 from __future__ import annotations
 
 import os
+from typing import Optional
 
-__all__ = ["env_int", "env_float", "env_int_tuple"]
+__all__ = ["env_int", "env_float", "env_int_tuple", "env_str", "env_flag"]
 
 
 def env_int(name: str, default: int) -> int:
@@ -18,6 +19,31 @@ def env_int(name: str, default: int) -> int:
         return int(os.environ.get(name, default))
     except ValueError:
         return default
+
+
+def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Raw string knob.  ``default=None`` preserves set-vs-unset
+    distinctions (several knobs auto-tune only while unset)."""
+    return os.environ.get(name, default)
+
+
+_FLAG_OFF = ("0", "false", "no", "off")
+_FLAG_ON = ("1", "true", "yes", "on")
+
+
+def env_flag(name: str, default: bool) -> bool:
+    """Boolean knob.  ``0/false/no/off`` disable, ``1/true/yes/on``
+    enable, anything else (including unset) keeps the default — the
+    fail-to-default convention, applied to booleans."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    raw = raw.strip().lower()
+    if raw in _FLAG_OFF:
+        return False
+    if raw in _FLAG_ON:
+        return True
+    return default
 
 
 def env_int_tuple(name: str, default: str) -> tuple:

@@ -35,7 +35,7 @@ import math
 import re
 import threading
 from bisect import bisect_left
-from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, Iterable, List, Sequence, Tuple
 
 # ~log-scale (1 / 2.5 / 5 per decade) from 100 microseconds to 2 minutes:
 # wide enough for pair-scoring microbatches and for multi-second
@@ -205,7 +205,9 @@ class _Family:
         self.labelnames = tuple(labelnames)
         self._locked = locked
         self._child_kwargs = child_kwargs
-        self._children: Dict[Tuple[str, ...], _Child] = {}
+        # lock-free double-checked reads in labels(); inserts only under
+        # the family lock
+        self._children: Dict[Tuple[str, ...], _Child] = {}  # guarded by: self._family_lock [writes]
         self._family_lock = threading.Lock()
         if not self.labelnames:
             # label-less families expose one implicit child so the family
@@ -232,23 +234,25 @@ class _Family:
                     self._children[key] = child
         return child
 
-    # label-less convenience: family proxies its single child
-    def _single(self):
+    # label-less convenience: family proxies its single child.  Public:
+    # hot paths pre-resolve the child once at import (`FAMILY.single()`)
+    # so the per-event write is a bare child op — the DK501/DK502 pattern.
+    def single(self):
         if self.labelnames:
             raise ValueError(f"{self.name} requires labels()")
         return self._children[()]
 
     def inc(self, amount: float = 1.0) -> None:
-        self._single().inc(amount)
+        self.single().inc(amount)
 
     def set(self, value: float) -> None:
-        self._single().set(value)
+        self.single().set(value)
 
     def dec(self, amount: float = 1.0) -> None:
-        self._single().dec(amount)
+        self.single().dec(amount)
 
     def observe(self, value: float) -> None:
-        self._single().observe(value)
+        self.single().observe(value)
 
     def _label_pairs(self, key: Tuple[str, ...]) -> Tuple[Tuple[str, str], ...]:
         return tuple(zip(self.labelnames, key))
@@ -376,8 +380,8 @@ class MetricRegistry:
     """
 
     def __init__(self):
-        self._families: Dict[str, _Family] = {}
-        self._collectors: List[Callable[[], Iterable[FamilySnapshot]]] = []
+        self._families: Dict[str, _Family] = {}  # guarded by: self._lock [writes]
+        self._collectors: List[Callable[[], Iterable[FamilySnapshot]]] = []  # guarded by: self._lock [writes]
         self._lock = threading.Lock()
 
     def _family(self, cls, name: str, help: str, labelnames=(), **kwargs):

@@ -47,7 +47,6 @@ import contextlib
 import contextvars
 import itertools
 import json
-import os
 import random
 import re
 import threading
@@ -55,7 +54,7 @@ import time
 from collections import deque
 from typing import Any, Dict, Iterator, List, Optional
 
-from .env import env_int
+from .env import env_float, env_int
 from .logctx import current_request_id
 
 __all__ = [
@@ -91,28 +90,18 @@ _env_int = env_int
 
 def _sample_rate() -> float:
     """Head-sampling probability in [0, 1] (``TRACE_SAMPLE_RATE``)."""
-    try:
-        rate = float(os.environ.get("TRACE_SAMPLE_RATE", "0.01"))
-    except ValueError:
-        return 0.01
-    return min(1.0, max(0.0, rate))
+    return min(1.0, max(0.0, env_float("TRACE_SAMPLE_RATE", 0.01)))
 
 
 def _slow_ms() -> float:
     """Tail-latch threshold (``TRACE_SLOW_MS``); <= 0 disables the latch."""
-    try:
-        return float(os.environ.get("TRACE_SLOW_MS", "1000"))
-    except ValueError:
-        return 1000.0
+    return env_float("TRACE_SLOW_MS", 1000.0)
 
 
 def _max_spans() -> int:
     """Per-trace span cap (``TRACE_MAX_SPANS``) — a pathological request
     (per-link spans over a huge feed) must stay O(cap), not O(work)."""
-    try:
-        return max(1, int(os.environ.get("TRACE_MAX_SPANS", "512")))
-    except ValueError:
-        return 512
+    return max(1, env_int("TRACE_MAX_SPANS", 512))
 
 
 # id generation: uniqueness, not cryptographic strength — a per-process

@@ -17,6 +17,7 @@ import os
 from typing import Optional
 
 from ..telemetry import JIT_CACHE_HITS, JIT_COMPILES
+from ..telemetry.env import env_str
 
 logger = logging.getLogger("jit-cache")
 
@@ -46,7 +47,7 @@ def enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:
     """
     import jax
 
-    path = path or os.environ.get("JAX_COMPILATION_CACHE_DIR") or _DEFAULT
+    path = path or env_str("JAX_COMPILATION_CACHE_DIR") or _DEFAULT
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)

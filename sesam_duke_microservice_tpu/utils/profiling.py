@@ -26,13 +26,13 @@ from __future__ import annotations
 
 import contextlib
 import logging
-import os
 import tempfile
 import threading
 import time
 from typing import Any, Dict, Optional
 
 from ..telemetry import tracing as _tracing
+from ..telemetry.env import env_int, env_str
 
 logger = logging.getLogger("profiling")
 
@@ -41,14 +41,11 @@ _traced_batches = 0
 
 
 def trace_dir() -> str:
-    return os.environ.get("PROFILE_TRACE_DIR", "")
+    return env_str("PROFILE_TRACE_DIR", "")
 
 
 def _trace_budget() -> int:
-    try:
-        return int(os.environ.get("PROFILE_TRACE_BATCHES", "3"))
-    except ValueError:
-        return 3
+    return env_int("PROFILE_TRACE_BATCHES", 3)
 
 
 def reset_trace_budget() -> int:

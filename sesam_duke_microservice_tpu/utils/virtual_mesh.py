@@ -24,7 +24,7 @@ def virtual_mesh_env(n_devices: int, inner_flag: str) -> Dict[str, str]:
     ``inner_flag`` is the guard the child checks to know it has been
     re-exec'd (so it provisions instead of re-exec'ing again).
     """
-    env = dict(os.environ)
+    env = dict(os.environ)  # dukecheck: ignore[DK301] child-process env composition, not a knob read
     env[inner_flag] = "1"
     env["JAX_PLATFORMS"] = "cpu"
     flags = " ".join(

@@ -37,3 +37,40 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """DUKE_LOCKCHECK=1 leg: a recorded lock-order inversion fails the
+    whole session even if every individual test passed — the sanitizer
+    validates the committed static hierarchy, not any one test."""
+    from sesam_duke_microservice_tpu.utils import lockcheck
+
+    if not lockcheck.enabled():
+        return
+    found = lockcheck.inversions()
+    if found:
+        print("\nlockcheck: lock-order inversions recorded:")
+        for line in found:
+            print("  " + line)
+        session.exitstatus = 1
+    rep = lockcheck.report()
+    if rep["unknown_edges"]:
+        # analyzer drift: the runtime saw a nesting the static graph
+        # doesn't model — fail the leg so it gets triaged into
+        # MANUAL_EDGES (or the analysis fixed), keeping the committed
+        # hierarchy the single source of truth
+        print("\nlockcheck: %d observed edge(s) missing from the static "
+              "graph (triage scripts/dukecheck/config.py):"
+              % len(rep["unknown_edges"]))
+        for line in rep["unknown_edges"]:
+            print("  " + line)
+        session.exitstatus = 1
+    if rep["unmapped_lock_edges"]:
+        # a lock the hierarchy doc could not even name — naming drift in
+        # the static analyzer; advisory until someone extends lockorder's
+        # definition extraction for that creation pattern
+        print("\nlockcheck: %d observed edge(s) involve a lock with no "
+              "static identity (analyzer naming drift):"
+              % len(rep["unmapped_lock_edges"]))
+        for line in rep["unmapped_lock_edges"]:
+            print("  " + line)

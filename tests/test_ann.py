@@ -10,18 +10,10 @@ space); (c) mutation semantics (tombstones, deletes, groups) carry over
 from the shared DeviceCorpus machinery.
 """
 
-import random
 
 import numpy as np
-import pytest
-
-from sesam_duke_microservice_tpu.core import comparators as C
-from sesam_duke_microservice_tpu.core.config import DukeSchema, MatchTunables
-from sesam_duke_microservice_tpu.core.records import (
-    ID_PROPERTY_NAME,
-    Property,
-    Record,
-)
+from sesam_duke_microservice_tpu.core.config import MatchTunables
+from sesam_duke_microservice_tpu.core.records import ID_PROPERTY_NAME
 from sesam_duke_microservice_tpu.engine.ann_matcher import (
     AnnIndex,
     AnnProcessor,

@@ -19,7 +19,6 @@ from sesam_duke_microservice_tpu.core.records import (
     DELETED_PROPERTY_NAME,
     GROUP_NO_PROPERTY_NAME,
     ID_PROPERTY_NAME,
-    Lookup,
     Property,
     Record,
 )

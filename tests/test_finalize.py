@@ -527,6 +527,6 @@ def test_dispatch_followers_gauge_zeroed_on_mark_failed():
     d = Dispatcher.__new__(Dispatcher)
     d._failed = None
     d.mark_failed("test: follower lost")
-    assert telemetry.DISPATCH_FOLLOWERS._single().value == 0
-    assert telemetry.DISPATCH_DOWN._single().value == 1
+    assert telemetry.DISPATCH_FOLLOWERS.single().value == 0
+    assert telemetry.DISPATCH_DOWN.single().value == 1
     telemetry.DISPATCH_DOWN.set(0)

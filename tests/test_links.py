@@ -1,8 +1,6 @@
 """Tests for link databases (memory + sqlite): idempotent assert, since feed,
 retraction."""
 
-import time
-
 import pytest
 
 from sesam_duke_microservice_tpu.links import (

@@ -212,8 +212,9 @@ def test_scoring_program_with_pallas_enabled(monkeypatch):
         r.add_value("NAME", nm)
         records.append(r)
     feats = F.extract_batch(plan, records)
-    to_dev = lambda t: {p: {k: jnp.asarray(a) for k, a in d.items()}
-                        for p, d in t.items()}
+    def to_dev(t):
+        return {p: {k: jnp.asarray(a) for k, a in d.items()}
+                for p, d in t.items()}
     dev = to_dev(feats)
     n = len(records)
     valid = jnp.ones((n,), bool)
@@ -429,8 +430,9 @@ def test_scoring_program_set_kernels_pallas_wiring(monkeypatch):
         r.add_value("TAGS", tags)
         records.append(r)
     feats = F.extract_batch(plan, records)
-    to_dev = lambda t: {p: {k: jnp.asarray(a) for k, a in d.items()}
-                        for p, d in t.items()}
+    def to_dev(t):
+        return {p: {k: jnp.asarray(a) for k, a in d.items()}
+                for p, d in t.items()}
     dev = to_dev(feats)
     n = len(records)
     valid = jnp.ones((n,), bool)
@@ -534,8 +536,9 @@ def test_scoring_program_jw_pallas_wiring(monkeypatch):
         r.add_value("CAPITAL", nm)
         records.append(r)
     feats = F.extract_batch(plan, records)
-    to_dev = lambda t: {p: {k: jnp.asarray(a) for k, a in d.items()}
-                        for p, d in t.items()}
+    def to_dev(t):
+        return {p: {k: jnp.asarray(a) for k, a in d.items()}
+                for p, d in t.items()}
     dev = to_dev(feats)
     n = len(records)
     valid = jnp.ones((n,), bool)

@@ -11,9 +11,6 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from sesam_duke_microservice_tpu.core import comparators as C
-from sesam_duke_microservice_tpu.core.config import DukeSchema
-from sesam_duke_microservice_tpu.core.records import ID_PROPERTY_NAME, Property
 from sesam_duke_microservice_tpu.ops import features as F
 from sesam_duke_microservice_tpu.ops import scoring as S
 from sesam_duke_microservice_tpu.parallel import (

@@ -11,7 +11,6 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from sesam_duke_microservice_tpu.ops import features as F
 from sesam_duke_microservice_tpu.ops import scoring as S
 from sesam_duke_microservice_tpu.parallel import (
     RingQueryPlacer,

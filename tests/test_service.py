@@ -220,7 +220,6 @@ def test_feed_503_when_write_locked(server_url):
     import sesam_duke_microservice_tpu.service.app as app_module
 
     # grab the workload lock as a writer would, then poll the feed
-    handler_app = None
     # find the app via a request for config? Instead reach through the server fixture:
     # the fixture's app object is bound to the handler class of this server.
     # Simpler: create a fresh app+server for this test.

@@ -11,7 +11,6 @@ import urllib.request
 
 import pytest
 
-from sesam_duke_microservice_tpu import telemetry
 from sesam_duke_microservice_tpu.core.config import parse_config
 from sesam_duke_microservice_tpu.telemetry.registry import (
     MetricRegistry,
@@ -47,7 +46,7 @@ def test_counter_basics():
     c = reg.counter("t_total", "help")
     c.inc()
     c.inc(2.5)
-    assert c._single().value == 3.5
+    assert c.single().value == 3.5
     with pytest.raises(ValueError):
         c.inc(-1)
 
@@ -94,7 +93,7 @@ def test_gauge_set_inc_dec():
     g.set(5)
     g.inc()
     g.dec(2)
-    assert g._single().value == 4
+    assert g.single().value == 4
 
 
 def test_histogram_bucketing_le_inclusive():
@@ -102,7 +101,7 @@ def test_histogram_bucketing_le_inclusive():
     h = reg.histogram("h_seconds", "help", buckets=(0.1, 1.0, 10.0))
     for v in (0.05, 0.1, 0.5, 1.0, 5.0, 100.0):
         h.observe(v)
-    cumulative, total, count = h._single().snapshot()
+    cumulative, total, count = h.single().snapshot()
     # le semantics: 0.1 bucket includes the exact 0.1 observation
     assert cumulative == [2, 4, 5, 6]
     assert count == 6
