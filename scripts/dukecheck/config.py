@@ -97,6 +97,28 @@ MANUAL_EDGES = (
      "links during scoring"),
     ("Processor._listener_lock", "links.base._millis_lock",
      "now_millis() from the listener's link-commit path"),
+    # -- HA serving group (ISSUE 8) --
+    ("Dispatcher.op_lock", "Dispatcher._send_lock",
+     "broadcast() serializes per-follower sends under the global mesh "
+     "op lock (every broadcast+execute section holds op_lock)"),
+    ("Dispatcher._send_lock", "_Child._lock",
+     "eviction counters (duke_follower_evictions_total, follower gauge) "
+     "written inside the broadcast send section"),
+    ("Dispatcher._send_lock", "_Family._family_lock",
+     "first-time .single()/.labels() child resolution from the eviction "
+     "path under the send lock"),
+    ("Dispatcher.op_lock", "_Family._family_lock",
+     "first-per-tag dispatch op-child resolution during a broadcast "
+     "under the mesh op lock"),
+    ("Dispatcher.op_lock", "ReplicaLinkDatabase.lock",
+     "promoted-leader ingest: listener link writes land in the replica "
+     "link DB inside the broadcast+execute section"),
+    ("Dispatcher.op_lock", "native._lock",
+     "lazy native-comparator load during a promoted-leader scoring "
+     "pass under the mesh op lock"),
+    ("Dispatcher.op_lock", "telemetry.decisions._AUDIT_LOCK",
+     "audit_log() singleton resolution during a promoted-leader "
+     "listener flush under the mesh op lock"),
 )
 
 # -- checker 5 (single-writer metrics) ---------------------------------------

@@ -30,15 +30,11 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 from ..core.config import ServiceConfig, WorkloadConfig
-from ..core.records import (
-    DATASET_ID_PROPERTY_NAME,
-    ORIGINAL_ENTITY_ID_PROPERTY_NAME,
-    Record,
-)
+from ..core.records import Record
 from ..index.base import CandidateIndex
 from ..index.inverted import InvertedIndex
 from ..links import create_link_database
-from ..links.base import LinkDatabase, LinkStatus
+from ..links.base import LinkDatabase
 from ..service.datasource import IncrementalDataSource
 from ..store.records import RecordStore
 from .listeners import ServiceMatchListener
@@ -105,6 +101,15 @@ class Workload:
             ds.dataset_id: IncrementalDataSource(ds)
             for ds in config.duke.data_sources
         }
+
+    def replace_link_database(self, link_database: LinkDatabase) -> None:
+        """Swap the link database wrapper in place — the dispatcher
+        installs the HA link-stream publisher this way (ISSUE 8).  Call
+        before serving starts or with ``self.lock`` held: the write path
+        (listener chain), the read path (feeds), and the delete path all
+        resolve through the new wrapper from then on."""
+        self.link_database = link_database
+        self.listener._wrapped.linkdb = link_database
 
     # -- lock-hold observations ---------------------------------------------
 
@@ -380,19 +385,13 @@ class Workload:
     # -- incremental feed (call with self.lock held) ------------------------
 
     def _link_row(self, link) -> dict:
-        """One feed row (wire format per App.java:744-770)."""
-        r1 = self.index.find_record_by_id(link.id1)
-        r2 = self.index.find_record_by_id(link.id2)
-        return {
-            "_id": f"{link.id1}_{link.id2}".replace(":", "_"),
-            "_updated": link.timestamp,
-            "_deleted": link.status == LinkStatus.RETRACTED,
-            "entity1": r1.get_value(ORIGINAL_ENTITY_ID_PROPERTY_NAME) if r1 else None,
-            "entity2": r2.get_value(ORIGINAL_ENTITY_ID_PROPERTY_NAME) if r2 else None,
-            "dataset1": r1.get_value(DATASET_ID_PROPERTY_NAME) if r1 else None,
-            "dataset2": r2.get_value(DATASET_ID_PROPERTY_NAME) if r2 else None,
-            "confidence": link.confidence,
-        }
+        """One feed row (wire format per App.java:744-770) — THE shared
+        materialization (``links.replica.feed_row``): the follower read
+        plane resolves through the same function, so leader and replica
+        feeds cannot drift by construction (ISSUE 8)."""
+        from ..links.replica import feed_row
+
+        return feed_row(link, self.index.find_record_by_id)
 
     def links_since(self, since: int = 0) -> List[dict]:
         """Full materialized feed (the HTTP layer streams via links_page;
@@ -417,19 +416,9 @@ class Workload:
         App.java:827-874).  ``next_cursor`` is the last row's timestamp
         (strictly-greater-than feed semantics); an empty ``rows`` means the
         feed is drained."""
-        links = self.link_database.get_changes_page(since, limit)
-        if not links:
-            return [], since
-        # lazy record mirrors resolve link endpoints from the store; warm
-        # the page's working set in one batched query instead of 2 x page
-        # point lookups under the lock
-        prefetch = getattr(
-            getattr(self.index, "records", None), "prefetch", None
-        )
-        if prefetch is not None:
-            ids = {l.id1 for l in links} | {l.id2 for l in links}
-            prefetch(ids)
-        return [self._link_row(l) for l in links], links[-1].timestamp
+        from ..links.replica import links_feed_page
+
+        return links_feed_page(self.link_database, self.index, since, limit)
 
     def save_corpus_snapshot(self) -> None:
         """Persist the device-corpus snapshot (no-op for host backends).
@@ -623,3 +612,42 @@ def build_workload(wc: WorkloadConfig, sc: ServiceConfig, *,
                     pass
         raise
     return Workload(wc, index, processor, listener, link_database, record_store)
+
+
+def adopt_workload(wc: WorkloadConfig, sc: ServiceConfig, *, backend: str,
+                   index: CandidateIndex, link_database: LinkDatabase,
+                   record_store: Optional[RecordStore] = None) -> Workload:
+    """Build a SERVING workload around an already-populated index + link
+    database — the leader-failover promotion path (ISSUE 8).
+
+    A promoted follower's replica corpus (bootstrap snapshot + replayed
+    commits) and replica link DB (published op stream at the applied
+    watermark) are already bit-identical to the deposed leader's, so only
+    the write-plane objects are new: a full processor (host finalization
+    ON — the follower replica ran with it off) and a match listener whose
+    events land in the replica link DB from now on.
+    """
+    group_filtering = wc.is_record_linkage
+    if backend == "device":
+        from .device_matcher import DeviceProcessor as _P
+    elif backend == "ann":
+        from .ann_matcher import AnnProcessor as _P
+    elif backend == "sharded":
+        from .sharded_matcher import ShardedAnnProcessor as _P
+    elif backend == "sharded-brute":
+        from .sharded_matcher import ShardedDeviceProcessor as _P
+    else:
+        raise RuntimeError(
+            f"promotion needs a device-family backend (got {backend!r})"
+        )
+    processor = _P(wc.duke, index, group_filtering=group_filtering,
+                   profile=sc.profile, threads=sc.threads)
+    one_to_one = (wc.enforce_one_to_one if sc.one_to_one is None
+                  else sc.one_to_one and wc.is_record_linkage)
+    listener = ServiceMatchListener(
+        wc.name, link_database, kind=wc.kind, one_to_one=one_to_one,
+        record_resolver=index.find_record_by_id,
+    )
+    processor.add_match_listener(listener)
+    return Workload(wc, index, processor, listener, link_database,
+                    record_store)

@@ -1,6 +1,7 @@
 from ..telemetry.env import env_flag
 from .base import Link, LinkStatus, LinkKind, LinkDatabase
 from .memory import InMemoryLinkDatabase
+from .replica import PublishingLinkDatabase, ReplicaLinkDatabase
 from .sqlite import SqliteLinkDatabase
 from .write_behind import WriteBehindLinkDatabase
 
@@ -10,6 +11,8 @@ __all__ = [
     "LinkKind",
     "LinkDatabase",
     "InMemoryLinkDatabase",
+    "PublishingLinkDatabase",
+    "ReplicaLinkDatabase",
     "SqliteLinkDatabase",
     "WriteBehindLinkDatabase",
 ]

@@ -167,6 +167,15 @@ class LinkDatabase:
         write-behind wrapper overrides; callers needing the barrier
         (snapshot save, benchmarks) call it unconditionally."""
 
+    @property
+    def flush_error(self):
+        """The latched background-flush failure, or None.  Synchronous
+        backends can never latch; the write-behind wrapper overrides.
+        Surfaced by ``/readyz`` (unready) and ``/healthz`` so a dead
+        persistence thread is visible to orchestrators before a read
+        drains into it (ISSUE 8 satellite)."""
+        return None
+
     def close(self) -> None:
         pass
 

@@ -37,6 +37,7 @@ import threading
 from collections import deque
 from typing import Callable, List, Optional, Sequence
 
+from ..utils import faults
 from .base import Link, LinkDatabase
 
 logger = logging.getLogger("links-write-behind")
@@ -196,8 +197,20 @@ class WriteBehindLinkDatabase(LinkDatabase):
         )
 
     def _flush_batch(self, batch: List[Link]) -> None:
+        plan = faults.active()
+        if plan is not None:
+            # chaos hook (DUKE_FAULTS flush_fail): a raised injection
+            # latches the buffer exactly like a real disk failure
+            plan.check_flush("link write-behind")
         self.inner.assert_links(batch)
         self.inner.commit()
+
+    @property
+    def flush_error(self) -> Optional[BaseException]:
+        """The latched background-flush failure, or None (read lock-free
+        by health probes: a dead persistence thread must be visible to
+        orchestrators without waiting for a read to drain into it)."""
+        return self._wb.error
 
     # test/introspection compatibility: the sealed-batch queue lives in
     # the shared buffer now

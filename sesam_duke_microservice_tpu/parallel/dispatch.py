@@ -65,13 +65,28 @@ from typing import Dict, List, Optional, Tuple
 
 from .. import telemetry
 from ..telemetry.env import env_flag, env_float, env_int, env_str
-from ..utils import lockcheck
+from ..utils import faults, lockcheck
 
 logger = logging.getLogger("dispatch")
 
 # rendezvous key in the jax.distributed coordination service KV store
 _KV_ADDR_KEY = "sesam_duke/dispatch/addr"
 _CONNECT_TIMEOUT_S = env_float("DUKE_DISPATCH_TIMEOUT", 600.0)
+
+# Per-follower send discipline (ISSUE 8): every sendall is bounded by a
+# timeout (a dead follower mid-bootstrap used to park the leader on a
+# full send buffer forever), and transient failures retry with
+# exponential backoff + jitter before the follower is EVICTED — the
+# group degrades to the survivors instead of latching the whole slice.
+_SEND_TIMEOUT_S = env_float("DUKE_DISPATCH_SEND_TIMEOUT", 120.0)
+_SEND_RETRIES = env_int("DUKE_DISPATCH_SEND_RETRIES", 4)
+_RETRY_BASE_S = env_float("DUKE_DISPATCH_RETRY_BASE_MS", 50.0) / 1000.0
+
+
+def _backoff_delay(attempt: int) -> float:
+    from ..utils.backoff import full_jitter_delay
+
+    return full_jitter_delay(attempt, _RETRY_BASE_S, 2.0)
 
 # Cached registry children (dukecheck DK501/DK502): op tags are a small
 # closed set, so each child resolves through the family lock at most once
@@ -141,7 +156,14 @@ def latch_on_failure(d: Optional["Dispatcher"], reason_prefix: str):
 # authentication (advisor r4).  Hashing the token keeps the frame
 # fixed-length for any operator-chosen DUKE_DISPATCH_TOKEN.
 _HELLO_MAGIC = b"SDMT1"
-_HELLO_LEN = len(_HELLO_MAGIC) + 64  # magic + sha256 hexdigest (ascii)
+# magic + sha256 hexdigest (ascii) + 8-byte big-endian follower index.
+# The index rides the AUTHENTICATED frame so the leader's per-follower
+# identity (fault-spec coordinates, eviction logs) is the follower's
+# stable process index, not TCP accept order — accept order varies
+# run-to-run with >1 follower, which would break DUKE_FAULTS site
+# determinism (`partition=1:...` must mean process 2 in every run).
+_HELLO_TOKEN_LEN = len(_HELLO_MAGIC) + 64
+_HELLO_LEN = _HELLO_TOKEN_LEN + 8
 
 # Commit digest handshake: after replaying each ("commit", ...) op the
 # follower answers with ONE raw frame — magic + ok byte + its 32-byte
@@ -183,10 +205,12 @@ def _verify_enabled() -> bool:
     return env_flag("DUKE_DISPATCH_VERIFY", True)
 
 
-def _hello_frame(token: str) -> bytes:
+def _hello_frame(token: str, idx: int = 0) -> bytes:
     import hashlib
 
-    return _HELLO_MAGIC + hashlib.sha256(token.encode()).hexdigest().encode()
+    return (_HELLO_MAGIC
+            + hashlib.sha256(token.encode()).hexdigest().encode()
+            + struct.pack(">Q", idx))
 
 
 def with_trace_ctx(op: tuple) -> tuple:
@@ -214,9 +238,15 @@ def _join_token() -> Optional[str]:
     return env_str("DUKE_DISPATCH_TOKEN") or None
 
 
-def _send_msg(sock: socket.socket, obj) -> None:
-    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(struct.pack(">Q", len(data)) + data)
+# Op frame header: payload length, leadership epoch, per-follower frame
+# sequence number.  The epoch fences zombie ex-leaders (a follower
+# rejects ops from an epoch lower than the one it has adopted); the
+# sequence number makes the stream idempotent under duplicate delivery
+# (the retry/fault layer may send a frame twice — the follower drops
+# seq <= last) and LOUD under loss (a gap means this follower missed an
+# op the leader believes delivered; its replica must resync, so the
+# loop raises instead of serving a hole).
+_HDR = struct.Struct(">QIQ")
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -229,9 +259,16 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+def _recv_op(sock: socket.socket):
+    """One framed op off the dispatch stream: (op, epoch, frame_seq)."""
+    n, epoch, seq = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    return pickle.loads(_recv_exact(sock, n)), epoch, seq
+
+
 def _recv_msg(sock: socket.socket):
-    (n,) = struct.unpack(">Q", _recv_exact(sock, 8))
-    return pickle.loads(_recv_exact(sock, n))
+    """The next op alone — for test/bench followers that don't exercise
+    the epoch/seq fencing."""
+    return _recv_op(sock)[0]
 
 
 def _kv_client():
@@ -293,24 +330,61 @@ def _env_fingerprint() -> dict:
 # -- frontend ----------------------------------------------------------------
 
 
+class _Follower:
+    """Per-follower health + stream state (ISSUE 8): one entry per
+    accepted connection.  ``alive`` flips false on eviction; ``seq`` is
+    the per-follower frame sequence number (frames successfully sent)."""
+
+    __slots__ = ("idx", "conn", "peer", "alive", "seq")
+
+    def __init__(self, idx: int, conn: socket.socket, peer="?"):
+        self.idx = idx
+        self.conn = conn
+        self.peer = peer
+        self.alive = True
+        self.seq = 0
+
+
 class Dispatcher:
     """Frontend-side op broadcaster (process 0 of a multi-host job)."""
 
-    def __init__(self, app):
+    def __init__(self, app, epoch: int = 1):
         self.app = app
+        # leadership epoch, stamped into every frame header: followers
+        # reject ops from a lower epoch, so a zombie ex-leader's stale
+        # broadcasts can never corrupt a promoted group (ISSUE 8)
+        self.epoch = epoch
         # serializes every broadcast+execute section across workloads so
         # all processes enqueue device programs in one global order
         self.op_lock = threading.RLock()
         self._send_lock = threading.Lock()
-        self._conns: List[socket.socket] = []
+        # single-writer: the accept loop (startup, pre-broadcast) appends;
+        # broadcast-time iteration snapshots under self._send_lock and
+        # eviction only flips per-entry alive flags
+        self._followers: List[_Follower] = []
+        self._op_index = 0  # broadcast ordinal (fault-plan coordinates)
         self._server: Optional[socket.socket] = None
         self._closed = False
-        # latched on the first broadcast failure: once any follower
-        # missed an op, its mirror is behind forever (ops are not
-        # replayable), so every further mesh op must refuse loudly —
-        # serving partial-mesh results or deadlocking a collective would
-        # both be silent corruption.  Recovery = restart the job.
+        # latched only on a FRONTEND-side desync: an op was broadcast
+        # but the frontend failed to execute it locally, so followers
+        # are ahead on a stream that is not replayable
+        # (latch_on_failure).  Per-FOLLOWER failures no longer latch —
+        # they evict that follower and the group degrades to the
+        # survivors (_evict).  Recovery from the latch = restart.
         self._failed: Optional[str] = None
+
+    @property
+    def _conns(self) -> List[socket.socket]:
+        """Live follower connections (kept as the historical name — a
+        swath of tests wires loopback followers through it)."""
+        return [f.conn for f in self._followers if f.alive]
+
+    @_conns.setter
+    def _conns(self, conns: List[socket.socket]) -> None:
+        self._followers = [_Follower(i, c) for i, c in enumerate(conns)]
+
+    def live_followers(self) -> List[_Follower]:
+        return [f for f in self._followers if f.alive]
 
     # - lifecycle -
 
@@ -354,6 +428,7 @@ class Dispatcher:
         self._accept_followers(n_followers, token)
         self._tag_workloads(self.app.deduplications, self.app.record_linkages)
         self._bootstrap_followers()
+        telemetry.DISPATCH_EPOCH.set(self.epoch)  # dukecheck: ignore[DK502] once: dispatcher start
         global _DISPATCHER
         _DISPATCHER = self
 
@@ -366,14 +441,15 @@ class Dispatcher:
         bytes is arbitrary code execution, advisor r4 high)."""
         import hmac
 
-        expected_hello = _hello_frame(token)
+        expected_token = _hello_frame(token)[:_HELLO_TOKEN_LEN]
         self._server.settimeout(_CONNECT_TIMEOUT_S)
-        while len(self._conns) < n_followers:
+        while len(self._followers) < n_followers:
             conn, peer = self._server.accept()
             try:
                 conn.settimeout(30.0)
                 hello = _recv_exact(conn, _HELLO_LEN)
-                if not hmac.compare_digest(hello, expected_hello):
+                if not hmac.compare_digest(hello[:_HELLO_TOKEN_LEN],
+                                           expected_token):
                     raise ValueError("bad join token")
                 conn.settimeout(None)
             except Exception as e:
@@ -383,8 +459,17 @@ class Dispatcher:
                 conn.close()
                 continue
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._conns.append(conn)
-            telemetry.DISPATCH_FOLLOWERS.set(len(self._conns))  # dukecheck: ignore[DK502] rare event: follower join
+            # the AUTHENTICATED frame's trailing index is the follower's
+            # stable identity (process index - 1), independent of accept
+            # order — fault-spec coordinates and eviction logs use it
+            (idx,) = struct.unpack(">Q", hello[_HELLO_TOKEN_LEN:])
+            if any(f.idx == idx for f in self._followers):
+                logger.warning(
+                    "dispatch: duplicate follower index %d from %s "
+                    "(misconfigured JAX_PROCESS_ID?)", idx, peer,
+                )
+            self._followers.append(_Follower(idx, conn, peer))
+            telemetry.DISPATCH_FOLLOWERS.set(len(self._followers))  # dukecheck: ignore[DK502] rare event: follower join
             logger.info("dispatch: follower connected from %s", peer)
 
     def _bootstrap_followers(self) -> None:
@@ -420,19 +505,27 @@ class Dispatcher:
     # - ops -
 
     def broadcast(self, op: tuple) -> None:
-        """Send one op to every follower (in one global order).
+        """Send one op to every LIVE follower (in one global order).
 
-        A send failure latches the dispatcher: the dead follower's mirror
-        is now permanently behind, so every subsequent op raises instead
-        of diverging the mesh (the standard JAX multi-controller stance —
-        a lost process ends the job)."""
+        Per-follower health (ISSUE 8): a send failure no longer latches
+        the dispatcher.  Transient failures retry with exponential
+        backoff + jitter; a follower that stays unreachable is EVICTED
+        (``duke_follower_evictions_total``) and the group degrades to
+        the survivors.  Only a frontend-side desync (``mark_failed`` via
+        ``latch_on_failure``) still halts every mesh op."""
         if self._failed is not None:
             raise RuntimeError(
-                "multi-host dispatch is down (a follower lost an op: "
-                f"{self._failed}); restart the job to recover"
+                "multi-host dispatch is down (frontend desynced from its "
+                f"own op stream: {self._failed}); restart the job to "
+                "recover"
             )
         data = pickle.dumps(op, protocol=pickle.HIGHEST_PROTOCOL)
-        frame = struct.pack(">Q", len(data)) + data
+        tag = str(op[0])
+        self._op_index += 1
+        plan = faults.active()
+        if plan is not None:
+            plan.check_leader_crash(self._op_index)
+        live = self.live_followers()
         # Dispatch observability (ISSUE 1 item 4), with two deliberate
         # substitutions: (a) there is no "dispatch queue depth" series
         # because broadcast is a synchronous sendall under op_lock — no
@@ -442,43 +535,146 @@ class Dispatcher:
         # per shard (forbidden on the scoring path); the per-HOST proxy
         # is duke_follower_replay_seconds{op="score"} vs the frontend's
         # duke_engine_phase_seconds{phase="retrieve"}.
-        _op_child(str(op[0])).inc()
-        _BYTES_CHILD.inc(len(frame) * len(self._conns))
+        _op_child(tag).inc()
+        _BYTES_CHILD.inc((_HDR.size + len(data)) * len(live))
         # lockcheck visibility: which locks are held across this blocking
         # network broadcast (the mesh op lock is expected; anything else
         # in the DUKE_LOCKCHECK=1 report deserves a look)
         lockcheck.note_blocking("dispatch.broadcast")
         with self._send_lock:
-            for conn in self._conns:
+            for f in live:
+                self._send_frame(f, tag, data, plan)
+
+    @staticmethod
+    def _send_tracked(conn: socket.socket, frame: bytes) -> None:
+        """``sendall`` with a byte cursor: an ``OSError`` is re-raised
+        carrying how much of the frame hit the wire (``e.frame_sent``),
+        so the caller can tell a retry-safe failure (0 bytes — the
+        stream is still frame-aligned) from a torn frame."""
+        sent = 0
+        try:
+            while sent < len(frame):
+                sent += conn.send(frame[sent:])
+        except OSError as e:
+            e.frame_sent = sent
+            raise
+
+    def _send_frame(self, f: _Follower, tag: str, data: bytes,
+                    plan) -> bool:
+        """One framed send to one follower, with bounded retry +
+        exponential backoff + jitter before eviction.
+
+        Only failures with ZERO bytes of the frame on the wire are
+        retried — injected pre-send faults, and real socket errors whose
+        first ``send`` wrote nothing (connection reset noticed at write
+        time), where the stream is still frame-aligned.  After a partial
+        write the stream position is torn, so the only safe recovery is
+        eviction.  The frame seq advances per successful send; a
+        fault-injected dup re-sends the SAME seq, which the follower
+        drops."""
+        err: Optional[BaseException] = None
+        attempts = 0
+        while True:
+            header = _HDR.pack(len(data), self.epoch, f.seq + 1)
+            try:
+                if plan is not None:
+                    plan.before_send(tag, f.idx, self._op_index, attempts)
+                f.conn.settimeout(_SEND_TIMEOUT_S)
                 try:
-                    conn.sendall(frame)
-                except OSError as e:
-                    self._failed = repr(e)
-                    telemetry.DISPATCH_DOWN.set(1)  # dukecheck: ignore[DK502] failure latch, fires at most once
-                    # the mesh is down, not just degraded: zero the
-                    # follower gauge so dashboards watching it see the
-                    # outage without also graphing duke_dispatch_down
-                    telemetry.DISPATCH_FOLLOWERS.set(0)  # dukecheck: ignore[DK502] failure latch, fires at most once
-                    logger.error(
-                        "dispatch: broadcast to a follower failed (%s); "
-                        "halting mesh ops — restart the job", e,
-                    )
-                    raise RuntimeError(
-                        f"multi-host dispatch broadcast failed: {e}"
-                    ) from e
+                    self._send_tracked(f.conn, header + data)
+                    f.seq += 1
+                    if plan is not None and plan.dup_send(
+                            tag, f.idx, self._op_index):
+                        # chaos dup rides the SAME seq; it must never
+                        # re-enter the retry loop (the primary send
+                        # already advanced f.seq, so a "retry" would
+                        # mint a fresh seq for duplicate payload)
+                        try:
+                            self._send_tracked(f.conn, header + data)
+                        except OSError as e:
+                            if getattr(e, "frame_sent", 0):
+                                self._evict(f, f"dup send tore: {e!r}")
+                                return False
+                            # zero bytes: the optional dup just didn't
+                            # happen; the stream is intact
+                finally:
+                    try:
+                        f.conn.settimeout(None)
+                    except OSError:
+                        pass
+                return True
+            except faults.InjectedSendFailure as e:
+                err = e
+            except OSError as e:
+                if getattr(e, "frame_sent", 0) or isinstance(
+                        e, socket.timeout):
+                    # bytes of a torn frame are on the wire (or a
+                    # 120 s-stalled peer — retrying a full send buffer
+                    # just stalls again): the stream cannot recover
+                    self._evict(f, f"send failed: {e!r}")
+                    return False
+                err = e  # zero bytes sent: frame-aligned, retry safe
+            attempts += 1
+            if attempts > _SEND_RETRIES:
+                self._evict(
+                    f, f"{attempts} send attempts failed: {err!r}"
+                )
+                return False
+            time.sleep(_backoff_delay(attempts))
+
+    def _evict(self, f: _Follower, reason: str) -> None:
+        """Remove one follower from the serving group (idempotent): its
+        stream is torn or it stopped answering, so it can never catch up
+        on the non-replayable op stream — but the SURVIVORS can keep
+        serving, so the dispatcher stays up (``duke_dispatch_down``
+        stays 0) and only the eviction counter moves."""
+        if not f.alive:
+            return
+        f.alive = False
+        try:
+            f.conn.close()
+        except OSError:
+            pass
+        telemetry.FOLLOWER_EVICTIONS.inc()  # dukecheck: ignore[DK502] rare event: follower eviction
+        survivors = len(self.live_followers())
+        telemetry.DISPATCH_FOLLOWERS.set(survivors)  # dukecheck: ignore[DK502] rare event: follower eviction
+        logger.error(
+            "dispatch: evicted follower %d at %s (%s); serving degrades "
+            "to %d survivor(s)%s",
+            f.idx, f.peer, reason, survivors,
+            "" if survivors else
+            " — single-process serving until the job re-forms",
+        )
+        backend = getattr(self.app, "backend", None)
+        if backend in ("sharded", "sharded-brute"):
+            # the eviction keeps the op stream and replica read tier
+            # alive, but THIS mesh's jitted collectives still span the
+            # evicted host's devices: entering the next cross-host
+            # scoring program would hang forever inside the collective
+            # (holding the workload + op locks), not fail.  Latch mesh
+            # ops loudly instead — a RuntimeError per request beats an
+            # unbounded wedge; restart the job to re-form the mesh.
+            self.mark_failed(
+                f"follower {f.idx} evicted from a {backend} mesh "
+                f"({reason}); cross-host collectives cannot run without "
+                "it"
+            )
 
     def verify_mirror_digest(self, key, digest: bytes) -> None:
-        """Read one digest frame per follower for the commit just applied
-        and compare against the frontend's own chained mirror digest
-        (``DeviceIndex._fold_mirror_digest``).  Any mismatch, replay
-        failure, or dead/slow follower latches the dispatcher and raises —
-        mirror divergence is permanent, so serving past it would be
-        silent corruption.  Called with ``op_lock`` held (commit runs
-        inside the broadcast+execute section), so frames can never
-        interleave across commits."""
+        """Read one digest frame per live follower for the commit just
+        applied and compare against the frontend's own chained mirror
+        digest (``DeviceIndex._fold_mirror_digest``).  A mismatch,
+        replay failure, or dead/slow follower EVICTS that follower — its
+        mirror is permanently behind/diverged, but the frontend's own
+        state is authoritative and the survivors are still in lockstep,
+        so the commit stands and serving degrades instead of latching
+        (ISSUE 8; the pre-HA behavior latched the whole slice).  Called
+        with ``op_lock`` held (commit runs inside the broadcast+execute
+        section), so frames can never interleave across commits."""
         if not _verify_enabled():
             return
-        for i, conn in enumerate(self._conns):
+        for f in self.live_followers():
+            conn = f.conn
             try:
                 conn.settimeout(_CONNECT_TIMEOUT_S)
                 frame = _recv_exact(conn, _DIGEST_LEN)
@@ -499,13 +695,10 @@ class Dispatcher:
                     )
                 blob = _recv_exact(conn, blob_len) if blob_len else b""
             except (OSError, EOFError) as e:
-                self.mark_failed(
-                    f"no commit digest from follower {i} for {key}: {e!r}"
+                self._evict(
+                    f, f"no commit digest for {key}: {e!r}"
                 )
-                raise RuntimeError(
-                    f"multi-host commit digest handshake failed "
-                    f"(follower {i}): {e}"
-                ) from e
+                continue
             finally:
                 try:
                     conn.settimeout(None)
@@ -520,13 +713,12 @@ class Dispatcher:
             ok = frame[len(_DIGEST_MAGIC)] == 1
             theirs = frame[len(_DIGEST_MAGIC) + 1:]
             if not ok or theirs != digest:
-                reason = (
-                    f"follower {i} mirror diverged on commit for {key}: "
+                self._evict(
+                    f,
+                    f"mirror diverged on commit for {key}: "
                     + ("replay failed" if not ok else
-                       f"digest {theirs.hex()} != {digest.hex()}")
+                       f"digest {theirs.hex()} != {digest.hex()}"),
                 )
-                self.mark_failed(reason)
-                raise RuntimeError(f"multi-host mirror divergence: {reason}")
 
     def mark_failed(self, reason: str) -> None:
         """Latch the dispatcher down after an op-stream desync the sender
@@ -560,14 +752,34 @@ class Dispatcher:
                                ("recordlinkage", linkages)):
             for name, wl in registry.items():
                 wl.index._dispatch_key = (kind, name)
+                self._install_link_publisher((kind, name), wl)
+
+    def _install_link_publisher(self, key, wl) -> None:
+        """Wrap the workload's link database so every committed link
+        batch (scoring matches, one-to-one retractions/rewrites, delete
+        retractions — in arrival order) broadcasts as a first-class
+        ``links`` op; followers fold them into replica link DBs and
+        serve ``?since=`` feeds locally (ISSUE 8 tentpole)."""
+        from ..links.replica import PublishingLinkDatabase
+
+        if isinstance(wl.link_database, PublishingLinkDatabase):
+            return  # already wrapped (re-tag after reload of same wl)
+
+        def publish(seq: int, rows) -> None:
+            self.broadcast(("links", key, seq, rows))
+
+        wl.replace_link_database(
+            PublishingLinkDatabase(wl.link_database, publish)
+        )
 
     def _stream_states(self, dedups: Dict, linkages: Dict) -> None:
         for kind, registry in (("deduplication", dedups),
                                ("recordlinkage", linkages)):
             for name, wl in registry.items():
-                self._stream_state((kind, name), wl.index)
+                self._stream_state((kind, name), wl.index,
+                                   getattr(wl, "link_database", None))
 
-    def _stream_state(self, key, index) -> None:
+    def _stream_state(self, key, index, link_db=None) -> None:
         """Stream one workload's corpus bootstrap in O(chunk)-bounded
         messages: the snapshot wire format file-chunked, the record
         mirror in batches — never a whole-corpus pickle (the r4 payload
@@ -583,7 +795,27 @@ class Dispatcher:
             # captured point, so the handshake compares equal iff every
             # post-bootstrap commit applied identically on both sides
             "mirror_digest": index._mirror_digest,
+            # replica link DBs resume the published link stream from the
+            # publisher's sequence at this capture point — the streamed
+            # link_state rows below ARE the state at that watermark
+            "link_seq": getattr(link_db, "seq", 0),
         }))
+        if link_db is not None:
+            # bootstrap the replica link DB: every current row (asserted
+            # AND retracted — the replica must serve the full ?since=
+            # history semantics), batched like the record mirror.
+            # get_all_links drains any write-behind buffer first, so the
+            # rows match the link_seq watermark captured above.
+            from ..links.replica import encode_link
+
+            batch: List = []
+            for link in link_db.get_all_links():
+                batch.append(encode_link(link))
+                if len(batch) >= _REC_BATCH:
+                    self.broadcast(("link_state", key, batch))
+                    batch = []
+            if batch:
+                self.broadcast(("link_state", key, batch))
         if has_snapshot:
             fd, tmp = tempfile.mkstemp(suffix=".npz")
             os.close(fd)
@@ -698,14 +930,32 @@ class _Replica:
         registry = (sc.deduplications if kind == "deduplication"
                     else sc.record_linkages)
         wc = registry[name]
+        # backend-generic (ISSUE 8): production multi-host runs sharded
+        # backends, but the HA machinery (replica link DBs, epoch
+        # fencing, failover) is backend-agnostic — single-device
+        # backends let the fault-injection suites run on hosts whose
+        # jax lacks shard_map
         if backend == "sharded-brute":
             from ..engine.sharded_matcher import ShardedDeviceIndex
 
             self.index = ShardedDeviceIndex(wc.duke, tunables=sc.tunables)
-        else:
+        elif backend == "sharded":
             from ..engine.sharded_matcher import ShardedAnnIndex
 
             self.index = ShardedAnnIndex(wc.duke, tunables=sc.tunables)
+        elif backend == "device":
+            from ..engine.device_matcher import DeviceIndex
+
+            self.index = DeviceIndex(wc.duke, tunables=sc.tunables)
+        elif backend == "ann":
+            from ..engine.ann_matcher import AnnIndex
+
+            self.index = AnnIndex(wc.duke, tunables=sc.tunables)
+        else:
+            raise RuntimeError(
+                f"follower replicas need a device-family backend "
+                f"(got {backend!r})"
+            )
         self.processor = FollowerProcessor(
             wc.duke, self.index, group_filtering=wc.is_record_linkage
         )
@@ -760,36 +1010,111 @@ class _Replica:
 class _FollowerSession:
     """The follower's op-stream state machine, socket-free so tests can
     drive it op by op: ``handle(op)`` returns False on shutdown.
-    ``send`` is the response channel (digest handshake frames)."""
+    ``send`` is the response channel (digest handshake frames).
 
-    def __init__(self, send):
+    Framed transports route through ``handle_frame`` instead, which
+    applies the HA stream discipline (ISSUE 8) before ``handle``:
+
+      * **epoch fencing** — ops from an epoch lower than the adopted one
+        are dropped (counted in ``stale_rejected``): after a promotion a
+        zombie ex-leader's stale broadcasts can never corrupt the group;
+      * **dup dropping** — a frame seq <= the last applied seq is the
+        retry/fault layer re-sending a frame; applying it twice would
+        double-apply a commit, so it drops silently;
+      * **gap detection** — a seq skip means this follower missed an op
+        the leader believes delivered (non-replayable stream), so the
+        loop raises instead of serving a hole.
+    """
+
+    def __init__(self, send, follower_idx: int = 0):
         from ..core.config import parse_config
 
         self._parse_config = parse_config
         self._send = send
+        self.follower_idx = follower_idx
         self.replicas: Dict[Tuple[str, str], _Replica] = {}
+        # follower-side replica link DBs (ISSUE 8 tentpole): one per
+        # workload, fed by the ``link_state`` bootstrap + ``links`` ops,
+        # read concurrently by the replica HTTP read plane
+        self.link_replicas: Dict[Tuple[str, str], object] = {}
         self._pending: Dict[Tuple[str, str], _StateAssembly] = {}
+        self._pending_links: Dict[Tuple[str, str], List] = {}
         self._incoming: Optional[Tuple[str, str]] = None  # (backend, cfg)
+        # stream fencing state (framed transports only)
+        self.epoch = 0
+        self.last_seq = 0
+        self.stale_rejected = 0  # ops dropped from a fenced-out epoch
+        self._op_count = 0  # ops handled (fault-plan coordinates)
+        # promotion hand-over: the promoted app owns the replica indexes
+        # and link DBs from then on, so close() must not release them
+        self.promoted = False
+
+    def adopt_epoch(self, epoch: int) -> None:
+        """Raise the fencing epoch (promotion): frames still in flight
+        from the deposed leader carry a lower epoch and are rejected."""
+        self.epoch = max(self.epoch, epoch)
+
+    def handle_frame(self, op: tuple, epoch: int, seq: int) -> bool:
+        """One framed op with the HA stream discipline applied (see the
+        class docstring); returns False on shutdown."""
+        if epoch < self.epoch:
+            self.stale_rejected += 1
+            logger.warning(
+                "follower: rejected %r op from fenced-out epoch %d "
+                "(adopted epoch is %d) — zombie ex-leader?",
+                op[0], epoch, self.epoch,
+            )
+            return True
+        if epoch > self.epoch:
+            # a higher epoch is a NEW leader's stream: adopt it and
+            # restart the seq space at this frame
+            self.epoch = epoch
+            self.last_seq = seq - 1
+        if seq <= self.last_seq:
+            return True  # duplicate delivery (retry/fault layer): drop
+        if seq != self.last_seq + 1:
+            raise RuntimeError(
+                f"dispatch stream gap: frame seq {seq} arrived after "
+                f"{self.last_seq} (missed {seq - self.last_seq - 1} "
+                "frame(s)); this follower must resync"
+            )
+        self.last_seq = seq
+        return self.handle(op)
 
     def close(self) -> None:
-        for replica in self.replicas.values():
-            try:
-                replica.close()
-            except Exception:
-                pass
+        if not self.promoted:
+            for replica in self.replicas.values():
+                try:
+                    replica.close()
+                except Exception:
+                    pass
         self.replicas.clear()
+        self.link_replicas.clear()
         for asm in self._pending.values():
             asm.discard()
         self._pending.clear()
+        self._pending_links.clear()
 
     def _begin(self, backend: str, config_string: str) -> None:
         # release old replicas (device memory) before new states stream
         for replica in self.replicas.values():
             replica.close()
         self.replicas.clear()
+        self.link_replicas.clear()
+        self._pending_links.clear()
         self._incoming = (backend, config_string)
 
     def handle(self, op: tuple) -> bool:
+        self._op_count += 1
+        plan = faults.active()
+        if plan is not None and plan.follower_crash(self.follower_idx,
+                                                    self._op_count):
+            # injected hard death: the replay loop dies exactly like a
+            # follower OOM/segv would — mid-stream, no farewell frame
+            raise RuntimeError(
+                f"injected follower crash at op {self._op_count} "
+                "(DUKE_FAULTS crash_follower)"
+            )
         t0 = time.monotonic()
         try:
             return self._handle(op)
@@ -817,12 +1142,18 @@ class _FollowerSession:
         elif tag == "state_begin":
             _, key, meta = op
             self._pending[key] = _StateAssembly(key, meta)
+            self._pending_links[key] = []
         elif tag == "snap":
             _, key, data = op
             self._pending[key].add_snapshot_chunk(data)
         elif tag == "recs":
             _, key, records = op
             self._pending[key].add_records(records)
+        elif tag == "link_state":
+            # replica link DB bootstrap rows (ISSUE 8): the leader's full
+            # link state at the captured ``link_seq`` watermark, batched
+            _, key, rows = op
+            self._pending_links[key].extend(rows)
         elif tag == "state_end":
             _, key = op
             asm = self._pending.pop(key)
@@ -838,6 +1169,28 @@ class _FollowerSession:
                 # across a restart loop
                 asm.discard()
                 raise
+            from ..links.replica import ReplicaLinkDatabase
+
+            replica_db = ReplicaLinkDatabase()
+            replica_db.load_snapshot(self._pending_links.pop(key, []),
+                                     asm.meta.get("link_seq", 0))
+            self.link_replicas[key] = replica_db
+        elif tag == "links":
+            # one committed link batch (scoring matches, retractions,
+            # one-to-one rewrites — in the leader's arrival order): fold
+            # into the replica under the monotonic watermark.  A
+            # duplicate batch drops (idempotent); a GAP raises — the
+            # frame-seq discipline upstream makes one impossible on a
+            # framed transport, so a gap here means a buggy publisher
+            # and the replica must never silently serve a hole.
+            _, key, seq, rows = op[:4]
+            db = self.link_replicas.get(key)
+            if db is None:
+                raise RuntimeError(
+                    f"links op for {key} before its bootstrap link state"
+                )
+            db.note_head(seq)
+            db.apply_ops(seq, rows)
         elif tag == "bootstrap_end":
             logger.info(
                 "follower: %d workload replica(s) ready", len(self.replicas)
@@ -912,11 +1265,94 @@ class _FollowerSession:
         return True
 
 
+def _leader_alive(host: str, port: int, timeout: float = 5.0) -> bool:
+    """Split-brain guard: before self-promoting on stream loss, probe
+    whether the leader's dispatch server still accepts connections.  A
+    follower the LEADER evicted (transient send error, digest timeout)
+    sees the same EOF a leader death produces — promoting then would
+    stand up a second live frontend.  A leader that answers the probe is
+    alive: the follower must exit, not promote.  (Conservative by
+    design: a wedged-but-listening leader suppresses promotion.)"""
+    try:
+        probe = socket.create_connection((host, int(port)),
+                                         timeout=timeout)
+        probe.close()
+        return True
+    except OSError:
+        return False
+
+
+def promote_follower(session: _FollowerSession):
+    """Promote this follower's replicas into a serving leader (ISSUE 8).
+
+    The replica corpus (bootstrap snapshot + replayed commits) and the
+    replicated link DB (bootstrap link state + the published op stream up
+    to the applied watermark) ARE the promoted leader's state — exactly
+    the join-bootstrap path run in reverse.  This builds full serving
+    workloads around them (real processors with host finalization, match
+    listeners writing into the replica link DBs) and returns a ``DukeApp``
+    the caller binds an HTTP server to (``service.app.serve``).
+
+    The session's fencing epoch is bumped BEFORE hand-over: any frame
+    still in flight from the deposed leader carries the old epoch and is
+    rejected (``stale_rejected``), so a zombie ex-leader that comes back
+    mid-promotion cannot corrupt the promoted group's state.
+    """
+    from ..engine.workload import adopt_workload
+    from ..links.replica import ReplicaLinkDatabase
+    from ..service.app import DukeApp
+
+    if not session.replicas:
+        raise RuntimeError("nothing to promote: no bootstrapped replicas")
+    backend, config_string = session._incoming
+    sc = session._parse_config(config_string)
+    session.adopt_epoch(session.epoch + 1)
+    dedups: Dict[str, object] = {}
+    linkages: Dict[str, object] = {}
+    for (kind, name), replica in session.replicas.items():
+        wc = (sc.deduplications if kind == "deduplication"
+              else sc.record_linkages)[name]
+        link_db = session.link_replicas.get((kind, name))
+        if link_db is None:
+            link_db = ReplicaLinkDatabase()
+        wl = adopt_workload(
+            wc, sc, backend=backend, index=replica.index,
+            link_database=link_db,
+            # the follower-local bootstrap store keeps backing the lazy
+            # record mirror, and the promoted write path persists new
+            # batches into it store-first — the frontend's own order
+            record_store=replica._asm.store,
+        )
+        (dedups if kind == "deduplication" else linkages)[name] = wl
+    session.promoted = True  # the app owns the indexes/link DBs now
+    telemetry.DISPATCH_EPOCH.set(session.epoch)  # dukecheck: ignore[DK502] once: promotion
+    logger.warning(
+        "follower %d PROMOTED to leader at epoch %d (%d workload(s), "
+        "link watermark(s) %s)",
+        session.follower_idx, session.epoch, len(session.replicas),
+        {k[1]: getattr(db, "applied_seq", 0)
+         for k, db in session.link_replicas.items()},
+    )
+    return DukeApp(sc, backend=backend, persistent=False,
+                   prebuilt=(dedups, linkages))
+
+
 def follower_main(poll_timeout_ms: int = None) -> None:
     """Follower process entrypoint: connect to the frontend's dispatch
     stream and replay mesh ops until shutdown/EOF.  Call after
     ``multihost.initialize()`` in a process with ``jax.process_index() >
-    0``; never returns until the job ends."""
+    0``; never returns until the job ends.
+
+    HA extensions (ISSUE 8), both off unless configured:
+
+      * ``DUKE_REPLICA_HTTP_PORT`` — serve the replica read plane
+        (``?since=`` feeds, /stats, /metrics, /healthz with replication
+        lag) from this follower while it replays;
+      * ``DUKE_PROMOTE_PORT`` — on leader loss (stream EOF/reset after a
+        completed bootstrap, without a clean shutdown op), promote this
+        follower's replicas to a serving leader and bind the full HTTP
+        frontend on that port instead of exiting.
+    """
     from ..utils.jit_cache import enable_persistent_cache
 
     enable_persistent_cache()
@@ -942,17 +1378,25 @@ def follower_main(poll_timeout_ms: int = None) -> None:
     logger.info("follower: connecting to dispatch stream at %s", addr)
     sock = socket.create_connection((host, int(port)),
                                     timeout=_CONNECT_TIMEOUT_S)
+    import jax
+
+    follower_idx = jax.process_index() - 1
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-    sock.sendall(_hello_frame(token))  # raw-bytes join (Dispatcher.start)
+    # raw-bytes join (Dispatcher.start); carries this follower's stable
+    # index so leader-side identity matches DUKE_FAULTS coordinates
+    sock.sendall(_hello_frame(token, follower_idx))
     sock.settimeout(None)  # ops arrive whenever the frontend has work
 
-    session = _FollowerSession(sock.sendall)
+    session = _FollowerSession(sock.sendall, follower_idx=follower_idx)
+    plane = None
+    replica_port = env_int("DUKE_REPLICA_HTTP_PORT", 0)
     any_op = False
+    clean_shutdown = False
     try:
         while True:
             try:
-                op = _recv_msg(sock)
-            except EOFError:
+                op, epoch, seq = _recv_op(sock)
+            except (EOFError, OSError):
                 if not any_op:
                     # EOF before the first op means the frontend dropped
                     # us at the handshake — almost always a join-token
@@ -966,12 +1410,47 @@ def follower_main(poll_timeout_ms: int = None) -> None:
                         "join token (is DUKE_DISPATCH_TOKEN set "
                         "identically on both sides?)"
                     )
-                logger.info("follower: dispatch stream closed; exiting")
-                return
+                logger.info("follower: dispatch stream closed")
+                break
             any_op = True
-            if not session.handle(op):
-                return
+            if plane is None and replica_port and session.replicas:
+                from ..service.replica_plane import serve_replica_plane
+
+                plane = serve_replica_plane(session, port=replica_port)
+            if not session.handle_frame(op, epoch, seq):
+                clean_shutdown = True
+                break
+        if not clean_shutdown and session.replicas:
+            promote_port = env_int("DUKE_PROMOTE_PORT", 0)
+            if promote_port and _leader_alive(host, int(port)):
+                # the stream died but the leader still answers: WE were
+                # evicted, the leader was not lost.  Promoting here would
+                # split-brain the group (two live frontends) — exit and
+                # let the orchestrator restart this follower into a
+                # fresh join instead.
+                raise RuntimeError(
+                    "dispatch stream lost but the leader still accepts "
+                    "connections — this follower was evicted; refusing "
+                    "to promote (split-brain guard). Restart to rejoin."
+                )
+            if promote_port:
+                # leader loss without a shutdown op: promote and re-bind
+                # the HTTP frontend (the replica plane, if any, yields to
+                # the full surface)
+                if plane is not None:
+                    plane.shutdown()
+                    plane = None
+                from ..service.app import serve
+
+                app = promote_follower(session)
+                server = serve(app, port=promote_port)
+                logger.warning(
+                    "promoted frontend serving on port %d", promote_port
+                )
+                server.serve_forever()
     finally:
+        if plane is not None:
+            plane.shutdown()
         session.close()
         sock.close()
 

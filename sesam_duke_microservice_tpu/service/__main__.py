@@ -53,8 +53,14 @@ def main() -> None:
 
         if jax.process_index() != 0:
             log.info(
-                "process %d/%d: follower mode (frontend is process 0)",
+                "process %d/%d: follower mode (frontend is process 0%s%s)",
                 jax.process_index(), jax.process_count(),
+                "; replica read plane on :%s" % env_int(
+                    "DUKE_REPLICA_HTTP_PORT", 0)
+                if env_int("DUKE_REPLICA_HTTP_PORT", 0) else "",
+                "; promotes on leader loss to :%s" % env_int(
+                    "DUKE_PROMOTE_PORT", 0)
+                if env_int("DUKE_PROMOTE_PORT", 0) else "",
             )
             follower_main()
             return

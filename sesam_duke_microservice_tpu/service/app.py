@@ -101,6 +101,44 @@ def _max_request_bytes() -> int:
 # resolve in well under 100 ms on every backend.
 DEFAULT_FEED_PAGE_SIZE = 5000
 
+# Mid-stream feed lock retries (ISSUE 8 satellite): bounded exponential
+# backoff + full jitter under a wall-clock deadline, replacing the 120
+# fixed 1 s retries — a wedged writer stops pinning the handler thread at
+# a predictable instant, and the retry traffic decays instead of polling
+# at 1 Hz for two minutes.
+DEFAULT_FEED_RETRY_DEADLINE_S = 120.0
+_FEED_BACKOFF_BASE_S = 0.05
+_FEED_BACKOFF_CAP_S = 2.0
+
+
+def _feed_retry_deadline() -> float:
+    from ..telemetry.env import env_float
+
+    return max(1.0, env_float("DUKE_FEED_RETRY_DEADLINE",
+                              DEFAULT_FEED_RETRY_DEADLINE_S))
+
+
+def write_chunk(wfile, data: bytes) -> int:
+    """One HTTP/1.1 chunk — THE framing primitive, shared by the leader
+    feed handler and the replica read plane so the wire format cannot
+    drift between the two serving planes.  Zero-length data writes
+    nothing (a zero-length chunk would terminate the stream).  Returns
+    the payload bytes written."""
+    if not data:
+        return 0
+    wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+    return len(data)
+
+
+def _feed_backoff_delay(attempt: int) -> float:
+    """Exponential backoff with full jitter for mid-stream lock retries
+    (ONE policy copy — utils.backoff — shared with the dispatcher's
+    send retries)."""
+    from ..utils.backoff import full_jitter_delay
+
+    return full_jitter_delay(attempt, _FEED_BACKOFF_BASE_S,
+                             _FEED_BACKOFF_CAP_S)
+
 
 def _feed_page_size() -> int:
     raw = env_str("FEED_PAGE_SIZE")
@@ -115,7 +153,9 @@ class DukeApp:
     """Application state: parsed config + live workloads, hot-swappable."""
 
     def __init__(self, config: ServiceConfig, *, backend: str = "host",
-                 persistent: bool = True):
+                 persistent: bool = True,
+                 prebuilt: Optional[Tuple[Dict[str, Workload],
+                                          Dict[str, Workload]]] = None):
         self.backend = backend
         self.persistent = persistent
         self._swap_lock = threading.Lock()
@@ -137,9 +177,23 @@ class DukeApp:
         # removed by reload) truncate the chunked framing, which a scrape
         # can't see — plain counters surfaced by the app collector and
         # /stats.  Handler threads increment under the lock (rare events).
-        self.feed_aborts = {"lock_starved": 0, "workload_removed": 0}
+        self.feed_aborts = {
+            "lock_starved": 0, "workload_removed": 0, "deadline": 0,
+        }
         self._feed_abort_lock = threading.Lock()
-        self.apply_config(config)
+        # promoted-leader marker: adopted workloads hold the ONLY copy of
+        # the replicated link state (in-memory replicas; the deposed
+        # leader's disk is gone), so apply_config refuses to rebuild them
+        self.adopted = prebuilt is not None
+        if prebuilt is not None:
+            # leader-failover promotion (parallel.dispatch
+            # .promote_follower): the workloads already exist — built
+            # around the replica corpus + replicated link DBs — so adopt
+            # them instead of rebuilding from durable stores
+            self.config = config
+            self.deduplications, self.record_linkages = prebuilt
+        else:
+            self.apply_config(config)
         # continuous cross-request microbatching (ISSUE 6): queues are
         # keyed by (kind, name) and dispatch re-resolves from the live
         # registries, so a hot reload retargets queued requests at the
@@ -157,10 +211,29 @@ class DukeApp:
         with self._feed_abort_lock:
             self.feed_aborts[reason] = self.feed_aborts.get(reason, 0) + 1
 
+    def link_flush_errors(self) -> Dict[str, str]:
+        """Latched write-behind flush failures by workload (ISSUE 8
+        satellite): a dead persistence thread used to be invisible to
+        orchestrators until a read drained into the latch — now /readyz
+        goes unready and /healthz names the exception.  Lock-free reads
+        of the buffers' latched error slots."""
+        out: Dict[str, str] = {}
+        for kind, registry in (("deduplication", self.deduplications),
+                               ("recordlinkage", self.record_linkages)):
+            for name, wl in registry.items():
+                try:
+                    err = wl.link_database.flush_error
+                except Exception:
+                    continue  # closed/raced workload: not a latch
+                if err is not None:
+                    out[f"{kind}/{name}"] = repr(err)
+        return out
+
     def readiness(self) -> Tuple[bool, Dict[str, bool]]:
         """GET /readyz substance: config parsed, every configured workload
-        built and swapped in, and (non-host backends) the device backend
-        initialized with at least one device."""
+        built and swapped in, (non-host backends) the device backend
+        initialized with at least one device, and no workload's
+        write-behind link persistence latched on a flush failure."""
         checks = {"config_loaded": self.config is not None}
         checks["workloads_built"] = bool(
             self.config is not None
@@ -171,6 +244,7 @@ class DukeApp:
             checks["device_backend"] = True
         else:
             checks["device_backend"] = backend_info()[1] > 0
+        checks["link_persistence"] = not self.link_flush_errors()
         return all(checks.values()), checks
 
     @property
@@ -192,6 +266,18 @@ class DukeApp:
         admin operation and the reference's reload pauses service the same
         way while offering weaker consistency.
         """
+        if getattr(self, "adopted", False):
+            # a promoted leader's workloads wrap replica link DBs that
+            # exist nowhere else; rebuilding via build_workload would
+            # swap in fresh EMPTY link databases and close the only copy
+            # — silent total link loss behind a 200.  Reload again once
+            # the group re-forms around durable state.
+            raise RuntimeError(
+                "config reload is disabled on a promoted leader: its "
+                "workloads hold the only copy of the replicated link "
+                "state (restart the job to re-form the serving group, "
+                "then reload)"
+            )
         with self._swap_lock:
             old = list(self.deduplications.values()) + list(self.record_linkages.values())
             for wl in old:
@@ -505,9 +591,18 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
         elif path == "/config":
             self._reply(200, self.app.config_string.encode("utf-8"), "application/xml")
         elif path in ("/health", "/healthz"):
-            # liveness: the process answers, nothing else is asserted
-            # (/health predates the probe split and stays for compat)
-            self._reply(200, b'{"status": "ok"}', "application/json")
+            # liveness: the process answers — still 200 with a latched
+            # flush failure (the process IS alive; /readyz is what goes
+            # unready), but the exception is REPORTED here so operators
+            # see the dead persistence thread without waiting for a read
+            # to drain into it (ISSUE 8 satellite).  /health predates the
+            # probe split and stays for compat.
+            health = {"status": "ok"}
+            flush_errors = self.app.link_flush_errors()
+            if flush_errors:
+                health["link_flush_errors"] = flush_errors
+            self._reply(200, json.dumps(health).encode("utf-8"),
+                        "application/json")
         elif path == "/readyz":
             self._handle_readyz()
         elif path == "/metrics":
@@ -825,7 +920,8 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
         cursor = since
         started = False   # headers sent (can't switch to an error reply after)
         first_row = True
-        lock_retries = 0
+        lock_attempts = 0
+        lock_deadline: Optional[float] = None
         try:
             while True:
                 workload = self._workloads(kind).get(name)
@@ -847,24 +943,47 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                         f"Unknown {label} '{name}'! (All {label}s must be "
                         f"specified in the configuration)",
                     )
+                # chaos hook (DUKE_FAULTS slow_lock): deterministic stall
+                # before the acquire, driving the deadline path in tests
+                from ..utils import faults
+
+                plan = faults.active()
+                if plan is not None:
+                    stall = plan.lock_delay()
+                    if stall:
+                        time.sleep(stall)
                 if not workload.lock.acquire(timeout=READ_LOCK_TIMEOUT_SECONDS):
                     if not started:
+                        # pre-stream: the abort response is the busy 503,
+                        # Retry-After derived from the recent write-hold
+                        # EWMA (the reference's 1 s try-then-503)
                         raise _BusyError(label, workload.busy_retry_after())
-                    # mid-stream contention: retry (no in-band error exists
-                    # once streaming), but bounded — a wedged writer must
-                    # not pin this handler thread forever.  Truncating the
-                    # chunked stream signals the failure to the client.
-                    lock_retries += 1
-                    if lock_retries > 120:
+                    # mid-stream contention: no in-band error channel
+                    # exists once streaming, so retry — with exponential
+                    # backoff + jitter under a wall-clock deadline
+                    # (ISSUE 8 satellite; was 120 fixed 1 s retries).  A
+                    # wedged writer truncates the chunked framing at the
+                    # deadline so the client sees a protocol error, never
+                    # silent partial success.
+                    now = time.monotonic()
+                    if lock_deadline is None:
+                        lock_deadline = now + _feed_retry_deadline()
+                    lock_attempts += 1
+                    if now >= lock_deadline:
                         logger.warning(
                             "Aborting %s feed stream: workload lock "
-                            "unavailable for >120 s mid-stream", name,
+                            "unavailable past the %.0f s deadline "
+                            "(%d attempts)", name, _feed_retry_deadline(),
+                            lock_attempts,
                         )
-                        self.app.count_feed_abort("lock_starved")
+                        self.app.count_feed_abort("deadline")
                         self.close_connection = True
                         return
+                    time.sleep(min(_feed_backoff_delay(lock_attempts),
+                                   max(0.0, lock_deadline - now)))
                     continue
-                lock_retries = 0
+                lock_attempts = 0
+                lock_deadline = None
                 try:
                     if workload.closed:
                         continue  # replaced by reload: re-resolve registry
@@ -907,10 +1026,7 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
             self.close_connection = True
 
     def _write_chunk(self, data: bytes) -> None:
-        if not data:
-            return  # a zero-length chunk would terminate the stream
-        self._resp_bytes += len(data)
-        self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+        self._resp_bytes += write_chunk(self.wfile, data)
 
     def _handle_feed_buffered(self, m, kind: str, name: str, label: str,
                               since: int) -> None:

@@ -372,8 +372,10 @@ def make_app_collector(app):
         out.append(FamilySnapshot(
             "duke_feed_aborts_total", "counter",
             "Feed streams aborted mid-response (chunked framing truncated) "
-            "by reason: workload-lock starvation past the bounded retries, "
-            "or workload removal by config reload",
+            "by reason: the mid-stream lock-backoff wall-clock deadline "
+            "(DUKE_FEED_RETRY_DEADLINE), or workload removal by config "
+            "reload (lock_starved is the pre-deadline series, kept for "
+            "continuity)",
             [("", (("reason", reason),), float(count))
              for reason, count in sorted(abort_counts.items())],
         ))

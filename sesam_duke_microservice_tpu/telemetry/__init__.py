@@ -126,6 +126,31 @@ FOLLOWER_REPLAY_SECONDS = GLOBAL.histogram(
     ("op",),
 )
 
+# -- HA serving group (ISSUE 8: parallel/dispatch.py, links/replica.py) ------
+FOLLOWER_EVICTIONS = GLOBAL.counter(
+    "duke_follower_evictions_total",
+    "Followers evicted from the serving group after exhausted send "
+    "retries, a dead digest handshake, or mirror divergence — the slice "
+    "degrades to the survivors instead of latching down",
+)
+DISPATCH_EPOCH = GLOBAL.gauge(
+    "duke_epoch",
+    "Leadership epoch fencing the dispatch op stream (followers reject "
+    "lower-epoch ops from a zombie ex-leader; promotion bumps it)",
+)
+REPLICA_LAG = GLOBAL.gauge(
+    "duke_replica_lag_ops",
+    "Link-stream ops this follower has seen but not yet applied to its "
+    "replica link DB (head seq - applied watermark), by workload",
+    ("kind", "workload"),
+)
+FAULTS_INJECTED = GLOBAL.counter(
+    "duke_faults_injected_total",
+    "Faults injected by the deterministic DUKE_FAULTS chaos layer, by "
+    "kind",
+    ("kind",),
+)
+
 # -- mesh (engine/sharded_matcher.py) ----------------------------------------
 MESH_DEVICES = GLOBAL.gauge(
     "duke_mesh_devices",

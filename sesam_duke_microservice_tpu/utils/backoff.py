@@ -1,0 +1,19 @@
+"""Capped exponential backoff with full jitter — THE one policy copy.
+
+Both retry loops introduced by ISSUE 8 (dispatcher per-follower send
+retries, feed mid-stream lock retries) draw their delays here, so a
+policy change (e.g. adding a floor) lands once.  Full jitter
+(uniform(0, ceiling)) decorrelates retriers contending for the same
+resource; see the AWS architecture blog's classic analysis.
+"""
+
+from __future__ import annotations
+
+import random
+
+
+def full_jitter_delay(attempt: int, base_s: float, cap_s: float) -> float:
+    """Delay before retry ``attempt`` (1-based): uniform in
+    [0, min(base * 2^(attempt-1), cap)]."""
+    ceiling = min(base_s * (2 ** min(max(attempt, 1) - 1, 32)), cap_s)
+    return random.uniform(0, ceiling)

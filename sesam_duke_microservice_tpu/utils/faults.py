@@ -1,0 +1,256 @@
+"""Deterministic fault injection for the multi-host serving path.
+
+The HA serving group (ISSUE 8) is only trustworthy if its failure
+handling is *exercised*: follower eviction, send retry/backoff, epoch
+fencing, replica watermarks, and the write-behind latch all have
+behavior that production traffic alone never reaches.  This layer
+injects those failures deterministically so a chaos differential test
+can pin the surviving group's output bit-identical to unfaulted serving.
+
+Activation is the ``DUKE_FAULTS`` env var (or ``configure()`` from
+tests): a ``;``/``,``-separated spec of fault tokens.  Probabilities are
+resolved by *hashing* the injection site's coordinates (seed, kind, op
+index, follower index) — not by consuming a shared RNG stream — so a
+given spec injects the same faults at the same ops regardless of thread
+interleaving or call order.  That determinism is what makes the chaos CI
+leg reproducible.
+
+Spec tokens (``p`` in [0,1]; ``@tag`` filters to one dispatch op tag):
+
+  ``seed=<int>``                   hash seed (default 0)
+  ``drop=<p>[@tag]``               transient send failure (first attempt
+                                   only — the retry layer must heal it)
+  ``delay=<p>:<seconds>[@tag]``    sleep before the send
+  ``dup=<p>[@tag]``                send the frame twice (same stream seq
+                                   — the follower must drop the dup)
+  ``partition=<f>:<from>:<to>``    every send attempt to follower ``f``
+                                   fails for op index in [from, to) —
+                                   exhausts the retries, forcing eviction
+  ``crash_follower=<f>:<n>``       follower ``f``'s replay loop dies hard
+                                   at its ``n``-th received op
+  ``crash_leader=<n>``             the dispatcher raises LeaderCrash
+                                   before broadcasting op ``n``
+  ``flush_fail=<n>``               the ``n``-th write-behind link flush
+                                   raises (exercises the latch +
+                                   /readyz unready satellite)
+  ``slow_lock=<p>:<seconds>``      feed-path lock acquisitions sleep
+                                   first (exercises the bounded-backoff
+                                   deadline path)
+
+Every injected fault counts in ``duke_faults_injected_total{kind}``.
+This module is wired into ``parallel/dispatch.py`` (send path + follower
+loop), ``links/write_behind.py`` (flush), and ``service/app.py`` (feed
+locks); with no spec set every hook is a no-op attribute read.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from .. import telemetry
+from ..telemetry.env import env_str
+
+
+class LeaderCrash(RuntimeError):
+    """Injected leader death: the dispatcher aborts before broadcasting."""
+
+
+class InjectedSendFailure(OSError):
+    """Injected transient send failure.  Subclasses OSError so any code
+    treating it generically sees a socket-like error, but it is raised
+    BEFORE any bytes hit the wire — retrying it cannot tear a frame."""
+
+
+class InjectedFlushFailure(IOError):
+    """Injected write-behind flush failure (latches the buffer)."""
+
+
+# cached label children (dukecheck DK501): fault kinds are a tiny closed
+# set, so each child resolves through the family lock at most once
+_KIND_CHILDREN: Dict[str, object] = {}
+
+
+def _count(kind: str) -> None:
+    child = _KIND_CHILDREN.get(kind)
+    if child is None:
+        child = telemetry.FAULTS_INJECTED.labels(kind=kind)  # dukecheck: ignore[DK501] once per fault kind, cached
+        _KIND_CHILDREN[kind] = child
+    child.inc()
+
+
+def _unit(seed: int, *key) -> float:
+    """Deterministic uniform draw in [0, 1) from the site coordinates."""
+    h = hashlib.sha256(repr((seed,) + key).encode()).digest()
+    return int.from_bytes(h[:8], "big") / float(1 << 64)
+
+
+class FaultPlan:
+    def __init__(self, spec: str):
+        self.spec = spec
+        self.seed = 0
+        # (p, tag-or-None) rules
+        self._drop: list = []
+        self._dup: list = []
+        # (p, seconds, tag-or-None)
+        self._delay: list = []
+        # follower -> (from_op, to_op)
+        self._partitions: Dict[int, Tuple[int, int]] = {}
+        # follower -> op count at which its loop dies
+        self._follower_crash: Dict[int, int] = {}
+        self._leader_crash: Optional[int] = None
+        self._flush_fail_at: Optional[int] = None
+        self._slow_lock: Optional[Tuple[float, float]] = None
+        self._flush_lock = threading.Lock()
+        self._flush_count = 0  # guarded by: self._flush_lock
+        self._lock_count = 0  # guarded by: self._flush_lock
+        self._parse(spec)
+
+    def _parse(self, spec: str) -> None:
+        for raw in spec.replace(",", ";").split(";"):
+            token = raw.strip()
+            if not token:
+                continue
+            kind, _, args = token.partition("=")
+            kind = kind.strip()
+            args, _, tag = args.partition("@")
+            tag = tag.strip() or None
+            parts = [p for p in args.split(":") if p != ""]
+            try:
+                if kind == "seed":
+                    self.seed = int(parts[0])
+                elif kind == "drop":
+                    self._drop.append((float(parts[0]), tag))
+                elif kind == "dup":
+                    self._dup.append((float(parts[0]), tag))
+                elif kind == "delay":
+                    self._delay.append((float(parts[0]), float(parts[1]), tag))
+                elif kind == "partition":
+                    self._partitions[int(parts[0])] = (
+                        int(parts[1]), int(parts[2]))
+                elif kind == "crash_follower":
+                    self._follower_crash[int(parts[0])] = int(parts[1])
+                elif kind == "crash_leader":
+                    self._leader_crash = int(parts[0])
+                elif kind == "flush_fail":
+                    self._flush_fail_at = int(parts[0])
+                elif kind == "slow_lock":
+                    self._slow_lock = (float(parts[0]), float(parts[1]))
+                else:
+                    raise ValueError(f"unknown fault kind {kind!r}")
+            except (IndexError, ValueError) as e:
+                raise ValueError(
+                    f"bad DUKE_FAULTS token {token!r}: {e}"
+                ) from e
+
+    # -- dispatcher send path -------------------------------------------------
+
+    def check_leader_crash(self, op_index: int) -> None:
+        if self._leader_crash is not None and op_index == self._leader_crash:
+            _count("crash_leader")
+            raise LeaderCrash(
+                f"injected leader crash at op {op_index} (DUKE_FAULTS)"
+            )
+
+    def before_send(self, tag: str, follower: int, op_index: int,
+                    attempt: int) -> None:
+        """Called before each send attempt; sleeps for delay faults and
+        raises ``InjectedSendFailure`` for drop/partition faults — always
+        BEFORE any bytes are written, so a retry is stream-safe."""
+        window = self._partitions.get(follower)
+        if window is not None and window[0] <= op_index < window[1]:
+            _count("partition")
+            raise InjectedSendFailure(
+                f"injected partition: follower {follower} unreachable "
+                f"for op {op_index}"
+            )
+        if attempt == 0:
+            for p, seconds, t in self._delay:
+                if t is None or t == tag:
+                    if _unit(self.seed, "delay", op_index, follower) < p:
+                        _count("delay")
+                        time.sleep(seconds)
+                        break
+            for p, t in self._drop:
+                if t is None or t == tag:
+                    if _unit(self.seed, "drop", op_index, follower) < p:
+                        _count("drop")
+                        raise InjectedSendFailure(
+                            f"injected send drop at op {op_index} "
+                            f"(follower {follower})"
+                        )
+
+    def dup_send(self, tag: str, follower: int, op_index: int) -> bool:
+        for p, t in self._dup:
+            if t is None or t == tag:
+                if _unit(self.seed, "dup", op_index, follower) < p:
+                    _count("dup")
+                    return True
+        return False
+
+    # -- follower loop --------------------------------------------------------
+
+    def follower_crash(self, follower: int, op_count: int) -> bool:
+        if self._follower_crash.get(follower) == op_count:
+            _count("crash_follower")
+            return True
+        return False
+
+    # -- write-behind flush ---------------------------------------------------
+
+    def check_flush(self, name: str) -> None:
+        if self._flush_fail_at is None:
+            return
+        with self._flush_lock:
+            self._flush_count += 1
+            hit = self._flush_count == self._flush_fail_at
+        if hit:
+            _count("flush_fail")
+            raise InjectedFlushFailure(
+                f"injected {name} flush failure (DUKE_FAULTS flush_fail)"
+            )
+
+    # -- lock paths -----------------------------------------------------------
+
+    def lock_delay(self) -> float:
+        """Seconds the feed path should stall before a lock attempt."""
+        if self._slow_lock is None:
+            return 0.0
+        p, seconds = self._slow_lock
+        with self._flush_lock:
+            self._lock_count += 1
+            n = self._lock_count
+        if _unit(self.seed, "slow_lock", n) < p:
+            _count("slow_lock")
+            return seconds
+        return 0.0
+
+
+_cached: Tuple[Optional[str], Optional[FaultPlan]] = (None, None)
+_override: Optional[FaultPlan] = None
+_override_set = False
+
+
+def configure(spec: Optional[str]) -> Optional[FaultPlan]:
+    """Test hook: install (or clear, with None) an explicit plan that
+    wins over the env var.  Returns the installed plan."""
+    global _override, _override_set
+    _override = FaultPlan(spec) if spec else None
+    _override_set = spec is not None
+    return _override
+
+
+def active() -> Optional[FaultPlan]:
+    """The current fault plan, or None (the overwhelmingly common case —
+    one env read and a tuple compare per call)."""
+    global _cached
+    if _override_set:
+        return _override
+    spec = env_str("DUKE_FAULTS") or None
+    cached_spec, cached_plan = _cached
+    if spec != cached_spec:
+        cached_plan = FaultPlan(spec) if spec else None
+        _cached = (spec, cached_plan)
+    return cached_plan

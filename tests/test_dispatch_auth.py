@@ -95,6 +95,32 @@ def test_wrong_token_rejected_right_token_accepted(monkeypatch):
             c.close()
 
 
+def test_hello_frame_carries_follower_index(monkeypatch):
+    """The authenticated hello's trailing index is the follower's stable
+    identity: accept order must not define it (ISSUE 8 — DUKE_FAULTS
+    coordinates like `partition=1:...` must mean the same process every
+    run)."""
+    assert dispatch._hello_frame("x", 3)[-8:] == struct.pack(">Q", 3)
+    monkeypatch.setattr(dispatch, "_CONNECT_TIMEOUT_S", 10.0)
+    d, port, t = _accept_in_thread(2, "tok")
+    conns = []
+    try:
+        # connect in REVERSE process order: idx must come from the frame
+        for idx in (1, 0):
+            c = socket.create_connection(("127.0.0.1", port), timeout=5)
+            c.sendall(dispatch._hello_frame("tok", idx))
+            conns.append(c)
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert [f.idx for f in d._followers] == [1, 0]
+    finally:
+        d._server.close()
+        for c in conns:
+            c.close()
+        for c in d._conns:
+            c.close()
+
+
 class _StubDispatcher:
     """Records broadcasts + the failure latch (no sockets)."""
 

@@ -1,0 +1,275 @@
+"""Leader failover: promotion from replica state + epoch fencing
+(ISSUE 8 tentpole).
+
+Kills the leader mid-ingest (injected ``crash_leader`` fault at a
+deterministic op index), promotes the follower's replicas into a serving
+``DukeApp`` (``dispatch.promote_follower``), and pins the promoted link
+DB bit-equal (modulo timestamps) to a clean single-process run of the
+batches that committed — then keeps ingesting through the promoted
+leader and re-binds the full HTTP frontend.  A zombie ex-leader's
+post-promotion broadcasts are rejected by the fenced epoch.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from sesam_duke_microservice_tpu import telemetry
+from sesam_duke_microservice_tpu.parallel import dispatch
+from sesam_duke_microservice_tpu.service.app import serve
+from sesam_duke_microservice_tpu.utils import faults
+
+from test_replica_serving import KEY, HaGroup
+from test_sharded_service import DEDUP_XML, _run_dedup, _seeded_batch
+
+
+@pytest.fixture(autouse=True)
+def _no_env_faults(monkeypatch):
+    # parse_config inside the follower/promotion paths reads the real
+    # env; pin MIN_RELEVANCE so replica + promoted configs match the
+    # leader's (built with env={"MIN_RELEVANCE": "0.05"})
+    monkeypatch.setenv("MIN_RELEVANCE", "0.05")
+    faults.configure("")
+    yield
+    faults.configure(None)
+
+
+def _link_facts(rows):
+    """Timestamp-free link identity: the promoted DB is compared against
+    a clean run whose wall-clock differs."""
+    return sorted(
+        (r["entity1"], r["entity2"], r["_deleted"],
+         round(r["confidence"], 9))
+        for r in rows
+    )
+
+
+def test_leader_crash_promotion_matches_clean_run():
+    b1 = _seeded_batch(24)
+    b2 = _seeded_batch(12, prefix="b")
+    b3 = _seeded_batch(9, prefix="d")
+
+    g = HaGroup(DEDUP_XML, backend="device")
+    app2 = None
+    try:
+        g.ingest(b1)
+        g.wait_applied()
+        pre_crash_rows = g.leader_feed()
+
+        # kill the leader MID-INGEST: the very next broadcast (b2's
+        # corpus commit) dies before any bytes hit the wire
+        faults.configure(
+            f"crash_leader={g.dispatcher._op_index + 1}"
+        )
+        with pytest.raises(faults.LeaderCrash):
+            g.ingest(b2)
+        faults.configure("")
+
+        session = g.followers[0].session
+        assert session.link_replicas[KEY].applied_seq \
+            == g.workload().link_database.seq
+
+        # -- promote: replicas become a serving leader at epoch 2
+        app2 = dispatch.promote_follower(session)
+        assert session.promoted and session.epoch == 2
+        assert telemetry.DISPATCH_EPOCH.single().value == 2
+        wl2 = app2.deduplications["people"]
+
+        # the promoted feed IS the deposed leader's at the watermark —
+        # same rows, same timestamps (replicated verbatim)
+        with wl2.lock:
+            assert wl2.links_since(0) == pre_crash_rows
+
+        # and equals a CLEAN single-process run of the committed batches
+        oracle = _run_dedup("device", [b1])
+        assert sorted(
+            (r[0], r[1], r[2]) for r in _link_facts(pre_crash_rows)
+            if not r[2]
+        ) == sorted((e1, e2, False) for e1, e2, _c in oracle)
+
+        # -- the promoted leader keeps serving writes: ingest continues
+        # and the end state equals a clean run of b1 + b3
+        with wl2.lock:
+            wl2.process_batch("crm", b3)
+            rows_after = wl2.links_since(0)
+        clean = _run_dedup("device", [b1, b3])
+        assert sorted(
+            (e1, e2, round(c, 9))
+            for e1, e2, d, c in _link_facts(rows_after) if not d
+        ) == clean
+
+        # -- zombie fencing: the deposed leader broadcasts at epoch 1;
+        # the promoted session rejects without touching replica state
+        stale0 = session.stale_rejected
+        count0 = session.link_replicas[KEY].applied_seq
+        g.dispatcher.broadcast(("score", KEY, []))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and session.stale_rejected == stale0:
+            time.sleep(0.01)
+        assert session.stale_rejected == stale0 + 1
+        assert session.link_replicas[KEY].applied_seq == count0
+    finally:
+        if app2 is not None:
+            app2.close()
+        g.close()
+
+
+def test_promoted_frontend_rebinds_http():
+    """The full REST surface comes back on the promoted follower: feed,
+    /healthz, /readyz, /stats — served from the replica-built app."""
+    g = HaGroup(DEDUP_XML, backend="device")
+    app2 = None
+    server = None
+    try:
+        g.ingest(_seeded_batch(24))
+        g.wait_applied()
+        expected = g.leader_feed()
+
+        app2 = dispatch.promote_follower(g.followers[0].session)
+        server = serve(app2, port=0, host="127.0.0.1")
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+
+        with urllib.request.urlopen(base + "/deduplication/people?since=0",
+                                    timeout=30) as r:
+            assert r.status == 200
+            assert json.loads(r.read()) == expected
+        with urllib.request.urlopen(base + "/readyz", timeout=30) as r:
+            assert r.status == 200
+        with urllib.request.urlopen(base + "/stats", timeout=30) as r:
+            stats = json.loads(r.read())
+            assert stats["workloads"][0]["records_indexed"] == 24
+        # a post-promotion POST ingests through the promoted engine
+        req = urllib.request.Request(
+            base + "/deduplication/people/crm",
+            json.dumps(_seeded_batch(6, prefix="x")).encode(),
+            {"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.status == 200
+    finally:
+        if server is not None:
+            server.shutdown()
+        if app2 is not None:
+            app2.close()
+        g.close()
+
+
+def test_promote_without_replicas_refuses():
+    session = dispatch._FollowerSession(lambda frame: None)
+    with pytest.raises(RuntimeError, match="nothing to promote"):
+        dispatch.promote_follower(session)
+    session.close()
+
+
+def test_promoted_leader_refuses_config_reload():
+    """A promoted leader's workloads hold the ONLY copy of the replicated
+    link state — a reload would swap in empty link DBs behind a 200."""
+    g = HaGroup(DEDUP_XML, backend="device")
+    app2 = None
+    try:
+        g.ingest(_seeded_batch(24))
+        g.wait_applied()
+        app2 = dispatch.promote_follower(g.followers[0].session)
+        wl2 = app2.deduplications["people"]
+        with wl2.lock:
+            rows_before = wl2.links_since(0)
+        assert rows_before
+        with pytest.raises(RuntimeError, match="promoted leader"):
+            app2.reload_from_string(g.sc.config_string)
+        # nothing was swapped or closed: the link state survives intact
+        assert app2.deduplications["people"] is wl2
+        with wl2.lock:
+            assert wl2.links_since(0) == rows_before
+    finally:
+        if app2 is not None:
+            app2.close()
+        g.close()
+
+
+def test_publish_failure_keeps_seq_and_batch():
+    """A publish that raises must not advance the stream seq or drop the
+    batch — the next commit re-publishes it (no ReplicaGap hole)."""
+    from sesam_duke_microservice_tpu.links.memory import (
+        InMemoryLinkDatabase,
+    )
+    from sesam_duke_microservice_tpu.links.base import (
+        Link,
+        LinkKind,
+        LinkStatus,
+    )
+    from sesam_duke_microservice_tpu.links.replica import (
+        PublishingLinkDatabase,
+        ReplicaLinkDatabase,
+    )
+
+    published = []
+    fail = {"on": True}
+
+    def publish(seq, rows):
+        if fail["on"]:
+            raise RuntimeError("broadcast failed")
+        published.append((seq, list(rows)))
+
+    db = PublishingLinkDatabase(InMemoryLinkDatabase(), publish)
+    db.assert_link(Link("a", "b", LinkStatus.INFERRED, LinkKind.DUPLICATE,
+                        0.9, timestamp=1000))
+    with pytest.raises(RuntimeError, match="broadcast failed"):
+        db.commit()
+    assert db.seq == 0 and not published  # nothing advanced, no hole
+    fail["on"] = False
+    db.assert_link(Link("c", "d", LinkStatus.INFERRED, LinkKind.DUPLICATE,
+                        0.8, timestamp=2000))
+    db.commit()
+    assert [seq for seq, _ in published] == [1]
+    assert len(published[0][1]) == 2  # the failed batch rode along
+    replica = ReplicaLinkDatabase()
+    replica.apply_ops(*published[0])  # and replays with no gap
+    assert replica.count() == 2
+
+
+def test_leader_alive_probe_distinguishes_eviction_from_death():
+    """Split-brain guard: stream EOF alone cannot tell 'the leader
+    evicted me' from 'the leader died' — the liveness probe can."""
+    import socket
+
+    server = socket.create_server(("127.0.0.1", 0))
+    host, port = server.getsockname()
+    try:
+        assert dispatch._leader_alive(host, port, timeout=5.0) is True
+    finally:
+        server.close()
+    assert dispatch._leader_alive(host, port, timeout=2.0) is False
+
+
+def test_zero_byte_send_failure_retries_then_heals(monkeypatch):
+    """A real OSError that wrote no bytes is retry-safe (the stream is
+    still frame-aligned): the retry layer heals it without eviction."""
+    g = HaGroup(DEDUP_XML, backend="device")
+    try:
+        real = dispatch.Dispatcher._send_tracked
+        fails = {"n": 2}
+
+        def flaky(conn, frame):
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                e = OSError("transient reset")
+                e.frame_sent = 0
+                raise e
+            return real(conn, frame)
+
+        monkeypatch.setattr(dispatch.Dispatcher, "_send_tracked",
+                            staticmethod(flaky))
+        monkeypatch.setattr(dispatch, "_RETRY_BASE_S", 0.001)
+        g.ingest(_seeded_batch(6))
+        assert fails["n"] == 0  # the flaky sends actually happened
+        assert g.dispatcher._failed is None
+        assert len(g.dispatcher.live_followers()) == 1  # NOT evicted
+        g.wait_applied()
+        assert g.replica_feed() == g.leader_feed()
+    finally:
+        g.close()

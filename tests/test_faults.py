@@ -1,0 +1,345 @@
+"""Deterministic fault injection + the chaos differential (ISSUE 8).
+
+The acceptance bar: under an injected-fault spec (op-stream drop/delay/
+dup, partitions, follower crash, write-behind flush failure, slow
+locks), the surviving group's link rows and feeds stay BIT-IDENTICAL to
+unfaulted serving — transient faults are healed by the retry layer and
+the seq-fencing dup-drop, topology faults degrade to the survivors
+(``duke_follower_evictions_total`` moves while ``duke_dispatch_down``
+stays 0), and persistence faults surface in /readyz instead of hiding
+until a read drains.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from sesam_duke_microservice_tpu import telemetry
+from sesam_duke_microservice_tpu.core.config import parse_config
+from sesam_duke_microservice_tpu.links.base import Link, LinkKind, LinkStatus
+from sesam_duke_microservice_tpu.links.sqlite import SqliteLinkDatabase
+from sesam_duke_microservice_tpu.links.write_behind import (
+    WriteBehindLinkDatabase,
+)
+from sesam_duke_microservice_tpu.parallel import dispatch
+from sesam_duke_microservice_tpu.service.app import DukeApp, serve
+from sesam_duke_microservice_tpu.utils import faults
+
+from test_replica_serving import HaGroup
+from test_sharded_service import DEDUP_XML, _seeded_batch
+
+
+@pytest.fixture(autouse=True)
+def _no_env_faults():
+    faults.configure("")
+    yield
+    faults.configure(None)
+
+
+def _fault_count(kind: str) -> float:
+    return telemetry.FAULTS_INJECTED.labels(kind=kind).value
+
+
+# -- spec parsing -------------------------------------------------------------
+
+
+def test_fault_spec_parses_every_kind():
+    plan = faults.FaultPlan(
+        "seed=42; drop=0.5@commit; dup=0.25; delay=0.1:0.05;"
+        "partition=1:10:20; crash_follower=0:7; crash_leader=33;"
+        "flush_fail=2; slow_lock=0.5:0.01"
+    )
+    assert plan.seed == 42
+    assert plan._drop == [(0.5, "commit")]
+    assert plan._dup == [(0.25, None)]
+    assert plan._delay == [(0.1, 0.05, None)]
+    assert plan._partitions == {1: (10, 20)}
+    assert plan._follower_crash == {0: 7}
+    assert plan._leader_crash == 33
+    assert plan._flush_fail_at == 2
+    assert plan._slow_lock == (0.5, 0.01)
+
+
+def test_fault_spec_rejects_garbage():
+    with pytest.raises(ValueError, match="bad DUKE_FAULTS token"):
+        faults.FaultPlan("drop=notanumber")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.FaultPlan("explode=1")
+
+
+def test_fault_draws_are_deterministic():
+    """Same seed + same site coordinates => same injection decision,
+    regardless of call order — the property the chaos CI leg rests on."""
+    p1 = faults.FaultPlan("seed=7;drop=0.5")
+    p2 = faults.FaultPlan("seed=7;drop=0.5")
+    decisions1 = []
+    for op in range(50):
+        try:
+            p1.before_send("commit", 0, op, 0)
+            decisions1.append(False)
+        except faults.InjectedSendFailure:
+            decisions1.append(True)
+    decisions2 = []
+    for op in reversed(range(50)):
+        try:
+            p2.before_send("commit", 0, op, 0)
+            decisions2.append(False)
+        except faults.InjectedSendFailure:
+            decisions2.append(True)
+    assert decisions1 == list(reversed(decisions2))
+    assert any(decisions1) and not all(decisions1)
+
+
+def test_env_spec_activation(monkeypatch):
+    faults.configure(None)  # let the env var through
+    monkeypatch.setenv("DUKE_FAULTS", "seed=1;drop=0.5")
+    plan = faults.active()
+    assert plan is not None and plan.seed == 1
+    monkeypatch.delenv("DUKE_FAULTS")
+    assert faults.active() is None
+
+
+# -- chaos differential -------------------------------------------------------
+
+
+def test_chaos_differential_drop_dup_delay_bit_identical():
+    """THE chaos claim: under heavy transient op-stream faults (drops
+    retried, dups seq-dropped, delays slept), leader AND replica feeds
+    are bit-identical to each other — and equal to an unfaulted control
+    group run of the same batches."""
+    batches = [_seeded_batch(24), _seeded_batch(12, prefix="b"),
+               [{"_id": "1", "_deleted": True}]]
+
+    # control: same batches, no faults
+    control = HaGroup(DEDUP_XML, backend="device")
+    try:
+        for b in batches:
+            control.ingest(b)
+        control.wait_applied()
+        control_leader = control.leader_feed()
+        control_replica = control.replica_feed()
+    finally:
+        control.close()
+    assert control_leader == control_replica
+
+    faults.configure("seed=3;drop=0.35;dup=0.35;delay=0.15:0.002")
+    drops0, dups0 = _fault_count("drop"), _fault_count("dup")
+    evictions0 = telemetry.FOLLOWER_EVICTIONS.single().value
+    g = HaGroup(DEDUP_XML, backend="device", n_followers=2)
+    try:
+        for b in batches:
+            g.ingest(b)
+        g.wait_applied(follower=0)
+        g.wait_applied(follower=1)
+        leader_rows = g.leader_feed()
+        assert g.replica_feed(follower=0) == leader_rows
+        assert g.replica_feed(follower=1) == leader_rows
+        # the faults actually fired...
+        assert _fault_count("drop") > drops0
+        assert _fault_count("dup") > dups0
+        # ...and were HEALED: no eviction, no latch
+        assert telemetry.FOLLOWER_EVICTIONS.single().value == evictions0
+        assert telemetry.DISPATCH_DOWN.single().value == 0
+        assert g.dispatcher._failed is None
+    finally:
+        g.close()
+        faults.configure("")
+
+    # the faulted group's rows equal the control group's, timestamps
+    # aside (different wall-clock runs)
+    def facts(rows):
+        return sorted((r["entity1"], r["entity2"], r["_deleted"],
+                       round(r["confidence"], 9)) for r in rows)
+
+    assert facts(leader_rows) == facts(control_leader)
+
+
+def test_partition_exhausts_retries_and_evicts(monkeypatch):
+    """A partitioned follower (every send attempt fails) is evicted
+    after the bounded retries; the group degrades to the survivor and
+    stays bit-identical — duke_dispatch_down stays 0 throughout."""
+    monkeypatch.setattr(dispatch, "_SEND_RETRIES", 2)
+    monkeypatch.setattr(dispatch, "_RETRY_BASE_S", 0.001)
+    faults.configure("partition=0:1:100000")
+    evictions0 = telemetry.FOLLOWER_EVICTIONS.single().value
+    partitions0 = _fault_count("partition")
+    g = HaGroup(DEDUP_XML, backend="device", n_followers=2)
+    try:
+        g.ingest(_seeded_batch(12))
+        assert _fault_count("partition") > partitions0
+        assert telemetry.FOLLOWER_EVICTIONS.single().value == evictions0 + 1
+        assert telemetry.DISPATCH_DOWN.single().value == 0
+        assert g.dispatcher._failed is None
+        assert [f.idx for f in g.dispatcher.live_followers()] == [1]
+        g.wait_applied(follower=1)
+        assert g.replica_feed(follower=1) == g.leader_feed()
+    finally:
+        g.close()
+
+
+def test_follower_crash_evicted_group_survives(monkeypatch):
+    """crash_follower kills the replay loop mid-stream; the dead digest
+    handshake evicts it and the leader keeps serving."""
+    monkeypatch.setattr(dispatch, "_CONNECT_TIMEOUT_S", 10.0)
+    # the bootstrap for one workload is ~4 ops; crash follower 0 shortly
+    # after, mid-ingest
+    faults.configure("crash_follower=0:6")
+    g = HaGroup(DEDUP_XML, backend="device", n_followers=2)
+    try:
+        g.ingest(_seeded_batch(12))
+        g.ingest(_seeded_batch(6, prefix="b"))
+        assert g.followers[0].error is not None  # the loop really died
+        assert g.dispatcher._failed is None
+        assert telemetry.DISPATCH_DOWN.single().value == 0
+        assert len(g.dispatcher.live_followers()) == 1
+        g.wait_applied(follower=1)
+        assert g.replica_feed(follower=1) == g.leader_feed()
+    finally:
+        g.close()
+
+
+# -- write-behind flush failure ----------------------------------------------
+
+
+def test_flush_fail_latches_buffer(tmp_path):
+    faults.configure("flush_fail=1")
+    db = WriteBehindLinkDatabase(
+        SqliteLinkDatabase(str(tmp_path / "links.db"))
+    )
+    try:
+        db.assert_link(Link("a", "b", LinkStatus.INFERRED,
+                            LinkKind.DUPLICATE, 0.9))
+        db.commit()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and db.flush_error is None:
+            time.sleep(0.01)
+        assert isinstance(db.flush_error, faults.InjectedFlushFailure)
+        assert _fault_count("flush_fail") >= 1
+        with pytest.raises(RuntimeError, match="flush failed"):
+            db.drain()
+    finally:
+        db.close()
+
+
+def test_flush_latch_flips_readyz_and_healthz(tmp_path):
+    """ISSUE 8 satellite: a dead persistence thread goes unready in
+    /readyz and is NAMED in /healthz — before any read drains into it."""
+    xml = DEDUP_XML.replace(
+        "<DukeMicroService>",
+        f'<DukeMicroService dataFolder="{tmp_path}">',
+    ).replace(' link-database-type="in-memory"', "")
+    app = DukeApp(parse_config(xml, env={"MIN_RELEVANCE": "0.05"}),
+                  backend="host", persistent=True)
+    server = serve(app, port=0, host="127.0.0.1")
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        with urllib.request.urlopen(base + "/readyz", timeout=30) as r:
+            assert r.status == 200  # healthy before the fault
+
+        faults.configure("flush_fail=1")
+        wl = app.deduplications["people"]
+        with wl.lock:
+            wl.process_batch("crm", _seeded_batch(6))
+        deadline = time.monotonic() + 10
+        while (time.monotonic() < deadline
+               and wl.link_database.flush_error is None):
+            time.sleep(0.01)
+        assert wl.link_database.flush_error is not None
+
+        ready, checks = app.readiness()
+        assert ready is False and checks["link_persistence"] is False
+        try:
+            urllib.request.urlopen(base + "/readyz", timeout=30)
+            raise AssertionError("readyz stayed ready past the latch")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert json.loads(e.read())["checks"]["link_persistence"] is False
+        # liveness stays 200 but NAMES the latched exception
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+            health = json.loads(r.read())
+            assert r.status == 200
+            assert "deduplication/people" in health["link_flush_errors"]
+            assert "InjectedFlushFailure" in \
+                health["link_flush_errors"]["deduplication/people"]
+    finally:
+        faults.configure("")
+        server.shutdown()
+        app.close()
+
+
+# -- feed lock deadline -------------------------------------------------------
+
+
+def test_feed_midstream_deadline_abort(monkeypatch):
+    """ISSUE 8 satellite: the mid-stream lock retry loop is bounded by a
+    wall-clock deadline (backoff + jitter, not 120 fixed 1 s retries);
+    hitting it truncates the stream and counts the 'deadline' reason."""
+    monkeypatch.setenv("FEED_PAGE_SIZE", "10")
+    monkeypatch.setenv("DUKE_FEED_RETRY_DEADLINE", "2")
+    sc = parse_config(DEDUP_XML, env={"MIN_RELEVANCE": "0.05"})
+    app = DukeApp(sc, backend="host", persistent=False)
+    wl = app.deduplications["people"]
+    base_ts = 1_700_000_000_000
+    for i in range(50):
+        wl.link_database.assert_link(
+            Link(f"crm__a{i}", f"crm__b{i}", LinkStatus.INFERRED,
+                 LinkKind.DUPLICATE, 0.9, timestamp=base_ts + i))
+
+    release = threading.Event()
+    stolen = threading.Event()
+    real_page = wl.links_page
+    pages = []
+
+    def hooked(since, limit):
+        pages.append(since)
+        if len(pages) == 1:
+            # after this page the handler releases the lock; a thief
+            # grabs it and holds past the feed deadline
+            def thief():
+                with wl.lock:
+                    stolen.set()
+                    release.wait(timeout=30)
+
+            threading.Thread(target=thief, daemon=True).start()
+        return real_page(since, limit)
+
+    wl.links_page = hooked
+    server = serve(app, port=0, host="127.0.0.1")
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        try:
+            with urllib.request.urlopen(
+                base + "/deduplication/people?since=0", timeout=60
+            ) as r:
+                r.read()
+        except Exception:
+            pass  # truncated chunked framing surfaces as a transport error
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if app.feed_aborts["deadline"]:
+                break
+            time.sleep(0.05)
+        assert app.feed_aborts["deadline"] == 1
+        release.set()
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            text = r.read().decode()
+        assert 'duke_feed_aborts_total{reason="deadline"} 1' in text
+    finally:
+        release.set()
+        server.shutdown()
+        app.close()
+
+
+def test_slow_lock_fault_counts_and_stalls():
+    faults.configure("slow_lock=1:0.01")
+    plan = faults.active()
+    before = _fault_count("slow_lock")
+    assert plan.lock_delay() == 0.01
+    assert _fault_count("slow_lock") == before + 1
+    faults.configure("slow_lock=0:0.01")
+    assert faults.active().lock_delay() == 0.0

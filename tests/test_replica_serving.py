@@ -1,0 +1,412 @@
+"""Replicated link-feed serving (ISSUE 8 tentpole).
+
+Drives a REAL ``Dispatcher`` against real ``_FollowerSession`` replay
+loops over loopback sockets — framed ops with epoch/seq fencing, the
+commit digest handshake, the published link stream, and the follower
+HTTP read plane — without a 2-process jax.distributed job (this host's
+jax lacks ``shard_map``, so the suites run the HA machinery on the
+single-device ``device``/``ann`` backends; the machinery is
+backend-agnostic by construction).
+
+The core claim: a follower's replica link DB, fed only by the bootstrap
+``link_state`` + the ``links`` op stream, serves ``?since=`` feed rows
+BIT-IDENTICAL to the leader's at the same watermark — including
+retractions and one-to-one conflict rewrites — while taking no leader
+lock.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from sesam_duke_microservice_tpu import telemetry
+from sesam_duke_microservice_tpu.core.config import parse_config
+from sesam_duke_microservice_tpu.engine.workload import build_workload
+from sesam_duke_microservice_tpu.links.replica import (
+    ReplicaGap,
+    ReplicaLinkDatabase,
+    links_feed_page,
+)
+from sesam_duke_microservice_tpu.parallel import dispatch
+from sesam_duke_microservice_tpu.utils import faults
+
+from test_sharded_service import DEDUP_XML, LINKAGE_XML, _seeded_batch
+
+KEY = ("deduplication", "people")
+
+ONE_TO_ONE_XML = LINKAGE_XML.replace(
+    'link-mode="many-to-many"', 'link-mode="one-to-one"'
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_env_faults():
+    """Pin every test to an explicit fault plan (none unless it installs
+    one), so the CI chaos leg's DUKE_FAULTS env spec cannot distort
+    tests that assert exact eviction/retry behavior."""
+    faults.configure("")
+    yield
+    faults.configure(None)
+
+
+class LoopbackFollower:
+    """One follower replay loop over a socketpair: real framed ops, real
+    digest handshake responses, the production ``handle_frame`` fencing."""
+
+    def __init__(self, idx: int = 0):
+        self.leader_sock, self.sock = socket.socketpair()
+        self.session = dispatch._FollowerSession(self._send,
+                                                 follower_idx=idx)
+        self.error = None
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+        self.thread.start()
+
+    def _send(self, frame: bytes) -> None:
+        self.sock.sendall(frame)
+
+    def _loop(self) -> None:
+        try:
+            while True:
+                op, epoch, seq = dispatch._recv_op(self.sock)
+                if not self.session.handle_frame(op, epoch, seq):
+                    return
+        except (EOFError, OSError):
+            return
+        except BaseException as e:  # crash: die hard, like the process
+            self.error = e
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        for s in (self.sock, self.leader_sock):
+            try:
+                s.close()
+            except OSError:
+                pass
+        self.thread.join(timeout=10)
+        self.session.close()
+
+
+class HaGroup:
+    """Leader workloads + dispatcher + N loopback followers, bootstrapped
+    exactly like ``Dispatcher.start()`` does it (minus the jax.distributed
+    rendezvous)."""
+
+    def __init__(self, xml, backend="device", n_followers=1, env=None):
+        sc = parse_config(xml, env=env or {"MIN_RELEVANCE": "0.05"})
+        self.sc = sc
+        dedups = {
+            name: build_workload(wc, sc, backend=backend, persistent=False)
+            for name, wc in sc.deduplications.items()
+        }
+        linkages = {
+            name: build_workload(wc, sc, backend=backend, persistent=False)
+            for name, wc in sc.record_linkages.items()
+        }
+
+        class _App:
+            pass
+
+        app = _App()
+        app.backend = backend
+        app.config_string = sc.config_string
+        app.deduplications = dedups
+        app.record_linkages = linkages
+        self.app = app
+        self.dispatcher = dispatch.Dispatcher(app)
+        self.followers = [LoopbackFollower(i) for i in range(n_followers)]
+        self.dispatcher._conns = [f.leader_sock for f in self.followers]
+        self._prev_global = dispatch._DISPATCHER
+        dispatch._DISPATCHER = self.dispatcher
+        try:
+            self.dispatcher._tag_workloads(dedups, linkages)
+            self.dispatcher._bootstrap_followers()
+        except BaseException:
+            self.close()
+            raise
+
+    def workload(self, name="people", kind="deduplication"):
+        registry = (self.app.deduplications if kind == "deduplication"
+                    else self.app.record_linkages)
+        return registry[name]
+
+    def ingest(self, batch, dataset="crm", name="people",
+               kind="deduplication") -> None:
+        wl = self.workload(name, kind)
+        with wl.lock:
+            wl.process_batch(dataset, batch)
+
+    def wait_applied(self, follower=0, key=KEY, timeout=60) -> None:
+        """Block until the follower's replica watermark reaches the
+        leader publisher's sequence (links ops carry no handshake)."""
+        wl = self.workload(key[1], key[0])
+        want = wl.link_database.seq
+        session = self.followers[follower].session
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.followers[follower].error is not None:
+                raise AssertionError(
+                    f"follower died: {self.followers[follower].error!r}"
+                )
+            db = session.link_replicas.get(key)
+            if db is not None and db.applied_seq >= want:
+                return
+            time.sleep(0.01)
+        raise AssertionError(
+            f"replica never reached watermark {want} "
+            f"(at {session.link_replicas.get(key) and session.link_replicas[key].applied_seq})"
+        )
+
+    def replica_feed(self, follower=0, key=KEY, since=0):
+        session = self.followers[follower].session
+        db = session.link_replicas[key]
+        index = session.replicas[key].index
+        rows, cursor = [], since
+        while True:
+            page, cursor = links_feed_page(db, index, cursor, 5000)
+            rows.extend(page)
+            if not page:
+                return rows
+
+    def leader_feed(self, name="people", kind="deduplication", since=0):
+        wl = self.workload(name, kind)
+        with wl.lock:
+            return wl.links_since(since)
+
+    def close(self) -> None:
+        dispatch._DISPATCHER = self._prev_global
+        try:
+            self.dispatcher.close()
+        finally:
+            for f in self.followers:
+                f.close()
+            for registry in (self.app.deduplications,
+                             self.app.record_linkages):
+                for wl in registry.values():
+                    try:
+                        wl.close()
+                    except Exception:
+                        pass
+
+
+@pytest.fixture
+def group(request):
+    g = HaGroup(DEDUP_XML, backend=getattr(request, "param", "device"))
+    try:
+        yield g
+    finally:
+        g.close()
+
+
+# -- feed parity --------------------------------------------------------------
+
+
+@pytest.mark.parametrize("group", ["device", "ann"], indirect=True)
+def test_replica_feed_parity_with_retractions(group):
+    """Leader feed vs follower replica feed: bit-identical rows at the
+    same watermark, through ingest with duplicates, a second batch, and a
+    deletion (link retraction)."""
+    group.ingest(_seeded_batch(24))
+    group.ingest(_seeded_batch(12, prefix="b"))
+    # record "1" is half of the (0,1)-style duplicate structure: deleting
+    # it retracts links, which must replicate as first-class rows
+    group.ingest([{"_id": "1", "_deleted": True}])
+    group.wait_applied()
+
+    leader_rows = group.leader_feed()
+    replica_rows = group.replica_feed()
+    assert leader_rows == replica_rows  # full dicts: ts, ids, confidences
+    assert any(r["_deleted"] for r in leader_rows), "no retraction exercised"
+    # and the replica holds the same watermark the leader published
+    session = group.followers[0].session
+    assert (session.link_replicas[KEY].applied_seq
+            == group.workload().link_database.seq)
+    assert session.link_replicas[KEY].lag_ops() == 0
+
+
+def test_replica_feed_parity_one_to_one_rewrites():
+    """One-to-one record linkage: conflict resolution retracts weaker
+    links and rewrites winners across batches — the rewrite/retract
+    churn must replicate bit-identically."""
+    g = HaGroup(ONE_TO_ONE_XML, backend="device",
+                env={"MIN_RELEVANCE": "0.05"})
+    try:
+        key = ("recordlinkage", "pairing")
+        g.ingest([{"_id": f"L{i}", "name": f"acme systems {i}"}
+                  for i in range(6)],
+                 dataset="left", name="pairing", kind="recordlinkage")
+        # right side: near-duplicates competing for the same left records
+        # (forces one-to-one displacement rewrites)
+        g.ingest([{"_id": f"R{i}", "name": f"acme systems {i % 3}"}
+                  for i in range(6)],
+                 dataset="right", name="pairing", kind="recordlinkage")
+        g.ingest([{"_id": "R9", "name": "acme systems 0"}],
+                 dataset="right", name="pairing", kind="recordlinkage")
+        g.wait_applied(key=key)
+        leader_rows = g.leader_feed(name="pairing", kind="recordlinkage")
+        assert leader_rows  # the fixture must actually produce links
+        assert leader_rows == g.replica_feed(key=key)
+    finally:
+        g.close()
+
+
+def test_replica_feed_pages_match_leader_at_cursor(group):
+    """Paged replica reads honor the same strictly-greater-than cursor
+    contract as the leader's."""
+    group.ingest(_seeded_batch(24))
+    group.wait_applied()
+    leader_rows = group.leader_feed()
+    assert len(leader_rows) >= 2
+    mid_ts = leader_rows[len(leader_rows) // 2 - 1]["_updated"]
+    assert (group.replica_feed(since=mid_ts)
+            == group.leader_feed(since=mid_ts))
+
+
+# -- read plane ---------------------------------------------------------------
+
+
+def _get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def test_replica_http_read_plane(group):
+    from sesam_duke_microservice_tpu.service.replica_plane import (
+        serve_replica_plane,
+    )
+
+    group.ingest(_seeded_batch(24))
+    group.wait_applied()
+    server = serve_replica_plane(group.followers[0].session, port=0,
+                                 host="127.0.0.1")
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        status, headers, body = _get(base + "/deduplication/people?since=0")
+        assert status == 200
+        assert headers.get("X-Replica-Lag") == "0"
+        assert json.loads(body) == group.leader_feed()
+
+        status, _, body = _get(base + "/healthz")
+        health = json.loads(body)
+        assert status == 200 and health["role"] == "replica"
+        assert health["replication_lag_ops"] == 0
+        assert health["epoch"] == 1
+
+        status, _, body = _get(base + "/readyz")
+        assert status == 200
+
+        status, _, body = _get(base + "/stats")
+        stats = json.loads(body)
+        row = stats["workloads"][0]
+        assert row["links_rows"] == len(
+            {(r["entity1"], r["entity2"]) for r in group.leader_feed()}
+        ) or row["links_rows"] > 0
+        assert row["applied_seq"] == group.workload().link_database.seq
+        assert row["lag_ops"] == 0
+
+        status, _, body = _get(base + "/metrics")
+        text = body.decode()
+        assert "duke_replica_lag_ops" in text
+        assert 'workload="people"' in text
+
+        status, _, _ = _get(base + "/recordlinkage/nope?since=0")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    finally:
+        server.shutdown()
+
+
+def test_replica_feed_takes_no_leader_lock(group):
+    """Acceptance criterion: a replica serves feed pages while the
+    leader's workload lock is HELD (a long ingest in flight)."""
+    group.ingest(_seeded_batch(24))
+    group.wait_applied()
+    wl = group.workload()
+    expected = group.leader_feed()
+    assert wl.lock.acquire(timeout=5)
+    try:
+        t0 = time.monotonic()
+        rows = group.replica_feed()
+        elapsed = time.monotonic() - t0
+    finally:
+        wl.lock.release()
+    assert rows == expected
+    assert elapsed < 1.0, "replica read waited on something"
+
+
+# -- stream discipline --------------------------------------------------------
+
+
+def test_replica_watermark_drops_dups_and_raises_on_gap():
+    db = ReplicaLinkDatabase()
+    rows1 = [("a", "b", "inferred", "duplicate", 0.9, 1000)]
+    rows2 = [("c", "d", "inferred", "duplicate", 0.8, 2000)]
+    assert db.apply_ops(1, rows1) is True
+    assert db.apply_ops(1, rows1) is False  # duplicate delivery: dropped
+    assert db.count() == 1
+    db.note_head(3)
+    assert db.lag_ops() == 2
+    with pytest.raises(ReplicaGap):
+        db.apply_ops(3, rows2)  # seq 2 never arrived
+    assert db.apply_ops(2, rows2) is True
+    assert db.lag_ops() == 1
+
+
+def test_epoch_fencing_rejects_stale_frames():
+    session = dispatch._FollowerSession(lambda frame: None)
+    assert session.handle_frame(("bootstrap_end",), 1, 1)
+    session.adopt_epoch(2)  # promotion happened elsewhere
+    assert session.handle_frame(("bootstrap_end",), 1, 2)  # zombie: dropped
+    assert session.stale_rejected == 1
+    # dup seq drops silently; gap raises
+    assert session.handle_frame(("bootstrap_end",), 2, 2)
+    assert session.handle_frame(("bootstrap_end",), 2, 2)  # dup
+    with pytest.raises(RuntimeError, match="stream gap"):
+        session.handle_frame(("bootstrap_end",), 2, 9)
+    session.close()
+
+
+def test_higher_epoch_adopted_with_fresh_seq_space():
+    session = dispatch._FollowerSession(lambda frame: None)
+    assert session.handle_frame(("bootstrap_end",), 1, 1)
+    # a new leader's stream starts its own seq space
+    assert session.handle_frame(("bootstrap_end",), 3, 1)
+    assert session.epoch == 3 and session.last_seq == 1
+    session.close()
+
+
+# -- eviction -----------------------------------------------------------------
+
+
+def test_follower_eviction_degrades_not_latches(group, monkeypatch):
+    """Acceptance criterion: one follower's death evicts IT —
+    duke_dispatch_down stays 0, duke_follower_evictions_total moves, and
+    the survivors keep replicating bit-identically."""
+    monkeypatch.setattr(dispatch, "_CONNECT_TIMEOUT_S", 10.0)
+    g2 = HaGroup(DEDUP_XML, backend="device", n_followers=2)
+    evictions0 = telemetry.FOLLOWER_EVICTIONS.single().value
+    try:
+        g2.ingest(_seeded_batch(12))
+        g2.wait_applied(follower=0)
+        g2.wait_applied(follower=1)
+        # follower 0 dies (socket torn, replay loop gone)
+        g2.followers[0].sock.close()
+        g2.ingest(_seeded_batch(6, prefix="b"))
+        assert g2.dispatcher._failed is None
+        assert telemetry.DISPATCH_DOWN.single().value == 0
+        assert telemetry.FOLLOWER_EVICTIONS.single().value == evictions0 + 1
+        assert len(g2.dispatcher.live_followers()) == 1
+        g2.wait_applied(follower=1)
+        assert g2.replica_feed(follower=1) == g2.leader_feed()
+        # and the leader keeps accepting writes afterward
+        g2.ingest(_seeded_batch(3, prefix="c"))
+        g2.wait_applied(follower=1)
+        assert g2.replica_feed(follower=1) == g2.leader_feed()
+    finally:
+        g2.close()

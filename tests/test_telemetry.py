@@ -292,7 +292,7 @@ def test_health_probes(live_app):
     assert ready["status"] == "ready"
     assert ready["checks"] == {
         "config_loaded": True, "workloads_built": True,
-        "device_backend": True,
+        "device_backend": True, "link_persistence": True,
     }
 
 

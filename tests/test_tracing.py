@@ -222,11 +222,15 @@ class _SpanFollower:
         self.thread.start()
 
     def _run(self):
+        last_seq = 0
         while True:
             try:
-                op = dispatch._recv_msg(self.sock)
+                op, _epoch, seq = dispatch._recv_op(self.sock)
             except (EOFError, OSError):
                 return
+            if seq <= last_seq:
+                continue  # dup frame (CI chaos leg): production fencing drops
+            last_seq = seq
             if op[0] != "commit":
                 continue
             _, _key, records = op[:3]
