@@ -9,19 +9,35 @@ O(N * L^2) comparator FLOPs to O(N * D) matmul FLOPs + O(C * L^2)
 rescoring — the configuration for corpora where brute force stops being
 free (BASELINE.json configs[3-4]).
 
+Two multiplicative retrieval levers ride on top (ISSUE 9):
+
+  * **int8 embedding storage** (``DUKE_EMB_INT8``): per-row symmetric
+    int8 quantization with the scale vector as a second ANN_PROP tensor
+    — half the embedding HBM and roughly double the retrieval matmul
+    throughput, with the certified cosine error bound credited to the
+    recall-escalation trigger (``ops.encoder.int8_cosine_eps``,
+    ``ops.scoring.rescore_retrieved``);
+  * **IVF clustered retrieval** (``DUKE_IVF``): k-means cells over the
+    corpus embeddings with a two-stage cell-probe scan (``ops.ivf``) —
+    ~10x fewer retrieval FLOPs at measured recall.  A saturated probe
+    escalates ``nprobe`` in lockstep with the C ladder and finally falls
+    back to the flat scan, so truncation can never pass silently.
+
 Semantics vs the brute-force backend: emitted probabilities for retrieved
 pairs are identical (same exact rescoring + host finalization path through
 ``DeviceProcessor``); the candidate *set* is approximate, bounded below by
 recall escalation — when every retrieved candidate clears the pruning
 threshold the search re-runs with doubled C, so a saturated result can
 never silently truncate.  Recall against brute force is measured in
-``tests/test_ann.py`` and the bench harness, mirroring how the reference's
-Lucene blocking bounds work per record via ``max_search_hits`` without a
-recall guarantee (IncrementalLuceneDatabase.java:349-423).
+``tests/test_ann.py`` / ``tests/test_ivf.py`` and the bench harness,
+mirroring how the reference's Lucene blocking bounds work per record via
+``max_search_hits`` without a recall guarantee
+(IncrementalLuceneDatabase.java:349-423).
 
 The embedding matrix rides inside the ``DeviceCorpus`` feature tree as a
 pseudo-property (``ops.encoder.ANN_PROP``), so append/growth/tombstone and
-the incremental device-mirror update apply to it unchanged.
+the incremental device-mirror update apply to it unchanged — including
+the int8 scale vector.
 """
 
 from __future__ import annotations
@@ -34,6 +50,7 @@ import numpy as np
 from ..core.config import DukeSchema, MatchTunables
 from ..core.records import Record
 from ..ops import encoder as E
+from ..ops import ivf as IVF
 from ..telemetry.env import env_int
 from .device_matcher import (
     DeviceIndex,
@@ -69,15 +86,29 @@ class AnnIndex(DeviceIndex):
         self.dim = dim
         self.initial_top_c = initial_top_c
         self.encoder = E.RecordEncoder(schema, dim)
-        # rides in the snapshot fingerprint: a pre-bf16 (f32) snapshot must
-        # be rejected at load, or the first append would silently pin the
-        # corpus to the old dtype and forfeit the HBM/bandwidth win
-        self.emb_storage = str(np.dtype(E.STORAGE_DTYPE))
+        # rides in the snapshot fingerprint: a snapshot written under a
+        # different storage layout (pre-bf16 f32, or a DUKE_EMB_INT8
+        # flip) must be rejected at load, or the first append would
+        # silently mix dtypes in one corpus and forfeit the HBM win
+        self.emb_storage = self.encoder.storage
+        # IVF clustered retrieval (DUKE_IVF): resolved at construction;
+        # trains lazily on the scoring path once the corpus crosses
+        # DUKE_IVF_MIN_ROWS (ops.ivf — no lock of its own, the workload
+        # lock already serializes every mutation site)
+        self.ivf: Optional[IVF.IvfState] = (
+            IVF.IvfState(nshards=self._ivf_shards()) if IVF.enabled()
+            else None
+        )
+
+    def _ivf_shards(self) -> int:
+        """Shard count for the IVF membership layout (the sharded index
+        overrides with its mesh size)."""
+        return 1
 
     def _extract(self, records: Sequence[Record], plan=None):
-        # the embedding (E.STORAGE_DTYPE bf16 — see ops.encoder) rides
-        # through extract_batch so feature + embedding extraction share
-        # one entry point
+        # the embedding (bf16, or int8 + scale under DUKE_EMB_INT8 — see
+        # ops.encoder) rides through extract_batch so feature + embedding
+        # extraction share one entry point
         from ..ops import features as F
 
         return F.extract_batch(plan or self.plan, records,
@@ -91,26 +122,37 @@ class AnnIndex(DeviceIndex):
 
     def explain_retrieval(self, record: Record, candidate: Record,
                           group_filtering: bool = False) -> dict:
-        """ANN retrieval provenance (ISSUE 5): embedding cosine between
-        the pair plus — when safe — the candidate's actual rank in the
-        query's top-C retrieval.  The rank re-runs the two-stage scorer
-        for this one query; in multi-host serving that would enqueue a
-        device program followers never see (collective desync), so rank
-        is skipped there and cosine alone is reported."""
+        """ANN retrieval provenance (ISSUE 5, extended by ISSUE 9):
+        embedding cosine between the pair plus — when safe — the
+        candidate's actual rank in the query's top-C retrieval, the
+        EFFECTIVE C after recall escalation (``initial_top_c`` alone
+        understated what the search actually did), and under IVF the
+        probed-cell list plus whether the candidate's cell was probed —
+        the natural "why was this pair missed" answer.  The rank re-runs
+        the two-stage scorer for this one query; in multi-host serving
+        that would enqueue a device program followers never see
+        (collective desync), so rank is skipped there and cosine alone
+        is reported."""
         out = super().explain_retrieval(record, candidate, group_filtering)
         out["mode"] = "ann"
         out["exhaustive"] = False
         out["top_c"] = self.initial_top_c
+        out["emb_storage"] = self.emb_storage
         e1 = self.encoder.encode(record)
         e2 = self.encoder.encode(candidate)
         out["cosine"] = float(np.dot(e1, e2))  # encode() normalizes
         row = self.id_to_row.get(candidate.record_id)
         from ..parallel import dispatch
 
+        effective_c = None
         if row is not None and dispatch.current() is None:
             result = self.scorer_cache.score_block(
                 [record], group_filtering=group_filtering
             )
+            # the width the escalation loop actually finished at — the
+            # truthful "how hard did retrieval look" figure
+            effective_c = int(result.top_index.shape[1])
+            out["effective_top_c"] = effective_c
             positions = np.nonzero(result.top_index[0] == row)[0]
             if positions.size:
                 out["rank"] = int(positions[0])
@@ -118,12 +160,43 @@ class AnnIndex(DeviceIndex):
             else:
                 out["rank"] = None
                 out["retrieved"] = False
+        ivf = self.ivf
+        if ivf is not None and ivf.ready:
+            # host-side replay of the stage-1 probe (tiny: Q=1 x K) at
+            # the EFFECTIVE escalated width — reporting the initial
+            # nprobe could claim "cell not probed" for a pair the real
+            # escalated (or flat-fallback) search did scan
+            scores = e1 @ ivf.centroids.T
+            nprobe = ivf.nprobe_for(
+                effective_c if effective_c is not None
+                else self.initial_top_c,
+                self.initial_top_c,
+            )
+            probed = np.argsort(-scores, kind="stable")[:nprobe]
+            out["ivf"] = {
+                "cells": ivf.ncells,
+                "nprobe": nprobe,
+                # nprobe == ncells: the ladder ended in the flat scan,
+                # every cell (hence every row) was scanned
+                "flat_fallback": bool(nprobe >= ivf.ncells),
+                "probed_cells": [int(c) for c in probed],
+            }
+            if row is not None and row < ivf.assigned_upto:
+                cell = int(ivf.cell_of[row])
+                out["ivf"]["candidate_cell"] = cell
+                out["ivf"]["cell_probed"] = bool(cell in set(
+                    int(c) for c in probed
+                ))
         return out
 
 
 class _AnnScorerCache(_ScorerCache):
     """Caches jitted ANN scorers per (top_c, group_filtering) and runs the
-    recall-escalation loop."""
+    recall-escalation loop — through the IVF cell-probe program when
+    DUKE_IVF trained one, widening ``nprobe`` along the C ladder and
+    falling back to the flat scan once every cell is probed."""
+
+    escalation_stage = "top_c"
 
     def _build(self, top_c: int, group_filtering: bool, from_rows: bool,
                plan=None):
@@ -134,22 +207,63 @@ class _AnnScorerCache(_ScorerCache):
             group_filtering=group_filtering, queries_from_rows=from_rows,
         )
 
+    def _build_ivf(self, top_c: int, nprobe: int, group_filtering: bool,
+                   from_rows: bool):
+        return IVF.build_ivf_scorer(
+            self.index.plan, top_c=top_c, nprobe=nprobe,
+            group_filtering=group_filtering, queries_from_rows=from_rows,
+        )
+
+    def _ivf_scorer(self, top_c: int, nprobe: int, group_filtering: bool,
+                    from_rows: bool):
+        from ..utils.jit_cache import record_cache_hit, record_compile
+
+        key = ("ivf", top_c, nprobe, group_filtering, from_rows)
+        if key not in self._scorers:
+            record_compile()
+            self._scorers[key] = self._build_ivf(
+                top_c, nprobe, group_filtering, from_rows
+            )
+        else:
+            record_cache_hit()
+        return self._scorers[key]
+
+    def _ivf_placers(self):
+        """(place_centroids, place_cells) hooks for the IVF device
+        tensors; None = default single-device placement.  The sharded
+        cache overrides with replicated / record-axis-sharded placement."""
+        return None, None
+
+    def _ivf_ready(self):
+        """Train/refresh/assign under the workload lock the dispatch
+        path already holds; returns the ready IvfState or None."""
+        ivf = self.index.ivf
+        if ivf is None:
+            return None
+        return ivf if ivf.sync(self.index.corpus) else None
+
     def _lower_one(self, row_feats, cap: int, bucket: int,
                    group_filtering: bool, *, from_rows: bool = True,
                    probe_feats=None, plan=None):
-        """ANN pre-warm: the scorer signature carries the embedding matrix
-        separately from the feature tree (see dispatch_block).  Covers both
-        variants — from_rows=True (indexed batches gather on device) and
-        from_rows=False (http-transform probes upload bucket-shaped
-        qfeats + a (bucket, dim) query embedding)."""
+        """ANN pre-warm: the scorer signature carries the embedding tree
+        ({emb} or {emb, scale}) separately from the feature tree (see
+        dispatch_block).  Covers both variants — from_rows=True (indexed
+        batches gather on device) and from_rows=False (http-transform
+        probes upload bucket-shaped qfeats + a bucket-sized query
+        embedding tree).  The IVF program is deliberately NOT pre-warmed:
+        its shapes depend on trained cell geometry, which only exists
+        once data arrived."""
         import jax
 
         row_feats = dict(row_feats)
-        emb = row_feats.pop(E.ANN_PROP)[E.ANN_TENSOR]
+        emb_tree = row_feats.pop(E.ANN_PROP)
         cfeats, (mb, mb2, mi, qg, qr, ml) = self._lower_args(
             row_feats, cap, bucket
         )
-        corpus_emb = jax.ShapeDtypeStruct((cap,) + emb.shape[1:], emb.dtype)
+        corpus_tree = {
+            name: jax.ShapeDtypeStruct((cap,) + arr.shape[1:], arr.dtype)
+            for name, arr in emb_tree.items()
+        }
         c = min(self.index.initial_top_c, cap)
         # private jit instance via the shared builder — see
         # _ScorerCache._lower_one
@@ -159,10 +273,13 @@ class _AnnScorerCache(_ScorerCache):
             qfeats = {}
         else:
             pf = dict(probe_feats)
-            pemb = pf.pop(E.ANN_PROP)[E.ANN_TENSOR]
-            q_emb = jax.ShapeDtypeStruct(
-                (bucket,) + pemb.shape[1:], pemb.dtype
-            )
+            pemb = pf.pop(E.ANN_PROP)
+            q_emb = {
+                name: jax.ShapeDtypeStruct(
+                    (bucket,) + arr.shape[1:], arr.dtype
+                )
+                for name, arr in pemb.items()
+            }
             qfeats = {
                 prop: {
                     name: jax.ShapeDtypeStruct(
@@ -173,7 +290,7 @@ class _AnnScorerCache(_ScorerCache):
                 for prop, tensors in pf.items()
             }
         scorer.lower(
-            q_emb, qfeats, corpus_emb, cfeats, mb, mb2, mi, qg, qr, ml
+            q_emb, qfeats, corpus_tree, cfeats, mb, mb2, mi, qg, qr, ml
         ).compile()
 
     def dispatch_block(self, records: Sequence[Record], *,
@@ -202,28 +319,52 @@ class _AnnScorerCache(_ScorerCache):
             # signature stable for the cached from_rows variant
             q_emb = jnp.float32(0.0)
         else:
-            q_emb = qfeats.pop(E.ANN_PROP)[E.ANN_TENSOR]
+            q_emb = qfeats.pop(E.ANN_PROP)
 
         cfeats_all, cvalid, cdeleted, cgroup = corpus.device_arrays()
-        corpus_emb = cfeats_all[E.ANN_PROP][E.ANN_TENSOR]
+        emb_tree = cfeats_all[E.ANN_PROP]
         corpus_feats = {
             prop: tensors for prop, tensors in cfeats_all.items()
             if prop != E.ANN_PROP
         }
 
+        # lazy IVF maintenance (train on first crossing, assign appended
+        # slices, refresh on doubling) — runs under the workload lock the
+        # dispatch path holds, so no trainer lock exists
+        ivf = self._ivf_ready()
+
+        c0 = min(index.initial_top_c, corpus.capacity)
+
         def call(c):
+            if ivf is not None:
+                nprobe = ivf.nprobe_for(c, c0)
+                if nprobe < ivf.ncells:
+                    pc, pk = self._ivf_placers()
+                    cents, cells = ivf.device_tensors(pc, pk)
+                    return self._ivf_scorer(
+                        c, nprobe, group_filtering, from_rows
+                    )(
+                        q_emb, qfeats, emb_tree, cents, cells, corpus_feats,
+                        cvalid, cdeleted, cgroup, query_group_j, query_row_j,
+                        jnp.float32(min_logit),
+                    )
+                # every cell probed: the probe degenerated to a worse
+                # flat scan — fall back to the real one (today's path),
+                # preserving the "escalation ends in exhaustive
+                # retrieval" contract
             return self._scorer(c, group_filtering, from_rows)(
-                q_emb, qfeats, corpus_emb, corpus_feats, cvalid, cdeleted,
+                q_emb, qfeats, emb_tree, corpus_feats, cvalid, cdeleted,
                 cgroup, query_group_j, query_row_j, jnp.float32(min_logit),
             )
 
-        c = min(index.initial_top_c, corpus.capacity)
         # recall escalation: when every retrieved candidate cleared the
-        # pruning bound the search saturated — double C so truncation can
-        # never pass silently
+        # pruning bound (or sat inside the int8 ambiguity band at the
+        # cutoff) the search saturated — double C (and, under IVF,
+        # nprobe) so truncation can never pass silently
         return _PendingBlock(
-            corpus.capacity, n, min_logit, c, call,
-            lambda cmax, cc: cmax >= cc, *call(c)
+            corpus.capacity, n, min_logit, c0, call,
+            lambda cmax, cc: cmax >= cc, *call(c0),
+            stage="ivf" if ivf is not None else self.escalation_stage,
         )
 
 

@@ -1854,7 +1854,7 @@ class _PendingBlock:
     """
 
     def __init__(self, capacity, n, min_logit, k, call, needs_escalation,
-                 top_logit, top_index, count):
+                 top_logit, top_index, count, stage: str = "top_k"):
         self.capacity = capacity
         self.n = n
         self.min_logit = min_logit
@@ -1864,6 +1864,10 @@ class _PendingBlock:
         self.top_logit = top_logit
         self.top_index = top_index
         self.count = count
+        # retrieval stage the escalation metric attributes re-runs to:
+        # "top_k" (brute force), "top_c" (flat ANN), "ivf" (cell probe,
+        # incl. its terminal flat-scan fallback)
+        self.stage = stage
 
 
 # process-wide escalation count (observability: the F1-at-scale harness
@@ -1873,13 +1877,16 @@ ESCALATIONS = 0
 _ESCALATIONS_LOCK = threading.Lock()
 
 
-def _count_escalation() -> None:
+def _count_escalation(stage: str = "top_k") -> None:
     global ESCALATIONS
     with _ESCALATIONS_LOCK:
         ESCALATIONS += 1
     # mirrored on /metrics; escalations are rare by construction (each
     # doubles K), so the registry update is off the steady-state path
     telemetry.SCORER_ESCALATIONS.inc()  # dukecheck: ignore[DK502] rare by construction (each escalation doubles K)
+    # stage-attributed series (ISSUE 9): brute-force K, flat-ANN C, or
+    # IVF probe escalations tell different capacity stories
+    telemetry.RETRIEVAL_ESCALATIONS.labels(stage=stage).inc()  # dukecheck: ignore[DK501,DK502] rare by construction (each escalation doubles the width)
 
 
 def resolve_block(pending) -> _BlockResult:
@@ -1906,7 +1913,7 @@ def resolve_block(pending) -> _BlockResult:
         if k >= pending.capacity or not pending.needs_escalation(cmax, k):
             return _BlockResult(logit_np, index_np, pending.min_logit)
         k = min(k * 2, pending.capacity)
-        _count_escalation()
+        _count_escalation(getattr(pending, "stage", "top_k"))
         logger.info(
             "escalation: %d candidates at the bound, retrying with "
             "width=%d", cmax, k,

@@ -7,8 +7,10 @@ breakdown answering "why did (or didn't) A link to B":
   * **retrieval provenance** — how the pair would meet: inverted-index
     terms hit with tf/idf contributions (host backend,
     index.inverted.explain_retrieval), embedding cosine + retrieval rank
-    (ANN backends), or the exhaustive brute-force bounds (device
-    backend);
+    + the EFFECTIVE top-C after recall escalation — and, under DUKE_IVF,
+    the probed-cell list plus whether the candidate's cell was probed,
+    the "why was this pair missed" answer (ANN backends, ISSUE 9) — or
+    the exhaustive brute-force bounds (device backend);
   * **host breakdown** — per comparison property: the cleaned values,
     per-value-pair comparator similarities, Duke's probability map, and
     the clamped naive-Bayes logit contribution.  Contributions sum (from

@@ -225,17 +225,55 @@ class _ShardedAnnScorerCache(_AnnScorerCache):
             group_filtering=group_filtering,
         )
 
-        # adapt to the single-device ANN call convention (embedding matrix
+        # adapt to the single-device ANN call convention (embedding tree
         # carried separately): reassemble the corpus feature tree the
-        # sharded program expects (embedding riding as a pseudo-property)
+        # sharded program expects (embedding — and the int8 scale when
+        # present — riding as a pseudo-property)
         def call(q_emb, qfeats, corpus_emb, corpus_feats, cvalid, cdeleted,
                  cgroup, query_group, query_row, min_logit):
             cfeats = dict(corpus_feats)
-            cfeats[E.ANN_PROP] = {E.ANN_TENSOR: corpus_emb}
+            cfeats[E.ANN_PROP] = E.as_emb_tree(corpus_emb)
             return base(q_emb, qfeats, cfeats, cvalid, cdeleted, cgroup,
                         query_group, query_row, min_logit)
 
         return call
+
+    def _build_ivf(self, top_c: int, nprobe: int, group_filtering: bool,
+                   from_rows: bool):
+        from ..parallel.ann_sharded import build_sharded_ivf_scorer
+
+        base = build_sharded_ivf_scorer(
+            self.index.plan, self.index.mesh, top_c=top_c, nprobe=nprobe,
+            group_filtering=group_filtering,
+        )
+
+        def call(q_emb, qfeats, emb_tree, centroids, cell_rows,
+                 corpus_feats, cvalid, cdeleted, cgroup, query_group,
+                 query_row, min_logit):
+            cfeats = dict(corpus_feats)
+            cfeats[E.ANN_PROP] = E.as_emb_tree(emb_tree)
+            return base(q_emb, qfeats, cfeats, centroids, cell_rows,
+                        cvalid, cdeleted, cgroup, query_group, query_row,
+                        min_logit)
+
+        return call
+
+    def _ivf_placers(self):
+        """SNIPPETS.md pjit partition-rule pattern: replicate the small
+        lookup table (centroids), shard the big per-row state (the
+        stacked local-row membership matrix) on the record axis."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.sharded import SHARD_AXIS
+
+        mesh = self.index.mesh
+        repl = NamedSharding(mesh, P())
+        sharded = NamedSharding(mesh, P(SHARD_AXIS))
+        return (
+            lambda arr: jax.device_put(arr, repl),
+            lambda arr: jax.device_put(arr, sharded),
+        )
 
     def prewarm_async(self, group_filtering: bool) -> None:
         return  # see _ShardedScorerCache.prewarm_async
@@ -285,6 +323,12 @@ class ShardedAnnIndex(AnnIndex):
 
     def _make_corpus(self, plan, values_per_record: int):
         return ShardedDeviceCorpus(plan, values_per_record, self.mesh)
+
+    def _ivf_shards(self) -> int:
+        # the IVF membership matrix stacks per-shard (K, B) blocks of
+        # LOCAL row ids so P(SHARD_AXIS) placement hands each shard_map
+        # instance exactly its own block (parallel.ann_sharded)
+        return self.mesh.size
 
     @property
     def scorer_cache(self) -> _ShardedAnnScorerCache:

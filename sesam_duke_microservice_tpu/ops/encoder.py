@@ -21,6 +21,7 @@ the encoder is deterministic from the schema alone.
 
 from __future__ import annotations
 
+import math
 from typing import Dict, List, Sequence
 
 import ml_dtypes
@@ -35,6 +36,10 @@ from . import features as F
 # engine.device_matcher applies to it unchanged).
 ANN_PROP = "__ann__"
 ANN_TENSOR = "emb"
+# int8 storage mode only (DUKE_EMB_INT8): the per-row symmetric
+# quantization scale rides the corpus tree as a second ANN_PROP tensor,
+# so append/growth/tombstone/snapshot machinery covers it for free.
+ANN_SCALE = "scale"
 
 # Storage dtype for the corpus embedding matrix — THE single decision
 # point (ann_matcher, the sharded bench, the driver dryrun, and the
@@ -42,7 +47,112 @@ ANN_TENSOR = "emb"
 # matmul operands to bf16 for the MXU anyway, so denser storage halves
 # the dominant HBM/row term and the scan's memory traffic at identical
 # blocking quality (candidates are rescored exactly either way).
+#
+# DUKE_EMB_INT8=1 halves it AGAIN: rows are stored as symmetric per-row
+# int8 (q = round(v * 127 / max|v|), scale = max|v| / 127 riding as the
+# ANN_SCALE tensor) and retrieval runs an int8 x int8 -> int32 MXU
+# matmul rescaled by the two row scales.  The int32 accumulation is
+# EXACT (D * 127^2 << 2^31 for any dim up to ~130k), so the only
+# retrieval error is the vector quantization itself, bounded by
+# ``int8_cosine_eps`` and credited to the recall-escalation trigger
+# (ops.scoring.build_ann_scorer) instead of silently eating recall.
 STORAGE_DTYPE = ml_dtypes.bfloat16
+
+
+def int8_enabled() -> bool:
+    """int8 embedding storage toggle (read at encoder construction so one
+    index can never mix dtypes mid-life; the snapshot fingerprint and the
+    feature-cache plan fingerprint both carry the resolved mode)."""
+    return env_flag("DUKE_EMB_INT8", False)
+
+
+def storage_name(storage: str = None) -> str:
+    """Canonical storage-mode string (snapshot + cache fingerprints)."""
+    if storage is not None:
+        return storage
+    return "int8" if int8_enabled() else str(np.dtype(STORAGE_DTYPE))
+
+
+def int8_cosine_eps(dim: int) -> float:
+    """Certified worst-case |exact cosine - int8-reconstructed cosine|.
+
+    Rows are L2-normalized before quantization, so per component the
+    reconstruction error is at most scale/2 with scale = max|v|/127 <= 1/127,
+    giving a per-vector L2 error of at most sqrt(D)/254.  With
+    q = v + dq, c = v' + dc (||v|| = ||v'|| = 1):
+
+        |q.c - v.v'| <= ||dq|| + ||dc|| + ||dq||*||dc||
+                     <= 2*sqrt(D)/254 + D/254^2
+
+    The int32 dot of the stored int8 codes is exact (D * 127^2 < 2^31),
+    so this bound covers the WHOLE retrieval-score error.  Used to widen
+    the recall-escalation trigger: retrieved candidates within 2*eps of
+    the top-C cutoff could be displaced by quantization, so they are
+    counted as saturation evidence (ops.scoring.build_ann_scorer).
+    """
+    per_side = math.sqrt(float(dim)) / 254.0
+    return 2.0 * per_side + per_side * per_side
+
+
+def int8_cosine_eps_dynamic(q_tree: Dict, c_tree: Dict):
+    """Traced per-block certified cosine-error bound from the ACTUAL
+    row scales: ``sqrt(D)/2 * (sq + sc) + D/4 * sq * sc`` with sq/sc the
+    max query/corpus scale in the block.
+
+    Same derivation as ``int8_cosine_eps`` (which substitutes the
+    worst-possible scale 1/127) — hashed-n-gram rows have max components
+    well below 1, so the actual scales are typically ~4x smaller and the
+    bound ~4x tighter while staying a deterministic worst case.  The
+    static bound made the escalation credit fire routinely on flat
+    cosine tails (a ~0.26 band at dim 256); this one keeps the credit a
+    rare-saturation signal.  Returns a jnp scalar (trace-safe).
+    """
+    import jax.numpy as jnp
+
+    d = float(q_tree[ANN_TENSOR].shape[-1])
+    root = math.sqrt(d) / 2.0
+    sq = jnp.max(q_tree[ANN_SCALE])
+    sc = jnp.max(c_tree[ANN_SCALE])
+    return root * (sq + sc) + (root * sq) * (root * sc)
+
+
+def quantize_rows(rows: np.ndarray):
+    """Symmetric per-row int8 quantization of f32 embedding rows.
+
+    Returns ``(codes int8 (N, D), scale f32 (N,))`` with
+    ``codes * scale[:, None]`` the reconstruction.  All-zero rows (empty
+    records) keep scale 0 — they reconstruct to zero and cosine 0, the
+    same behavior the f32 path has for them.
+    """
+    rows = np.asarray(rows, dtype=np.float32)
+    peak = np.abs(rows).max(axis=1)
+    scale = (peak / 127.0).astype(np.float32)
+    inv = np.where(scale > 0.0, 1.0 / np.where(scale > 0.0, scale, 1.0), 0.0)
+    codes = np.rint(rows * inv[:, None]).astype(np.int8)
+    return codes, scale
+
+
+def corpus_tensors_from_f32(rows: np.ndarray, storage: str):
+    """f32 embedding rows -> the ANN_PROP tensor dict for ``storage``
+    ("int8" or a float dtype name).  ONE conversion point shared by the
+    serial extractor, the shared-memory parallel extractor's parent-side
+    assembly, and the encoder itself."""
+    if storage == "int8":
+        codes, scale = quantize_rows(rows)
+        return {ANN_TENSOR: codes, ANN_SCALE: scale}
+    return {ANN_TENSOR: rows.astype(STORAGE_DTYPE)}
+
+
+def dequantize_rows(tree) -> np.ndarray:
+    """ANN_PROP tensor dict -> f32 rows (host side: k-means training,
+    explain provenance).  Accepts both storage layouts."""
+    emb = tree[ANN_TENSOR]
+    if emb.dtype == np.int8:
+        return emb.astype(np.float32) * np.asarray(
+            tree[ANN_SCALE], dtype=np.float32
+        )[:, None]
+    return np.asarray(emb, dtype=np.float32)
+
 
 _NGRAM = 3
 
@@ -136,6 +246,10 @@ class RecordEncoder:
         # brute force is measured, not assumed (SURVEY.md section 7 hard
         # part 5), and more fields can only add evidence
         self.props: List[str] = [p.name for p in schema.comparison_properties()]
+        # corpus storage mode, resolved ONCE at construction: an index
+        # whose env flips mid-life must never mix dtypes in one corpus
+        # (the snapshot fingerprint and feature-cache key both carry this)
+        self.storage = storage_name()
 
     def encode(self, record: Record) -> np.ndarray:
         pairs = []
@@ -146,8 +260,18 @@ class RecordEncoder:
         return embed_values(pairs, self.dim)
 
     def encode_corpus(self, records: Sequence[Record]) -> np.ndarray:
-        """Corpus-resident embeddings: ``encode_batch`` in STORAGE_DTYPE."""
+        """Corpus-resident embeddings: ``encode_batch`` in STORAGE_DTYPE.
+
+        bf16-mode helper kept for the benches/dryrun that assemble the
+        corpus tree by hand; storage-mode-aware callers (ops.features)
+        use ``corpus_tensors`` instead."""
         return self.encode_batch(records).astype(STORAGE_DTYPE)
+
+    def corpus_tensors(self, records: Sequence[Record]) -> Dict[str, np.ndarray]:
+        """The ANN_PROP tensor dict for a record batch under this
+        encoder's storage mode ({emb} in bf16, {emb, scale} in int8)."""
+        return corpus_tensors_from_f32(self.encode_batch(records),
+                                       self.storage)
 
     def encode_batch(self, records: Sequence[Record]) -> np.ndarray:
         if not records:
@@ -270,11 +394,55 @@ def _fused_retrieval(q_emb, corpus_emb, corpus_valid, corpus_deleted,
     return top_sim, top_idx
 
 
+def as_emb_tree(x) -> Dict:
+    """Normalize an embedding operand to the ANN_PROP tensor-dict layout.
+
+    Bare arrays (the legacy bf16 call convention used by the benches and
+    the fused-retrieval tests) wrap as ``{ANN_TENSOR: x}``; dicts — the
+    corpus tree's ANN_PROP entry, carrying the int8 scale when
+    DUKE_EMB_INT8 storage is active — pass through."""
+    return x if isinstance(x, dict) else {ANN_TENSOR: x}
+
+
+def is_int8_tree(tree: Dict) -> bool:
+    return ANN_SCALE in tree
+
+
+def chunk_sims(q_tree: Dict, c_emb, c_scale=None):
+    """(Q, chunk) cosine-score tile for one corpus chunk.
+
+    bf16 storage: both operands cast to bf16, f32 MXU accumulation — the
+    pre-existing path, bit-for-bit.  int8 storage: int8 x int8 -> int32
+    MXU matmul (exact: D * 127^2 << 2^31) rescaled by the per-row
+    query/corpus scales; roughly double the matmul throughput of bf16 at
+    half the HBM traffic."""
+    import jax
+    import jax.numpy as jnp
+
+    q_emb = q_tree[ANN_TENSOR]
+    if c_scale is not None:
+        raw = jax.lax.dot_general(
+            q_emb, c_emb,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        ).astype(jnp.float32)
+        return (raw * q_tree[ANN_SCALE][:, None]) * c_scale[None, :]
+    return jax.lax.dot_general(
+        q_emb.astype(jnp.bfloat16), c_emb.astype(jnp.bfloat16),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
 def retrieval_scan(q_emb, corpus_emb, corpus_valid, corpus_deleted,
                    corpus_group, query_group, query_row, *,
                    chunk: int, top_c: int, group_filtering: bool,
                    row_offset=0):
     """Blockwise cosine top-C over the corpus embedding matrix.
+
+    ``q_emb`` / ``corpus_emb`` accept either a bare matrix (bf16 legacy
+    convention) or the ANN_PROP tensor dict — ``{emb}`` for float
+    storage, ``{emb, scale}`` for DUKE_EMB_INT8 (see ``chunk_sims``).
 
     Same scan/mask/merge skeleton as ``ops.scoring.scan_topk`` but the chunk
     score is a single (Q, D) x (D, chunk) matmul in bf16 with f32
@@ -308,16 +476,19 @@ def retrieval_scan(q_emb, corpus_emb, corpus_valid, corpus_deleted,
     cap_total = corpus_valid.shape[0]
     while chunk < wide and chunk * 2 <= cap_total and cap_total % (chunk * 2) == 0:
         chunk *= 2
-    import jax
     import jax.numpy as jnp
     from jax import lax
 
     from . import scoring
 
+    q_tree = as_emb_tree(q_emb)
+    c_tree = as_emb_tree(corpus_emb)
+    int8 = is_int8_tree(c_tree)
+    q_emb = q_tree[ANN_TENSOR]
+    corpus_emb = c_tree[ANN_TENSOR]
     q = q_emb.shape[0]
     cap = corpus_valid.shape[0]
     nchunks = cap // chunk
-    qb = q_emb.astype(jnp.bfloat16)
 
     neg = jnp.float32(scoring.NEG_INF)
     init_sim = jnp.full((q, top_c), neg, jnp.float32)
@@ -333,6 +504,7 @@ def retrieval_scan(q_emb, corpus_emb, corpus_valid, corpus_deleted,
 
     if (
         not exact
+        and not int8  # the fused segmax kernel stages bf16 operands only
         and env_flag("DEVICE_ANN_FUSED", True)
         and pk.pallas_enabled()
     ):
@@ -349,11 +521,11 @@ def retrieval_scan(q_emb, corpus_emb, corpus_valid, corpus_deleted,
         top_sim, top_idx = carry
         start = ci * chunk
         emb_c = lax.dynamic_slice_in_dim(corpus_emb, start, chunk, axis=0)
-        sims = jax.lax.dot_general(
-            qb, emb_c.astype(jnp.bfloat16),
-            (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # (Q, chunk)
+        scale_c = (
+            lax.dynamic_slice_in_dim(c_tree[ANN_SCALE], start, chunk)
+            if int8 else None
+        )
+        sims = chunk_sims(q_tree, emb_c, scale_c)  # (Q, chunk)
 
         cvalid = lax.dynamic_slice_in_dim(corpus_valid, start, chunk)
         cdel = lax.dynamic_slice_in_dim(corpus_deleted, start, chunk)

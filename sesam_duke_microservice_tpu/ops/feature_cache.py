@@ -182,6 +182,13 @@ def plan_fingerprint(plan, encoder=None) -> tuple:
     thresholds re-uses every cached row.  Includes the comparator class
     name (PHONETIC covers Soundex/Metaphone/Norphone, which extract
     different codes) and QGram's ``q``.
+
+    The encoder leg carries the resolved embedding storage mode (bf16 vs
+    the DUKE_EMB_INT8 per-row symmetric int8 + scale layout) and the
+    DUKE_IVF retrieval mode — mirroring the ``emb_storage`` snapshot
+    guard (engine.ann_matcher) in the cache key, so a dtype or IVF flip
+    between restarts self-invalidates cached rows instead of scattering
+    one storage layout into a corpus built under the other.
     """
     from . import features as F
 
@@ -193,9 +200,11 @@ def plan_fingerprint(plan, encoder=None) -> tuple:
     enc = None
     if encoder is not None:
         from . import encoder as E
+        from . import ivf
 
         enc = (int(encoder.dim), tuple(encoder.props),
-               str(np.dtype(E.STORAGE_DTYPE)))
+               getattr(encoder, "storage", None) or E.storage_name(),
+               bool(ivf.enabled()))
     return (specs, F.MAX_GRAMS, F.MAX_TOKENS,
             str(np.dtype(F.CHAR_DTYPE)), enc)
 
@@ -219,9 +228,16 @@ def record_key(record) -> Optional[bytes]:
 
 def _row_slice(feats: RowDict, j: int) -> RowDict:
     """Copy row ``j`` out of batch tensors (a view would pin the whole
-    batch's memory and break the byte accounting)."""
+    batch's memory and break the byte accounting).
+
+    The trailing ``reshape`` pins the cached row to exactly the batch
+    tensor's per-row shape: ``np.ascontiguousarray`` promotes 0-d slices
+    to ``(1,)``, which would make rows of 1-D per-row tensors (the int8
+    embedding scale) scatter back with a phantom axis on the all-hit
+    path and silently produce ``(n, 1)`` where misses produce ``(n,)``.
+    """
     return {
-        prop: {name: np.ascontiguousarray(arr[j])
+        prop: {name: np.ascontiguousarray(arr[j]).reshape(arr.shape[1:])
                for name, arr in tensors.items()}
         for prop, tensors in feats.items()
     }

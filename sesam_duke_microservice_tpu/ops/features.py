@@ -526,7 +526,10 @@ def _extract_direct(
 
     out = _extract_serial(plan, records)
     if encoder is not None:
-        out[E.ANN_PROP] = {E.ANN_TENSOR: encoder.encode_corpus(records)}
+        # storage-mode-aware: {emb} bf16, or {emb, scale} under
+        # DUKE_EMB_INT8 (the scale vector rides the corpus tree as a
+        # second ANN_PROP tensor)
+        out[E.ANN_PROP] = encoder.corpus_tensors(records)
     return out
 
 

@@ -1,0 +1,575 @@
+"""IVF clustered retrieval: k-means cells + two-stage probe scoring.
+
+Breaks the flat-scan FLOP wall of the embedding-ANN backend
+(``ops.encoder.retrieval_scan`` touches every corpus row per query, so
+retrieval work is O(N * D) per query regardless of how concentrated the
+corpus is).  The standard billion-scale playbook (FAISS IVF cell-probe,
+SCANN quantized scoring) applies cleanly here because exact f64
+finalization already makes retrieval a *recall-only* concern:
+
+  * **train** — k-means over the corpus embeddings (device matmul
+    assignment steps, host centroid fold; seeded, so training is
+    deterministic for a given corpus + platform).  Trained lazily the
+    first time a scoring pass sees the corpus past ``DUKE_IVF_MIN_ROWS``
+    and refreshed when the corpus doubles past the trained size — both
+    under the workload lock the scoring path already holds, so the
+    trainer needs NO new lock.
+  * **bucket** — every row is assigned to its nearest centroid;
+    assignments are incremental (a streaming append assigns only the new
+    slice — ingest never retrains) and live in a padded ``(cells, B)``
+    row-index matrix so the probe program keeps static shapes.
+  * **probe** — per query: one tiny (Q, K) query x centroid matmul picks
+    the top-``nprobe`` cells, then a masked candidate scan scores ONLY
+    those cells' rows (gathered embedding tiles, the same
+    dtype-dispatched MXU scoring as the flat scan incl. DUKE_EMB_INT8)
+    keeping a running top-C.  Retrieval FLOPs drop from N*D to
+    ~(K + nprobe*B)*D per query — ~10x at nprobe ~ sqrt(K).
+
+Safety net: the exact rescoring of retrieved pairs is UNCHANGED (shared
+``ops.scoring`` tail), and a saturated probe escalates ``nprobe`` with
+the C-escalation ladder until it degenerates to the flat scan
+(``engine.ann_matcher``) — truncation can never pass silently, exactly
+like today's top-C doubling.  ``DUKE_IVF=0`` (default) never constructs
+any of this.
+
+Sharded layout: cell membership is stored as a stacked
+``(nshards * K, B)`` matrix of shard-LOCAL row ids — shard s's block is
+rows [s*K, (s+1)*K).  On one device (nshards=1) local == global; on a
+mesh the matrix is placed record-axis sharded (``P(SHARD_AXIS)``) so
+each shard_map instance sees exactly its own (K, B) block, while the
+tiny centroid matrix rides replicated (``P()``) — the SNIPPETS.md
+pjit partition-rule pattern (shard the big per-row state, replicate the
+small lookup tables).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..telemetry.env import env_flag, env_int
+from . import encoder as E
+
+logger = logging.getLogger("ivf")
+
+
+def enabled() -> bool:
+    """The IVF master switch (read at index construction — the resolved
+    choice then rides the feature-cache fingerprint)."""
+    return env_flag("DUKE_IVF", False)
+
+
+def min_rows() -> int:
+    """Corpus size below which IVF stays untrained (the flat scan is
+    already cheap there and k-means would overfit a tiny corpus)."""
+    return env_int("DUKE_IVF_MIN_ROWS", 4096)
+
+
+def configured_cells(n_rows: int) -> int:
+    """Cell count: DUKE_IVF_CELLS, or the ~sqrt(N) auto policy bucketed
+    to a power of two (so corpus growth re-trains onto O(log N) distinct
+    probe-program shapes, mirroring the capacity-doubling discipline)."""
+    k = env_int("DUKE_IVF_CELLS", 0)
+    if k <= 0:
+        k = 1 << max(2, math.ceil(math.log2(max(4.0, math.sqrt(n_rows)))))
+    return max(2, min(k, max(2, n_rows // 2)))
+
+
+def configured_nprobe(ncells: int) -> int:
+    """Initial probed-cell count: DUKE_IVF_NPROBE, or ~sqrt(K) auto."""
+    p = env_int("DUKE_IVF_NPROBE", 0)
+    if p <= 0:
+        p = max(1, int(round(math.sqrt(ncells))))
+    return max(1, min(p, ncells))
+
+
+def _pow2(n: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+def _slot_bucket(n: int) -> int:
+    """Membership-matrix width bucket: pow2 up to 64, then 64-multiples.
+    Cells are size-skewed in practice (embeddings cluster by the data's
+    name distribution), and a pow2 width driven by the LARGEST cell pads
+    every probed cell to it — the probe scan's FLOPs scale with the
+    padded width, so the coarser-than-necessary pow2 step was measurably
+    eating the IVF FLOP win.  64-multiples keep recompiles rare (widths
+    only change on overflow rebuilds, which double-ish) at ~1/4 the
+    padding waste."""
+    if n <= 64:
+        return _pow2(max(1, n))
+    return -(-n // 64) * 64
+
+
+# -- k-means ------------------------------------------------------------------
+
+
+def _kmeans_step():
+    """Jitted one-Lloyd-step kernel: cosine assignment (argmax over the
+    X @ C^T matmul — rows and centroids are L2-normalized, so cosine and
+    squared-distance argmins coincide) plus per-cell sums/counts for the
+    host-side centroid fold.  Shapes (n, D) x (K, D); recompiles per
+    (n, K) bucket — training is rare by construction."""
+    import jax
+    import jax.numpy as jnp
+
+    def step(x, cents):
+        scores = x @ cents.T                       # (n, K) f32
+        assign = jnp.argmax(scores, axis=1).astype(jnp.int32)
+        k = cents.shape[0]
+        sums = jax.ops.segment_sum(x, assign, num_segments=k)
+        counts = jax.ops.segment_sum(
+            jnp.ones((x.shape[0],), jnp.float32), assign, num_segments=k
+        )
+        return assign, sums, counts
+
+    return jax.jit(step)
+
+
+def train_kmeans(x: np.ndarray, ncells: int, *, seed: int,
+                 iters: int) -> np.ndarray:
+    """Deterministic seeded k-means over L2-normalized rows ``x``.
+
+    Returns (ncells, D) f32 L2-normalized centroids.  Init is a seeded
+    row sample (deterministic for a given corpus + seed); each Lloyd
+    step runs the assignment matmul on device and folds centroids on
+    host.  Empty cells keep their previous centroid (they stay probeable
+    and can re-acquire rows on the next refresh)."""
+    n = x.shape[0]
+    rng = np.random.default_rng(seed)
+    init = rng.choice(n, size=min(ncells, n), replace=False)
+    cents = x[np.sort(init)].astype(np.float32).copy()
+    if cents.shape[0] < ncells:  # degenerate tiny corpus: repeat rows
+        reps = -(-ncells // cents.shape[0])
+        cents = np.tile(cents, (reps, 1))[:ncells]
+    norms = np.linalg.norm(cents, axis=1, keepdims=True)
+    cents /= np.where(norms > 0.0, norms, 1.0)
+
+    import jax
+
+    step = _kmeans_step()
+    xj = None
+    for _ in range(max(1, iters)):
+        if xj is None:
+            import jax.numpy as jnp
+
+            xj = jnp.asarray(x, dtype=jnp.float32)
+        _, sums, counts = jax.device_get(step(xj, cents))
+        nonempty = counts > 0.0
+        folded = sums / np.where(nonempty, counts, 1.0)[:, None]
+        cents = np.where(nonempty[:, None], folded, cents).astype(np.float32)
+        norms = np.linalg.norm(cents, axis=1, keepdims=True)
+        cents /= np.where(norms > 0.0, norms, 1.0)
+    return cents
+
+
+class IvfState:
+    """Lazy-trained IVF index over one corpus's embedding rows.
+
+    All mutation happens on the scoring path, which runs UNDER the
+    workload lock (``_AnnScorerCache.dispatch_block``) — no lock of its
+    own.  Host state is authoritative; device copies re-place lazily per
+    generation through the owning scorer cache's placement hooks.
+    """
+
+    def __init__(self, *, nshards: int = 1, seed: Optional[int] = None):
+        self.nshards = max(1, nshards)
+        self.seed = seed if seed is not None else env_int(
+            "DUKE_IVF_SEED", 1234
+        )
+        self.iters = env_int("DUKE_IVF_ITERS", 8)
+        self.centroids: Optional[np.ndarray] = None   # (K, D) f32
+        self.ncells = 0
+        self.nprobe0 = 0
+        self.cell_of = np.full((0,), -1, dtype=np.int32)  # per corpus row
+        self.cell_rows: Optional[np.ndarray] = None   # (nshards*K, B) local
+        self.counts: Optional[np.ndarray] = None      # (nshards, K)
+        self.bucket = 0                               # B (pow2)
+        self.assigned_upto = 0
+        self.trained_rows = 0
+        self.generation = 0       # bumps on any centroid/membership change
+        self._corpus_id: Optional[int] = None
+        self._local_cap = 0
+        self._assign_fn = None
+        # device mirrors, re-placed when generation moves (placement hook
+        # injected by the scorer cache: replicated vs mesh-sharded)
+        self._dev: Optional[tuple] = None
+        self._dev_gen = -1
+
+    # -- queries -------------------------------------------------------------
+
+    @property
+    def ready(self) -> bool:
+        return self.centroids is not None
+
+    def nprobe_for(self, top_c: int, initial_top_c: int) -> int:
+        """Probed cells at escalation width ``top_c``: the initial
+        ``nprobe`` scaled with the C-doubling ladder, so a saturated
+        probe widens its cell coverage in lockstep with its candidate
+        budget; at >= ncells the caller falls back to the flat scan."""
+        grow = max(1, top_c // max(1, initial_top_c))
+        return min(self.ncells, max(1, self.nprobe0 * grow))
+
+    # -- maintenance (workload lock held) ------------------------------------
+
+    def sync(self, corpus) -> bool:
+        """Bring the IVF state up to date with ``corpus``; returns
+        readiness.  Trains lazily past ``min_rows``, refreshes (full
+        retrain + reassignment) once the corpus doubles past the trained
+        size, and otherwise assigns only the appended slice — streaming
+        ingest never retrains."""
+        if self._corpus_id != id(corpus):
+            # corpus object replaced (value-slot rebuild, fresh index):
+            # row numbering restarted, so membership must too
+            self._reset()
+            self._corpus_id = id(corpus)
+        if not self.ready and corpus.size < min_rows():
+            return False
+        retrain = (
+            not self.ready
+            or corpus.size >= 2 * max(1, self.trained_rows)
+        )
+        if retrain:
+            self._train(corpus)
+        if self.ready:
+            self._assign_new(corpus)
+        return self.ready
+
+    def _reset(self) -> None:
+        self.centroids = None
+        self.ncells = 0
+        self.cell_of = np.full((0,), -1, dtype=np.int32)
+        self.cell_rows = None
+        self.counts = None
+        self.bucket = 0
+        self.assigned_upto = 0
+        self.trained_rows = 0
+        self._local_cap = 0
+        self.generation += 1
+        self._dev = None
+
+    def _embeddings_f32(self, corpus, lo: int, hi: int) -> np.ndarray:
+        return E.dequantize_rows({
+            name: arr[lo:hi]
+            for name, arr in corpus.feats[E.ANN_PROP].items()
+        })
+
+    def _train(self, corpus) -> None:
+        n = corpus.size
+        live = np.flatnonzero(
+            corpus.row_valid[:n] & ~corpus.row_deleted[:n]
+        )
+        if live.size < 2:
+            return
+        # train on a seeded sample so a 10M-row refresh does not
+        # materialize (or matmul) the full f32 corpus per Lloyd step —
+        # gather the sampled rows out of the compact storage FIRST, then
+        # dequantize only those (the sample bound must bound host RAM
+        # too, not just the matmul)
+        sample_max = env_int("DUKE_IVF_TRAIN_SAMPLE", 262144)
+        rows = live
+        if live.size > sample_max:
+            rng = np.random.default_rng(self.seed)
+            rows = np.sort(rng.choice(live, size=sample_max, replace=False))
+        x = E.dequantize_rows({
+            name: arr[rows]
+            for name, arr in corpus.feats[E.ANN_PROP].items()
+        })
+        self.ncells = configured_cells(live.size)
+        self.centroids = train_kmeans(
+            x, self.ncells, seed=self.seed, iters=self.iters
+        )
+        self.nprobe0 = configured_nprobe(self.ncells)
+        self.trained_rows = n
+        # full reassignment under the fresh centroids
+        self.cell_of = np.full((corpus.capacity,), -1, dtype=np.int32)
+        self.assigned_upto = 0
+        self.cell_rows = None
+        self.generation += 1
+        self._dev = None
+        self._assign_new(corpus)
+        logger.info(
+            "IVF trained: %d cells over %d rows (nprobe0=%d, bucket=%d)",
+            self.ncells, int(live.size), self.nprobe0, self.bucket,
+        )
+
+    def _assign_rows(self, x: np.ndarray) -> np.ndarray:
+        """Nearest-centroid assignment for a slice of f32 rows (device
+        matmul; tiny next to the append's own extraction)."""
+        if self._assign_fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            self._assign_fn = jax.jit(
+                lambda a, c: jnp.argmax(a @ c.T, axis=1).astype(jnp.int32)
+            )
+        import jax
+
+        return np.asarray(jax.device_get(
+            self._assign_fn(x, self.centroids)
+        ))
+
+    def _assign_new(self, corpus) -> None:
+        if self.cell_of.shape[0] < corpus.capacity:
+            grown = np.full((corpus.capacity,), -1, dtype=np.int32)
+            grown[: self.cell_of.shape[0]] = self.cell_of
+            self.cell_of = grown
+        lo, hi = self.assigned_upto, corpus.size
+        if hi > lo:
+            step = 65536
+            for s in range(lo, hi, step):
+                e = min(hi, s + step)
+                self.cell_of[s:e] = self._assign_rows(
+                    self._embeddings_f32(corpus, s, e)
+                )
+            self.assigned_upto = hi
+            self.generation += 1
+            self._dev = None
+        self._rebuild_membership(corpus, lo)
+
+    def _rebuild_membership(self, corpus, appended_from: int) -> None:
+        """Maintain the padded (nshards*K, B) local-row membership
+        matrix.  Incremental for appended rows; a bucket overflow (some
+        cell outgrew B) or a capacity/shard-layout change rebuilds from
+        ``cell_of`` wholesale (O(N log N), rare by the pow2 bucketing)."""
+        local_cap = corpus.capacity // self.nshards
+        if (
+            self.cell_rows is None
+            or self._local_cap != local_cap
+        ):
+            self._rebuild_full(corpus, local_cap)
+            return
+        rows = np.arange(appended_from, self.assigned_upto)
+        if rows.size == 0:
+            return
+        shard = rows // local_cap
+        cells = self.cell_of[rows]
+        key = shard * self.ncells + cells
+        need = np.bincount(
+            key, minlength=self.nshards * self.ncells,
+        ).reshape(self.nshards, self.ncells)
+        if (self.counts + need).max() > self.bucket:
+            self._rebuild_full(corpus, local_cap)
+            return
+        # vectorized grouped scatter (the _rebuild_full trick with the
+        # live counts as base offsets): a large streaming append must not
+        # run a per-row Python loop under the workload lock
+        order = np.argsort(key, kind="stable")
+        sorted_key = key[order]
+        starts = np.searchsorted(
+            sorted_key, np.arange(self.nshards * self.ncells)
+        )
+        rank = np.arange(rows.size) - starts[sorted_key]
+        slots = self.counts.reshape(-1)[sorted_key] + rank
+        self.cell_rows[sorted_key, slots] = (
+            rows[order] - shard[order] * local_cap
+        ).astype(np.int32)
+        self.counts += need
+        self.generation += 1
+        self._dev = None
+
+    def _rebuild_full(self, corpus, local_cap: int) -> None:
+        n = self.assigned_upto
+        self._local_cap = local_cap
+        rows = np.arange(n)
+        shard = rows // max(1, local_cap)
+        cells = self.cell_of[:n]
+        key = shard * self.ncells + cells
+        counts = np.bincount(
+            key, minlength=self.nshards * self.ncells
+        ).reshape(self.nshards, self.ncells)
+        self.bucket = _slot_bucket(int(counts.max(initial=1)))
+        self.counts = counts
+        mat = np.full(
+            (self.nshards * self.ncells, self.bucket), -1, dtype=np.int32
+        )
+        order = np.argsort(key, kind="stable")
+        sorted_key = key[order]
+        # slot index within each (shard, cell) run of the sorted order
+        starts = np.searchsorted(sorted_key, np.arange(
+            self.nshards * self.ncells
+        ))
+        slot = np.arange(n) - starts[sorted_key]
+        mat[sorted_key, slot] = (rows[order]
+                                 - shard[order] * local_cap).astype(np.int32)
+        self.cell_rows = mat
+        self.generation += 1
+        self._dev = None
+
+    # -- device placement ----------------------------------------------------
+
+    def device_tensors(self, place_centroids=None, place_cells=None):
+        """(centroids, cell_rows) as device arrays, re-placed when the
+        generation moved.  ``place_*`` hooks inject sharding: the default
+        single-device placement, or replicated centroids + record-axis
+        sharded membership on a mesh."""
+        if self._dev is None or self._dev_gen != self.generation:
+            import jax.numpy as jnp
+
+            pc = place_centroids or jnp.asarray
+            pk = place_cells or jnp.asarray
+            self._dev = (pc(self.centroids), pk(self.cell_rows))
+            self._dev_gen = self.generation
+        return self._dev
+
+
+# -- the probe program core ---------------------------------------------------
+
+
+def _dequant_j(q_tree: Dict):
+    import jax.numpy as jnp
+
+    emb = q_tree[E.ANN_TENSOR]
+    if E.ANN_SCALE in q_tree:
+        return emb.astype(jnp.float32) * q_tree[E.ANN_SCALE][:, None]
+    return emb.astype(jnp.float32)
+
+
+def scan_slots() -> int:
+    """Candidate-slot chunk of the probe scan: bounds the transient
+    (Q, slots, D) gathered-embedding tile."""
+    return env_int("DUKE_IVF_SCAN_SLOTS", 1024)
+
+
+def ivf_probe_topc(q_tree, emb_tree, centroids, cell_rows, corpus_valid,
+                   corpus_deleted, corpus_group, query_group, query_row, *,
+                   top_c: int, nprobe: int, slot_chunk: int,
+                   group_filtering: bool, row_offset=0):
+    """Two-stage cell-probe retrieval: (top_sim, top_index) with GLOBAL
+    row indices, same contract as ``ops.encoder.retrieval_scan``.
+
+    Usable both under plain jit (row_offset=0) and inside shard_map
+    (``cell_rows`` is the shard's local (K, B) block of local row ids;
+    ``row_offset`` maps them to global ids, exactly as in
+    ``parallel.sharded``'s scan).  The eligibility mask is
+    ``ops.scoring.candidate_mask_gathered`` — the one-place policy.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from . import scoring as S
+
+    qf = _dequant_j(q_tree)                      # (Q, D) f32
+    q = qf.shape[0]
+    cell_scores = qf @ centroids.T.astype(jnp.float32)   # (Q, K) tiny
+    _, cells = lax.top_k(cell_scores, nprobe)            # (Q, P)
+    bucket = cell_rows.shape[1]
+    cand = jnp.take(cell_rows, cells.reshape(-1), axis=0).reshape(
+        q, nprobe * bucket
+    )                                            # local rows, -1 padded
+    total = nprobe * bucket
+    step = min(slot_chunk, _pow2(total))
+    nsteps = -(-total // step)
+    if nsteps * step != total:
+        cand = jnp.pad(cand, ((0, 0), (0, nsteps * step - total)),
+                       constant_values=-1)
+
+    emb = emb_tree[E.ANN_TENSOR]
+    scale = emb_tree.get(E.ANN_SCALE)
+    neg = jnp.float32(S.NEG_INF)
+    init_sim = jnp.full((q, top_c), neg, jnp.float32)
+    init_idx = jnp.full((q, top_c), -1, jnp.int32)
+
+    def body(carry, si):
+        top_sim, top_idx = carry
+        rows = lax.dynamic_slice_in_dim(cand, si * step, step, axis=1)
+        safe = jnp.clip(rows, 0)
+        flat = safe.reshape(-1)
+        emb_g = jnp.take(emb, flat, axis=0).reshape(q, step, -1)
+        if scale is not None:
+            raw = jnp.einsum(
+                "qd,qsd->qs", q_tree[E.ANN_TENSOR], emb_g,
+                preferred_element_type=jnp.int32,
+            ).astype(jnp.float32)
+            sims = (raw * q_tree[E.ANN_SCALE][:, None]
+                    * jnp.take(scale, flat).reshape(q, step))
+        else:
+            sims = jnp.einsum(
+                "qd,qsd->qs",
+                q_tree[E.ANN_TENSOR].astype(jnp.bfloat16),
+                emb_g.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+        grows = jnp.where(rows >= 0, rows + row_offset, -1)
+        mask = S.candidate_mask_gathered(
+            jnp.take(corpus_valid, flat).reshape(q, step),
+            jnp.take(corpus_deleted, flat).reshape(q, step),
+            jnp.take(corpus_group, flat).reshape(q, step),
+            grows, query_group, query_row, group_filtering,
+        )
+        sims = jnp.where(mask, sims, neg)
+        # carry first: top_k's positional tie-break keeps -1 sentinels
+        # from being displaced by all-masked slots (same invariant as
+        # retrieval_scan's merge)
+        merged_sim = jnp.concatenate([top_sim, sims], axis=1)
+        merged_idx = jnp.concatenate([top_idx, grows], axis=1)
+        top_sim, sel = lax.top_k(merged_sim, top_c)
+        top_idx = jnp.take_along_axis(merged_idx, sel, axis=1)
+        return (top_sim, top_idx), None
+
+    (top_sim, top_idx), _ = lax.scan(
+        body, (init_sim, init_idx), jnp.arange(nsteps, dtype=jnp.int32)
+    )
+    return top_sim, top_idx
+
+
+def build_ivf_scorer(
+    plan,
+    *,
+    top_c: int,
+    nprobe: int,
+    group_filtering: bool = False,
+    queries_from_rows: bool = False,
+) -> "object":
+    """The jitted single-device IVF scoring program.
+
+    Signature (the flat ``ops.scoring.build_ann_scorer`` convention plus
+    the two IVF tensors)::
+
+        fn(q_emb, qfeats, emb_tree, centroids, cell_rows, corpus_feats,
+           corpus_valid, corpus_deleted, corpus_group, query_group,
+           query_row, min_logit) -> (top_logit, top_index, count)
+
+    ``count`` carries the same saturation semantics (above-bound
+    candidates, widened by the int8 cosine-ambiguity credit) so the
+    shared escalation loop drives nprobe/C growth.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from . import scoring as S
+
+    pair_logits = S.build_gathered_pair_logits(plan)
+    slot_chunk = scan_slots()
+
+    @jax.jit
+    def score(q_emb, qfeats, emb_tree, centroids, cell_rows, corpus_feats,
+              corpus_valid, corpus_deleted, corpus_group, query_group,
+              query_row, min_logit):
+        if queries_from_rows:
+            qrows = jnp.clip(query_row, 0)
+            q_tree = {
+                name: jnp.take(arr, qrows, axis=0)
+                for name, arr in emb_tree.items()
+            }
+            qfeats = S.gather_rows(corpus_feats, qrows)
+        else:
+            q_tree = E.as_emb_tree(q_emb)
+        top_sim, top_index = ivf_probe_topc(
+            q_tree, emb_tree, centroids, cell_rows, corpus_valid,
+            corpus_deleted, corpus_group, query_group, query_row,
+            top_c=top_c, nprobe=nprobe, slot_chunk=slot_chunk,
+            group_filtering=group_filtering,
+        )
+        return S.rescore_retrieved(
+            pair_logits, qfeats, corpus_feats, top_sim, top_index,
+            min_logit, amb_eps=S.retrieval_amb_eps(q_tree, emb_tree),
+        )
+
+    return score

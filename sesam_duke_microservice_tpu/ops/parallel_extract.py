@@ -239,9 +239,13 @@ def extract_batch_parallel(plan, records, *, encoder=None
             shm = next(s for s in segments if s.name == shm_name)
             view = np.ndarray(shape, dtype=np.dtype(dtype), buffer=shm.buf)
             if (prop, tname) == ("__ann__", "emb_f32"):
-                out[E.ANN_PROP] = {
-                    E.ANN_TENSOR: view.astype(E.STORAGE_DTYPE)
-                }
+                # parent-side storage conversion (workers always emit
+                # f32): bf16 cast, or int8 quantization + scale vector
+                # under DUKE_EMB_INT8 — the ONE conversion point shared
+                # with the serial path (ops.encoder.corpus_tensors_from_f32)
+                out[E.ANN_PROP] = E.corpus_tensors_from_f32(
+                    view, encoder.storage
+                )
             else:
                 out[prop][tname] = view.copy()
         return out

@@ -476,6 +476,99 @@ def candidate_mask(cvalid, cdeleted, cgroup, cidx, query_group, query_row,
     return mask & (cidx[None, :] != query_row[:, None])
 
 
+def candidate_mask_gathered(gvalid, gdeleted, ggroup, grows, query_group,
+                            query_row, group_filtering: bool):
+    """``candidate_mask`` for ALIGNED gathered candidates: all operands
+    are (Q, S) per-query gathers (IVF probe scan, ops.ivf) plus the
+    global row ids ``grows`` (-1 for padding slots).  Same policy, same
+    one place: live non-tombstoned, group exclusion, self-row exclusion
+    — plus the padding-slot exclusion the gathered layout introduces."""
+    mask = (grows >= 0) & gvalid & ~gdeleted
+    if group_filtering:
+        mask = mask & (ggroup != query_group[:, None])
+    return mask & (grows != query_row[:, None])
+
+
+def retrieval_amb_eps(q_tree, emb_tree):
+    """Quantization ambiguity credit for the recall-escalation trigger:
+    the certified per-block cosine error bound under int8 storage
+    (``ops.encoder.int8_cosine_eps_dynamic`` — derived from the block's
+    ACTUAL row scales), or None for float storage (where the trigger
+    stays exactly the pre-int8 predicate)."""
+    from . import encoder as E
+
+    if E.is_int8_tree(emb_tree):
+        return E.int8_cosine_eps_dynamic(q_tree, emb_tree)
+    return None
+
+
+def saturation_count(logits, top_sim, retrieved, min_logit, amb_eps):
+    """ONE copy of the escalation-count predicate shared by every
+    retrieval tail (single-device flat/IVF and the per-shard sharded
+    tails): above-``min_logit`` candidates, plus — under int8 storage —
+    the quantization-ambiguity credit.
+
+    ``amb_eps`` (None for float storage) widens the saturation trigger:
+    when the retrieved set is FULL, a candidate whose retrieval cosine
+    sits within ``2 * amb_eps`` of the top-C cutoff AND whose exact
+    rescore clears the pruning bound counts as saturation evidence a
+    second time — a true candidate displaced by quantization error (the
+    dropped one's exact cosine can exceed the cutoff by at most 2*eps)
+    is cosine-adjacent to exactly these band members, and if they matter
+    after rescoring, the dropped neighbor could too, so the search
+    escalates instead of silently eating recall.  The above-bound
+    conjunct is what keeps the credit a *saturation* signal and not a
+    tail-density detector: it reasons from rescored evidence, the same
+    way the original "every retrieved candidate cleared the bound"
+    predicate does — a dense cosine tail of non-matches at the cutoff
+    (the common no-match query) takes no credit and cannot ladder
+    (measured: the unconditioned band escalated routinely on the
+    stresstest corpus; this form matches the bf16 path's zero).  With
+    the credit absent (or eps 0) this is bit-identical to the pre-int8
+    predicate (no retrieved cosine is strictly below the cutoff).  A
+    non-full retrieved set means retrieval never truncated, so no
+    ambiguity credit applies (and tiny corpora cannot trigger pointless
+    escalation ladders)."""
+    import jax.numpy as jnp
+
+    above = logits > min_logit
+    count = above.sum(axis=1).astype(jnp.int32)
+    if amb_eps is not None:
+        full = retrieved.all(axis=1)
+        cutoff = top_sim[:, -1:]  # sorted desc: the smallest retrieved
+        amb = ((top_sim < cutoff + 2.0 * amb_eps)
+               & retrieved & above).sum(axis=1).astype(jnp.int32)
+        count = count + jnp.where(full, amb, 0)
+    return count
+
+
+def rescore_retrieved(pair_logits, qfeats, corpus_feats, top_sim, top_index,
+                      min_logit, *, amb_eps=None):
+    """The shared tail of every two-stage retrieval program (flat ANN and
+    IVF): gather the retrieved rows' feature tensors, score them with the
+    exact per-property kernels, and derive the escalation count
+    (``saturation_count`` — ``amb_eps`` documented there)."""
+    import jax.numpy as jnp
+
+    retrieved = top_index >= 0
+    top_c = top_index.shape[1]
+    rows = jnp.clip(top_index, 0).reshape(-1)
+    q = top_index.shape[0]
+    cfeats = {
+        prop: {
+            name: jnp.take(arr, rows, axis=0).reshape(
+                (q, top_c) + arr.shape[1:]
+            )
+            for name, arr in tensors.items()
+        }
+        for prop, tensors in corpus_feats.items()
+    }
+    logits = pair_logits(qfeats, cfeats)
+    logits = jnp.where(retrieved, logits, NEG_INF)
+    count = saturation_count(logits, top_sim, retrieved, min_logit, amb_eps)
+    return logits, top_index, count
+
+
 def build_gathered_pair_logits(plan: F.SchemaFeatures) -> Callable:
     """Returns fn(qfeats (Q,...), cfeats gathered (Q, C, ...)) -> (Q, C).
 
@@ -527,6 +620,13 @@ def build_ann_scorer(
 
     ``count_above`` saturating at ``top_c`` signals the caller to escalate C
     (recall escalation — the ANN analogue of the brute-force K-escalation).
+    Under int8 embedding storage (DUKE_EMB_INT8) the count additionally
+    credits quantization-ambiguous candidates at the retrieval cutoff —
+    see ``rescore_retrieved``.
+
+    ``corpus_emb`` (and ``q_emb`` when not from rows) accept the
+    ANN_PROP tensor dict — ``{emb}`` for bf16 storage, ``{emb, scale}``
+    for int8 — or a bare bf16 matrix (legacy convention).
 
     ``queries_from_rows``: as in ``build_corpus_scorer`` — ``q_emb`` and
     ``qfeats`` are ignored (pass empty placeholders) and both are gathered
@@ -540,31 +640,25 @@ def build_ann_scorer(
     def score(q_emb, qfeats, corpus_emb, corpus_feats, corpus_valid,
               corpus_deleted, corpus_group, query_group, query_row,
               min_logit):
+        emb_tree = E.as_emb_tree(corpus_emb)
         if queries_from_rows:
             qrows = jnp.clip(query_row, 0)
-            q_emb = jnp.take(corpus_emb, qrows, axis=0)
+            q_tree = {
+                name: jnp.take(arr, qrows, axis=0)
+                for name, arr in emb_tree.items()
+            }
             qfeats = gather_rows(corpus_feats, qrows)
+        else:
+            q_tree = E.as_emb_tree(q_emb)
         top_sim, top_index = E.retrieval_scan(
-            q_emb, corpus_emb, corpus_valid, corpus_deleted, corpus_group,
+            q_tree, emb_tree, corpus_valid, corpus_deleted, corpus_group,
             query_group, query_row,
             chunk=chunk, top_c=top_c, group_filtering=group_filtering,
         )
-        retrieved = top_index >= 0
-        rows = jnp.clip(top_index, 0).reshape(-1)
-        q = top_index.shape[0]
-        cfeats = {
-            prop: {
-                name: jnp.take(arr, rows, axis=0).reshape(
-                    (q, top_c) + arr.shape[1:]
-                )
-                for name, arr in tensors.items()
-            }
-            for prop, tensors in corpus_feats.items()
-        }
-        logits = pair_logits(qfeats, cfeats)
-        logits = jnp.where(retrieved, logits, NEG_INF)
-        count = (logits > min_logit).sum(axis=1).astype(jnp.int32)
-        return logits, top_index, count
+        return rescore_retrieved(
+            pair_logits, qfeats, corpus_feats, top_sim, top_index,
+            min_logit, amb_eps=retrieval_amb_eps(q_tree, emb_tree),
+        )
 
     return score
 

@@ -4,18 +4,29 @@ merge over ICI.
 Scale-out of the two-stage ANN program (``ops.scoring.build_ann_scorer``)
 over a 1-D device mesh, following the same layout as the brute-force
 sharded scorer (``parallel.sharded``): corpus tensors (including the
-``ops.encoder`` embedding matrix riding as a pseudo-property) sharded on
-the record axis, queries replicated.
+``ops.encoder`` embedding tree riding as a pseudo-property — the int8
+scale vector shards with it) sharded on the record axis, queries
+replicated.
 
 Per-shard work is fully local: cosine top-C over the local embedding rows
-(one bf16 matmul per chunk), then exact rescoring of the local candidates —
-feature gathers never cross shards.  Only the (Q, C) scored results move:
-``all_gather`` over ICI collects every shard's (logit, global_row) pairs
-((D, Q, C) — C is tiny) and each device reduces them to the global top-C.
-Communication is O(Q * C * D) while compute scales 1/D — the candidate
-matrix never materializes anywhere, matching the design target of
-SURVEY.md §5.7 (ring/allgather sharded candidate retrieval at 10M-record
-scale, BASELINE.json configs[4]).
+(one bf16 — or int8 x int8 -> int32 — matmul per chunk), then exact
+rescoring of the local candidates — feature gathers never cross shards.
+Only the (Q, C) scored results move: ``all_gather`` over ICI collects
+every shard's (logit, global_row) pairs ((D, Q, C) — C is tiny) and each
+device reduces them to the global top-C.  Communication is O(Q * C * D)
+while compute scales 1/D — the candidate matrix never materializes
+anywhere, matching the design target of SURVEY.md §5.7 (ring/allgather
+sharded candidate retrieval at 10M-record scale, BASELINE.json
+configs[4]).
+
+IVF placement (ISSUE 9) follows the SNIPPETS.md pjit partition-rule
+pattern — shard the big per-row state, replicate the small lookup
+tables: the ``(nshards * K, B)`` cell-membership matrix of shard-LOCAL
+row ids is placed ``P(SHARD_AXIS)`` (each shard_map instance sees
+exactly its own (K, B) block) while the tiny (K, D) centroid matrix
+rides replicated ``P()``.  Every shard probes the same top-``nprobe``
+cells (the replicated stage-1 matmul is identical everywhere) and scans
+only its local members of those cells.
 
 Because every shard keeps its own local top-C before the merge, the merged
 candidate pool is a superset of the single-device pool (which keeps a
@@ -34,8 +45,60 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops import encoder as E
+from ..ops import ivf as IVF
 from ..ops import scoring as S
 from .sharded import SHARD_AXIS
+
+
+def _local_rescore_merge(pair_logits, q_tree, qfeats, feats, emb_tree,
+                         top_sim, top_index, row_offset, min_logit, *,
+                         top_c: int, ndev: int):
+    """Shared tail of both sharded ANN programs: local exact rescoring of
+    the shard's retrieved candidates (gathers never cross shards), the
+    shared ``scoring.saturation_count`` predicate on the local count (a
+    local top-C whose int8 cutoff band holds quantization-ambiguous
+    candidates may have truncated a true candidate BEFORE the merge),
+    and the all_gather global top-C merge."""
+    retrieved = top_index >= 0
+    local_rows = jnp.clip(top_index - row_offset, 0).reshape(-1)
+    q = top_index.shape[0]
+    cfeats = {
+        prop: {
+            name: jnp.take(arr, local_rows, axis=0).reshape(
+                (q, top_c) + arr.shape[1:]
+            )
+            for name, arr in tensors.items()
+        }
+        for prop, tensors in feats.items()
+    }
+    logits = pair_logits(qfeats, cfeats)
+    logits = jnp.where(retrieved, logits, S.NEG_INF)
+    local_count = S.saturation_count(
+        logits, top_sim, retrieved, min_logit,
+        S.retrieval_amb_eps(q_tree, emb_tree),
+    )
+
+    # merge: (D, Q, C) gathered over ICI, reduced to global top-C
+    all_logit = lax.all_gather(logits, SHARD_AXIS)
+    all_index = lax.all_gather(top_index, SHARD_AXIS)
+    merged_logit = jnp.transpose(all_logit, (1, 0, 2)).reshape(
+        q, ndev * top_c
+    )
+    merged_index = jnp.transpose(all_index, (1, 0, 2)).reshape(
+        q, ndev * top_c
+    )
+    out_logit, sel = lax.top_k(merged_logit, top_c)
+    out_index = jnp.take_along_axis(merged_index, sel, axis=1)
+    # escalation signal must see BOTH truncation modes: a shard whose
+    # local top-C saturated (may have dropped above-bound rows before
+    # the merge), and a merged pool with more above-bound rows than the
+    # merge keeps (indices are unique across shards, so counting the
+    # merged pool counts each candidate once)
+    merged_above = (merged_logit > min_logit).sum(axis=1).astype(jnp.int32)
+    count_sat = jnp.maximum(
+        lax.pmax(local_count, SHARD_AXIS), merged_above
+    )
+    return out_logit, out_index, count_sat
 
 
 def build_sharded_ann_scorer(
@@ -55,12 +118,14 @@ def build_sharded_ann_scorer(
         -> (top_logit (Q, C), top_index (Q, C) global rows, count_sat (Q,))
 
     ``corpus_feats`` must include the ``ops.encoder.ANN_PROP`` embedding
-    pseudo-property and be placed record-axis sharded (``ShardedCorpus``);
-    queries are replicated.  ``count_sat`` is the recall-escalation signal:
-    the max of (a) any shard's local above-``min_logit`` count (a saturated
-    local top-C may have truncated before the merge) and (b) the merged
-    pool's above-bound count (the merge itself truncates when more than
-    ``top_c`` survive).  The caller escalates when ``count_sat >= top_c``.
+    tree ({emb} bf16 or {emb, scale} int8) and be placed record-axis
+    sharded (``ShardedCorpus``); queries are replicated.  ``count_sat``
+    is the recall-escalation signal: the max of (a) any shard's local
+    above-``min_logit`` count — widened by the int8 cosine-ambiguity
+    credit — (a saturated local top-C may have truncated before the
+    merge) and (b) the merged pool's above-bound count (the merge itself
+    truncates when more than ``top_c`` survive).  The caller escalates
+    when ``count_sat >= top_c``.
     """
     pair_logits = S.build_gathered_pair_logits(plan)
     ndev = mesh.size
@@ -85,57 +150,86 @@ def build_sharded_ann_scorer(
         shard = lax.axis_index(SHARD_AXIS)
         row_offset = shard.astype(jnp.int32) * jnp.int32(local_cap)
 
-        corpus_emb = corpus_feats[E.ANN_PROP][E.ANN_TENSOR]
+        emb_tree = E.as_emb_tree(corpus_feats[E.ANN_PROP])
         feats = {
             prop: tensors for prop, tensors in corpus_feats.items()
             if prop != E.ANN_PROP
         }
 
         # stage 1: local cosine top-C (global row ids via row_offset)
+        q_tree = E.as_emb_tree(q_emb)
         top_sim, top_index = E.retrieval_scan(
-            q_emb, corpus_emb, corpus_valid, corpus_deleted, corpus_group,
-            query_group, query_row,
+            q_tree, emb_tree, corpus_valid, corpus_deleted,
+            corpus_group, query_group, query_row,
             chunk=chunk, top_c=top_c, group_filtering=group_filtering,
             row_offset=row_offset,
         )
-        retrieved = top_index >= 0
+        return _local_rescore_merge(
+            pair_logits, q_tree, qfeats, feats, emb_tree, top_sim,
+            top_index, row_offset, min_logit, top_c=top_c, ndev=ndev,
+        )
 
-        # stage 2: exact rescoring of the local candidates (local gather)
-        local_rows = jnp.clip(top_index - row_offset, 0).reshape(-1)
-        q = top_index.shape[0]
-        cfeats = {
-            prop: {
-                name: jnp.take(arr, local_rows, axis=0).reshape(
-                    (q, top_c) + arr.shape[1:]
-                )
-                for name, arr in tensors.items()
-            }
-            for prop, tensors in feats.items()
+    return jax.jit(score_shard)
+
+
+def build_sharded_ivf_scorer(
+    plan,
+    mesh: Mesh,
+    *,
+    top_c: int = 64,
+    nprobe: int = 8,
+    group_filtering: bool = False,
+) -> Callable:
+    """IVF cell-probe retrieval over the mesh (ISSUE 9).
+
+    Signature (the sharded flat convention plus the two IVF tensors)::
+
+        fn(q_emb, qfeats, corpus_feats, centroids, cell_rows,
+           corpus_valid, corpus_deleted, corpus_group, query_group,
+           query_row, min_logit) -> (top_logit, top_index, count_sat)
+
+    ``centroids`` ride replicated; ``cell_rows`` is the stacked
+    ``(mesh.size * K, B)`` shard-LOCAL membership matrix placed
+    ``P(SHARD_AXIS)`` so each shard_map instance sees its own (K, B)
+    block (``ops.ivf.IvfState`` builds exactly this layout).
+    """
+    pair_logits = S.build_gathered_pair_logits(plan)
+    ndev = mesh.size
+    slot_chunk = IVF.scan_slots()
+
+    corpus_spec = P(SHARD_AXIS)
+    repl = P()
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(repl, repl, corpus_spec, repl, corpus_spec, corpus_spec,
+                  corpus_spec, corpus_spec, repl, repl, repl),
+        out_specs=(repl, repl, repl),
+        check_vma=False,
+    )
+    def score_shard(q_emb, qfeats, corpus_feats, centroids, cell_rows,
+                    corpus_valid, corpus_deleted, corpus_group, query_group,
+                    query_row, min_logit):
+        local_cap = corpus_valid.shape[0]
+        shard = lax.axis_index(SHARD_AXIS)
+        row_offset = shard.astype(jnp.int32) * jnp.int32(local_cap)
+
+        emb_tree = E.as_emb_tree(corpus_feats[E.ANN_PROP])
+        feats = {
+            prop: tensors for prop, tensors in corpus_feats.items()
+            if prop != E.ANN_PROP
         }
-        logits = pair_logits(qfeats, cfeats)
-        logits = jnp.where(retrieved, logits, S.NEG_INF)
-        local_count = (logits > min_logit).sum(axis=1).astype(jnp.int32)
-
-        # merge: (D, Q, C) gathered over ICI, reduced to global top-C
-        all_logit = lax.all_gather(logits, SHARD_AXIS)
-        all_index = lax.all_gather(top_index, SHARD_AXIS)
-        merged_logit = jnp.transpose(all_logit, (1, 0, 2)).reshape(
-            q, ndev * top_c
+        q_tree = E.as_emb_tree(q_emb)
+        top_sim, top_index = IVF.ivf_probe_topc(
+            q_tree, emb_tree, centroids, cell_rows,
+            corpus_valid, corpus_deleted, corpus_group, query_group,
+            query_row, top_c=top_c, nprobe=nprobe, slot_chunk=slot_chunk,
+            group_filtering=group_filtering, row_offset=row_offset,
         )
-        merged_index = jnp.transpose(all_index, (1, 0, 2)).reshape(
-            q, ndev * top_c
+        return _local_rescore_merge(
+            pair_logits, q_tree, qfeats, feats, emb_tree, top_sim,
+            top_index, row_offset, min_logit, top_c=top_c, ndev=ndev,
         )
-        out_logit, sel = lax.top_k(merged_logit, top_c)
-        out_index = jnp.take_along_axis(merged_index, sel, axis=1)
-        # escalation signal must see BOTH truncation modes: a shard whose
-        # local top-C saturated (may have dropped above-bound rows before
-        # the merge), and a merged pool with more above-bound rows than the
-        # merge keeps (indices are unique across shards, so counting the
-        # merged pool counts each candidate once)
-        merged_above = (merged_logit > min_logit).sum(axis=1).astype(jnp.int32)
-        count_sat = jnp.maximum(
-            lax.pmax(local_count, SHARD_AXIS), merged_above
-        )
-        return out_logit, out_index, count_sat
 
     return jax.jit(score_shard)

@@ -193,6 +193,9 @@ def make_app_collector(app):
         rows_samples = []
         capacity_samples = []
         shard_samples = []
+        emb_samples = []
+        ivf_cell_samples = []
+        ivf_probe_samples = []
         link_samples = []
         queue_samples = []
         warm_samples = []
@@ -259,11 +262,27 @@ def make_app_collector(app):
                     # HBM budget figure the sharding exists to bound)
                     shard_samples.append(
                         ("", labels, corpus.capacity // mesh.size))
+                # ANN embedding footprint (ISSUE 9): host-mirror bytes of
+                # the embedding tree == the device-resident bytes (same
+                # dtypes/shapes), so the int8 HBM win is scrape-visible
+                from ..ops import encoder as _E
+
+                emb_tree = corpus.feats.get(_E.ANN_PROP)
+                if emb_tree is not None:
+                    emb_samples.append(("", labels, float(sum(
+                        arr.nbytes for arr in emb_tree.values()
+                    ))))
             else:
                 try:
                     indexed = len(wl.index)
                 except TypeError:
                     pass
+            ivf = getattr(wl.index, "ivf", None)
+            if ivf is not None:
+                # DUKE_IVF state (0 cells = enabled but still untrained
+                # below DUKE_IVF_MIN_ROWS)
+                ivf_cell_samples.append(("", labels, float(ivf.ncells)))
+                ivf_probe_samples.append(("", labels, float(ivf.nprobe0)))
             if indexed is not None:
                 rows_samples.append(
                     ("", labels + (("state", "indexed"),), indexed))
@@ -383,6 +402,22 @@ def make_app_collector(app):
             out.append(FamilySnapshot(
                 "duke_corpus_capacity_rows", "gauge",
                 "Pre-allocated device corpus capacity", capacity_samples))
+        if emb_samples:
+            out.append(FamilySnapshot(
+                "duke_emb_bytes", "gauge",
+                "Bytes of the ANN embedding tree (codes + int8 scale "
+                "vector when DUKE_EMB_INT8) resident per corpus row set",
+                emb_samples))
+        if ivf_cell_samples:
+            out.append(FamilySnapshot(
+                "duke_ivf_cells", "gauge",
+                "Trained IVF k-means cells (0 = DUKE_IVF on but below "
+                "DUKE_IVF_MIN_ROWS, flat scan serving)", ivf_cell_samples))
+            out.append(FamilySnapshot(
+                "duke_ivf_probe_cells", "gauge",
+                "Cells probed per query at the initial candidate width "
+                "(escalation widens this in lockstep with top-C)",
+                ivf_probe_samples))
         if shard_samples:
             out.append(FamilySnapshot(
                 "duke_corpus_capacity_rows_per_shard", "gauge",

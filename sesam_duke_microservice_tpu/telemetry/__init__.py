@@ -88,6 +88,17 @@ SCORER_ESCALATIONS = GLOBAL.counter(
     "duke_scorer_escalations_total",
     "K/C-escalation re-runs of the device scoring program",
 )
+# stage-attributed escalation series (ISSUE 9): which retrieval stage
+# saturated — brute-force top-K, flat-ANN top-C, or the IVF cell probe
+# (whose ladder widens nprobe and terminally falls back to the flat
+# scan).  The label set is closed (three stages), written only on the
+# rare escalation path.
+RETRIEVAL_ESCALATIONS = GLOBAL.counter(
+    "duke_retrieval_escalations_total",
+    "Retrieval-width escalation re-runs by saturated stage "
+    "(top_k = brute force, top_c = flat ANN, ivf = cell probe)",
+    ("stage",),
+)
 
 # -- streaming encode (engine/device_matcher.py) -----------------------------
 # Unlocked: incremented by the thread holding the workload lock (same

@@ -75,7 +75,8 @@ def test_parallel_matches_serial_bit_exact():
     par = PX.extract_batch_parallel(plan, records, encoder=enc)
     assert par is not None
     ser = F._extract_serial(plan, records)
-    ser[E.ANN_PROP] = {E.ANN_TENSOR: enc.encode_corpus(records)}
+    # storage-mode-aware ({emb} bf16, or {emb, scale} under DUKE_EMB_INT8)
+    ser[E.ANN_PROP] = enc.corpus_tensors(records)
 
     assert set(par) == set(ser)
     for prop in ser:
