@@ -93,6 +93,16 @@ IVF_BENCH = os.environ.get("BENCH_IVF", "1") != "0"
 IVF_CORPUS = int(os.environ.get("BENCH_IVF_CORPUS", "20000"))
 IVF_QUERIES = int(os.environ.get("BENCH_IVF_QUERIES", "2048"))
 
+# durability bench (ISSUE 10): e2e ingest records/s on the finalize-
+# bound duplicate-heavy corpus with the link journal off vs each sync
+# policy (none / fdatasync / fsync), plus recovery-replay throughput
+# over a synthesized journal — so the DUKE_JOURNAL_SYNC default is a
+# measured trade (fsync cost vs loss window), not a guess.
+# BENCH_DURABILITY=0 skips it.
+DURABILITY = os.environ.get("BENCH_DURABILITY", "1") != "0"
+DURA_RECOVERY_BATCHES = int(
+    os.environ.get("BENCH_DURA_RECOVERY_BATCHES", "10000"))
+
 # warm-resync ingest bench (this round's encode subsystem): re-POST an
 # already-ingested corpus — the reference's full-resync traffic shape —
 # and compare records/s cold (empty feature cache) vs warm (digest hits)
@@ -431,6 +441,109 @@ def e2e_ingest(schema) -> dict:
         "queries_per_batch": E2E_QUERIES,
         "dup_group": E2E_GROUP,
     }
+
+
+def _durability_arm(schema, tmpdir, mode: str) -> float:
+    """e2e ingest records/s (same finalize-bound corpus shape as the
+    ``e2e`` section, write-behind on) with the link journal configured
+    per ``mode``: 'off', or sync policy 'none'/'fdatasync'/'fsync'."""
+    from sesam_duke_microservice_tpu.engine.device_matcher import (
+        DeviceIndex,
+        DeviceProcessor,
+    )
+    from sesam_duke_microservice_tpu.engine.listeners import LinkMatchListener
+    from sesam_duke_microservice_tpu.links.journal import LinkJournal
+    from sesam_duke_microservice_tpu.links.sqlite import SqliteLinkDatabase
+    from sesam_duke_microservice_tpu.links.write_behind import (
+        WriteBehindLinkDatabase,
+    )
+
+    linkdb = SqliteLinkDatabase(os.path.join(tmpdir, f"links-{mode}.sqlite"))
+    journal = (None if mode == "off" else LinkJournal(
+        os.path.join(tmpdir, f"links-{mode}.journal"), sync=mode))
+    db = WriteBehindLinkDatabase(linkdb, journal=journal)
+    listener = LinkMatchListener(db)
+
+    index = DeviceIndex(schema)
+    proc = DeviceProcessor(schema, index, threads=(os.cpu_count() or 2))
+    proc.add_match_listener(listener)
+
+    corpus = duplicate_group_records(E2E_CORPUS, E2E_GROUP, seed=42,
+                                     dataset=f"dura-{mode}")
+    for r in corpus:
+        index.index(r)
+    index.commit()
+    warm = duplicate_group_records(E2E_QUERIES, E2E_GROUP, seed=42,
+                                   dataset=f"durawarm{mode}")
+    proc.deduplicate(warm)
+    for r in warm:
+        index.delete(r)
+
+    t0 = time.perf_counter()
+    for run in range(E2E_RUNS):
+        batch = duplicate_group_records(
+            E2E_QUERIES, E2E_GROUP, seed=42, dataset=f"dura{mode}{run}"
+        )
+        proc.deduplicate(batch)
+        for r in batch:
+            index.delete(r)
+    db.drain()
+    dt = time.perf_counter() - t0
+    db.close()
+    return round(E2E_RUNS * E2E_QUERIES / dt, 1)
+
+
+def durability_bench(schema) -> dict:
+    """Journal-cost + recovery-throughput measurements (ISSUE 10).
+
+    The ingest arms share the e2e corpus shape so the per-mode rates are
+    directly comparable with the headline ``e2e`` number; the recovery
+    arm synthesizes DURA_RECOVERY_BATCHES journaled batches and times a
+    cold ``recover()`` into a fresh sqlite store — the restart cost an
+    operator pays per 10k stranded (acked-but-unflushed) batches."""
+    import tempfile
+
+    from sesam_duke_microservice_tpu.links.journal import LinkJournal
+    from sesam_duke_microservice_tpu.links.sqlite import SqliteLinkDatabase
+    from sesam_duke_microservice_tpu.links.write_behind import (
+        WriteBehindLinkDatabase,
+    )
+
+    out = {"ingest_records_per_sec": {}}
+    with tempfile.TemporaryDirectory(prefix="duke-dura-bench") as tmpdir:
+        for mode in ("off", "none", "fdatasync", "fsync"):
+            out["ingest_records_per_sec"][mode] = _durability_arm(
+                schema, tmpdir, mode)
+
+        # recovery replay: N small journaled batches, no watermark
+        jpath = os.path.join(tmpdir, "recovery.journal")
+        journal = LinkJournal(jpath, sync="none")
+        for i in range(DURA_RECOVERY_BATCHES):
+            journal.append_batch([
+                (f"a{i}", f"b{i}", "inferred", "duplicate", 0.9,
+                 1_000_000 + i),
+            ])
+        journal.close()
+        inner = SqliteLinkDatabase(os.path.join(tmpdir, "recovery.sqlite"))
+        db = WriteBehindLinkDatabase(inner, journal=LinkJournal(jpath))
+        t0 = time.perf_counter()
+        replayed = db.recover()
+        dt = time.perf_counter() - t0
+        assert replayed == DURA_RECOVERY_BATCHES
+        db.close()
+        out["recovery"] = {
+            "batches": replayed,
+            "seconds": round(dt, 3),
+            "batches_per_sec": round(replayed / dt, 1),
+            "seconds_per_10k_batches": round(dt * 10000 / replayed, 3),
+        }
+    base = out["ingest_records_per_sec"]["off"]
+    out["journal_overhead"] = {
+        mode: round(1 - out["ingest_records_per_sec"][mode] / base, 4)
+        for mode in ("none", "fdatasync", "fsync")
+    }
+    out["default_sync"] = "fdatasync"
+    return out
 
 
 def warm_resync(schema) -> dict:
@@ -1039,6 +1152,8 @@ def main():
         result["concurrent"] = concurrent_bench()
     if IVF_BENCH and BACKEND == "device":
         result["ivf"] = ivf_bench(schema)
+    if DURABILITY and BACKEND == "device":
+        result["durability"] = durability_bench(schema)
     print(json.dumps(result))
     print(
         f"# cpu_baseline={cpu_rate:.0f} pairs/s, device median-of-{len(rates)}"

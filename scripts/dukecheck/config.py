@@ -33,6 +33,10 @@ RECEIVER_TYPES = {
     "scheduler": ("IngestScheduler",),
     "corpus": ("DeviceCorpus",),
     "database": ("DeviceIndex", "AnnIndex"),
+    "journal": ("LinkJournal",),
+    # chaos plan (utils.faults.active() return): its occurrence counters
+    # lock, so crash/flush checks under engine locks are real edges
+    "plan": ("FaultPlan",),
 }
 
 # methods that RETURN a lock/guard used as `with self.m():` — resolved to
@@ -47,6 +51,12 @@ CALLBACK_TARGETS = {
     ("WriteBehindBuffer", "_flush"): (
         "WriteBehindLinkDatabase._flush_batch",
         "AuditLog._write_batch",
+    ),
+    # batch-sealing hook (ISSUE 10): commit() journals the sealed batch
+    # under the buffer condition, so _cv -> LinkJournal._lock is a real
+    # static edge the resolver must see through the callable field
+    ("WriteBehindBuffer", "_seal"): (
+        "WriteBehindLinkDatabase._seal_batch",
     ),
     ("IngestScheduler", "_resolve"): ("DukeApp._resolve_workload",),
 }
@@ -119,6 +129,12 @@ MANUAL_EDGES = (
     ("Dispatcher.op_lock", "telemetry.decisions._AUDIT_LOCK",
      "audit_log() singleton resolution during a promoted-leader "
      "listener flush under the mesh op lock"),
+    # -- crash-consistent ingest (ISSUE 10) --
+    ("DukeApp._swap_lock", "links.journal._RECOVERY_LOCK",
+     "config reload builds workloads under the swap lock; the link-DB "
+     "factory's journal recovery enters the recovery_in_progress() "
+     "contextmanager (readyz 'recovering' flag) — the with-statement "
+     "indirection the analyzer cannot follow"),
 )
 
 # -- checker 5 (single-writer metrics) ---------------------------------------

@@ -1131,6 +1131,37 @@ class DeviceIndex(CandidateIndex):
     # any mismatch (schema change, env-sized tensor shapes, store drift)
     # silently falls back to full replay.
 
+    @staticmethod
+    def _snapshot_checksum(entries: Dict[str, np.ndarray]) -> str:
+        """Content checksum over the snapshot's payload arrays (ISSUE 10):
+        CRC32 chained over (key, dtype, shape, bytes) in sorted key
+        order.  Stamped as ``__checksum`` at save and re-derived from
+        the as-stored arrays at load, so a flipped byte, a swapped
+        member, or a partially-written archive is rejected into a store
+        replay instead of silently scoring corrupt features (the zip
+        layer's per-member CRC catches most of this; the stamp also
+        catches member-level substitution and pre-decompression
+        truncation modes it cannot)."""
+        import zlib as _zlib
+
+        crc = 0
+        for key in sorted(entries):
+            arr = np.ascontiguousarray(entries[key])
+            meta = f"{key}\x1f{arr.dtype.str}\x1f{arr.shape}".encode()
+            crc = _zlib.crc32(arr.tobytes(), _zlib.crc32(meta, crc))
+        return format(crc & 0xFFFFFFFF, "08x")
+
+    def _snapshot_reject(self, reason: str, detail: str) -> bool:
+        """A snapshot check failed: warn + count, fall back to replay.
+        Never raises — the store remains the source of truth and a bad
+        snapshot must cost a rebuild, not availability."""
+        telemetry.SNAPSHOT_FALLBACKS.labels(reason=reason).inc()  # dukecheck: ignore[DK501] startup/reload-only rejection path, never per-batch
+        logger.warning(
+            "corpus snapshot rejected (%s: %s); replaying from the "
+            "record store", reason, detail,
+        )
+        return False
+
     def _snapshot_fingerprint(self) -> str:
         import hashlib
 
@@ -1183,6 +1214,19 @@ class DeviceIndex(CandidateIndex):
                     bf16_keys.append(key)
                     a = a.view(np.uint16)
                 flat[key] = a
+        # payload arrays also feed the stamped content checksum (same
+        # set the load-side verification re-derives)
+        payload = dict(flat)
+        payload["__row_valid"] = corpus.row_valid[: corpus.size]
+        payload["__row_deleted"] = corpus.row_deleted[: corpus.size]
+        payload["__row_group"] = corpus.row_group[: corpus.size]
+        # fixed-width unicode, NOT object dtype: object arrays
+        # pickle, and a pickle-bearing snapshot would force
+        # allow_pickle=True at load — an arbitrary-code-execution
+        # vector for anyone who can write the data volume
+        payload["__row_ids"] = np.array(
+            [rid or "" for rid in corpus.row_ids], dtype=str
+        )
         # write-then-rename: a SIGKILL mid-save must never leave a truncated
         # snapshot (np.load would fail and silently force a full replay)
         tmp = f"{path}.tmp.{os.getpid()}"
@@ -1197,6 +1241,7 @@ class DeviceIndex(CandidateIndex):
                 tmp,
                 __fingerprint=np.array(self._snapshot_fingerprint()),
                 __content=np.array(content_hash),
+                __checksum=np.array(self._snapshot_checksum(payload)),
                 __bf16_keys=np.array(bf16_keys, dtype=str),
                 __value_slots=np.array(
                     [s.v for s in self.plan.device_props], dtype=np.int64
@@ -1212,18 +1257,14 @@ class DeviceIndex(CandidateIndex):
                 __device_props=np.array(
                     [s.name for s in self.plan.device_props], dtype=str
                 ),
-                __row_valid=corpus.row_valid[: corpus.size],
-                __row_deleted=corpus.row_deleted[: corpus.size],
-                __row_group=corpus.row_group[: corpus.size],
-                # fixed-width unicode, NOT object dtype: object arrays
-                # pickle, and a pickle-bearing snapshot would force
-                # allow_pickle=True at load — an arbitrary-code-execution
-                # vector for anyone who can write the data volume
-                __row_ids=np.array(
-                    [rid or "" for rid in corpus.row_ids], dtype=str
-                ),
-                **flat,
+                **payload,
             )
+            # kill-differential site (ISSUE 10): die in the tmp-written/
+            # not-yet-renamed window — the restart must find the PREVIOUS
+            # snapshot (or none) intact and never the torn tmp
+            from ..utils import faults as _faults
+
+            _faults.check_crash("mid_snapshot_save")
             # np.savez appends .npz to names without it
             os.replace(tmp if tmp.endswith(".npz") else f"{tmp}.npz", path)
         except BaseException:
@@ -1253,9 +1294,11 @@ class DeviceIndex(CandidateIndex):
         try:
             with np.load(path) as data:  # no pickle: plain arrays only
                 if str(data["__fingerprint"]) != self._snapshot_fingerprint():
-                    return False
+                    return self._snapshot_reject(
+                        "fingerprint", "plan/env fingerprint changed")
                 if "__value_slots" not in data.files:
-                    return False
+                    return self._snapshot_reject(
+                        "schema", "missing __value_slots")
                 # re-apply persisted long-text demotions BEFORE the
                 # per-prop list compares (see snapshot_save __device_props)
                 if "__device_props" in data.files and self._auto_chars:
@@ -1273,28 +1316,36 @@ class DeviceIndex(CandidateIndex):
                         # starting demoted is conservative and exact
                         self._demote_to_host(missing)
                     if [s.name for s in self.plan.device_props] != saved:
-                        return False
+                        return self._snapshot_reject(
+                            "schema", "device property set changed")
                 slots = [int(x) for x in data["__value_slots"]]
                 if len(slots) != len(self.plan.device_props):
-                    return False
+                    return self._snapshot_reject(
+                        "schema", "value-slot count mismatch")
                 if self._auto_value_slots:
                     # snapshot written under a larger cap: replaying re-grows
                     # under the current one instead of adopting oversize axes
                     if any(v > _VALUE_SLOTS_MAX for v in slots):
-                        return False
+                        return self._snapshot_reject(
+                            "schema", "value slots exceed the current cap")
                 elif slots != [s.v for s in self.plan.device_props]:
-                    return False
+                    return self._snapshot_reject(
+                        "schema", "value-slot widths changed")
                 # per-property char widths (r4): absent key = pre-r4
                 # snapshot, valid only at the plan's default widths
                 if "__char_widths" in data.files:
                     widths = [int(x) for x in data["__char_widths"]]
                     if len(widths) != len(self.plan.device_props):
-                        return False
+                        return self._snapshot_reject(
+                            "schema", "char-width count mismatch")
                     if self._auto_chars:
                         if any(w > _CHARS_CAP for w in widths):
-                            return False
+                            return self._snapshot_reject(
+                                "schema",
+                                "char widths exceed the current cap")
                     elif widths != [s.chars for s in self.plan.device_props]:
-                        return False
+                        return self._snapshot_reject(
+                            "schema", "char widths changed")
                 else:
                     widths = [s.chars for s in self.plan.device_props]
                 # record CONTENT hash, not just the id set: an id-set check
@@ -1304,7 +1355,8 @@ class DeviceIndex(CandidateIndex):
                 expected = (content_hash if content_hash is not None
                             else _records_content_hash(records_by_id))
                 if str(data["__content"]) != expected:
-                    return False
+                    return self._snapshot_reject(
+                        "content", "record store drifted past the snapshot")
                 accepted_hash = bytes.fromhex(expected)
                 row_ids = list(data["__row_ids"])
                 row_valid = data["__row_valid"]
@@ -1314,23 +1366,41 @@ class DeviceIndex(CandidateIndex):
                     rid for rid, ok in zip(row_ids, row_valid) if ok
                 }
                 if live != set(records_by_id):
-                    return False
+                    return self._snapshot_reject(
+                        "content", "live row set differs from the store")
                 bf16_keys = (
                     {str(k) for k in data["__bf16_keys"]}
                     if "__bf16_keys" in data.files else set()
                 )
                 feats: Dict[str, Dict[str, np.ndarray]] = {}
+                # as-stored arrays, pre-bf16-view: the checksum stamp was
+                # computed over exactly these at save time
+                raw_payload: Dict[str, np.ndarray] = {
+                    "__row_ids": data["__row_ids"],
+                    "__row_valid": row_valid,
+                    "__row_deleted": row_deleted,
+                    "__row_group": row_group,
+                }
                 for key in data.files:
                     if not key.startswith("feat\x1f"):
                         continue
                     _, prop, name = key.split("\x1f", 2)
                     arr = data[key]
+                    raw_payload[key] = arr
                     if key in bf16_keys:
                         arr = arr.view(ml_dtypes.bfloat16)
                     feats.setdefault(prop, {})[name] = arr
-        except Exception:
+                # stamped content checksum (ISSUE 10); absent = pre-stamp
+                # snapshot, accepted for upgrade compatibility (the zip
+                # member CRCs still guard it)
+                if "__checksum" in data.files and (
+                        str(data["__checksum"])
+                        != self._snapshot_checksum(raw_payload)):
+                    return self._snapshot_reject(
+                        "checksum", "stamped content checksum mismatch")
+        except Exception as e:
             logger.exception("snapshot load failed; replaying from store")
-            return False
+            return self._snapshot_reject("corrupt", repr(e))
 
         # every check passed — only now adopt the snapshot's value-slot
         # and char widths (a rejected snapshot must leave the plan

@@ -37,6 +37,7 @@ from ..links import create_link_database
 from ..links.base import LinkDatabase
 from ..service.datasource import IncrementalDataSource
 from ..store.records import RecordStore
+from ..utils import faults
 from .listeners import ServiceMatchListener
 from .processor import Processor
 
@@ -257,6 +258,9 @@ class Workload:
                 try:
                     if self.record_store is not None:
                         self.record_store.put_many(records)
+                        # kill-differential site (ISSUE 10): store rows
+                        # durable, index/scoring/links not yet applied
+                        faults.check_crash("post_store_put")
                         put_done = True
                     deleted = [r for r in records if r.is_deleted()]
                     for record in deleted:
@@ -340,6 +344,7 @@ class Workload:
                     # durable source of truth first; the blocking index is a
                     # replayable cache of this store (SURVEY.md section 7)
                     self.record_store.put_many(records)
+                    faults.check_crash("post_store_put")
                     put_done = True
                 for record in deleted:
                     # tombstone in the index (still resolvable by the GET

@@ -1,5 +1,8 @@
+import logging
+
 from ..telemetry.env import env_flag
 from .base import Link, LinkStatus, LinkKind, LinkDatabase
+from .journal import LinkJournal
 from .memory import InMemoryLinkDatabase
 from .replica import PublishingLinkDatabase, ReplicaLinkDatabase
 from .sqlite import SqliteLinkDatabase
@@ -10,12 +13,15 @@ __all__ = [
     "LinkStatus",
     "LinkKind",
     "LinkDatabase",
+    "LinkJournal",
     "InMemoryLinkDatabase",
     "PublishingLinkDatabase",
     "ReplicaLinkDatabase",
     "SqliteLinkDatabase",
     "WriteBehindLinkDatabase",
 ]
+
+logger = logging.getLogger("links")
 
 
 def create_link_database(link_database_type: str, data_folder=None,
@@ -27,11 +33,17 @@ def create_link_database(link_database_type: str, data_folder=None,
     ``WriteBehindLinkDatabase`` so each batch's flush transaction
     overlaps the next microbatch's encode phase; every row-returning
     read drains first, so feed and lookup semantics are unchanged
-    (links.write_behind).  The in-memory backend is never wrapped —
-    its writes are microsecond list appends with nothing to overlap,
-    so the flusher thread and drain barriers would be pure overhead.
+    (links.write_behind).  Unless ``DUKE_JOURNAL=0``, the wrapper
+    additionally journals every sealed batch durably BEFORE it is acked
+    (links.journal) and replays journaled-but-unapplied batches here at
+    open — startup recovery, flagged to ``/readyz`` as ``recovering``
+    while it runs.  The in-memory backend is never wrapped — its writes
+    are microsecond list appends with nothing to overlap, so the flusher
+    thread and drain barriers would be pure overhead.
     """
     import os
+
+    from . import journal as journal_mod
 
     if link_database_type == "in-memory":
         return InMemoryLinkDatabase()
@@ -40,8 +52,51 @@ def create_link_database(link_database_type: str, data_folder=None,
             return InMemoryLinkDatabase()
         name = "recordlinkdatabase" if is_record_linkage else "linkdatabase"
         os.makedirs(data_folder, exist_ok=True)
+        journal_path = os.path.join(data_folder, name + ".journal")
+
+        def warn_stranded(why: str) -> None:
+            # a journal left by an earlier journaled run may hold acked
+            # batches the flusher never applied; with journaling off we
+            # deliberately leave it untouched (it replays when
+            # DUKE_JOURNAL is re-enabled — the opt-out legs must pin the
+            # legacy path exactly), but stranding durable acked data
+            # must never be silent
+            try:
+                size = os.path.getsize(journal_path)
+            except OSError:
+                return
+            if size > 0:
+                logger.warning(
+                    "%s: existing link journal %s (%d bytes) is NOT "
+                    "being replayed (%s); any acked-but-unapplied "
+                    "batches in it stay stranded until the service "
+                    "restarts with DUKE_JOURNAL=1",
+                    data_folder, journal_path, size, why,
+                )
+
         db = SqliteLinkDatabase(os.path.join(data_folder, name + ".sqlite"))
         if not env_flag("DUKE_WRITE_BEHIND", True):
+            # synchronous writes: durable before the ack by construction,
+            # nothing for a journal to add
+            warn_stranded("DUKE_WRITE_BEHIND=0")
             return db
-        return WriteBehindLinkDatabase(db)
+        if not env_flag("DUKE_JOURNAL", True):
+            # the enforced caveat (ISSUE 10): journal-less write-behind
+            # acks batches still in volatile memory — in-memory link-DB
+            # semantics for the window until the background flush lands.
+            # Said out loud at startup so the trade-off is a choice, not
+            # a surprise; an existing journal file is left untouched (it
+            # replays when DUKE_JOURNAL is re-enabled).
+            logger.warning(
+                "DUKE_JOURNAL=0: write-behind link batches for %s are "
+                "acknowledged before they are durable; a crash in that "
+                "window permanently loses acked links", data_folder,
+            )
+            warn_stranded("DUKE_JOURNAL=0")
+            return WriteBehindLinkDatabase(db)
+        journal = LinkJournal(journal_path)
+        wrapped = WriteBehindLinkDatabase(db, journal=journal)
+        with journal_mod.recovery_in_progress():
+            wrapped.recover()
+        return wrapped
     raise ValueError(f"Got an unknown 'link-database-type' value: '{link_database_type}'")

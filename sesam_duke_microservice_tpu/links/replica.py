@@ -138,6 +138,13 @@ class PublishingLinkDatabase(LinkDatabase):
     def flush_error(self) -> Optional[BaseException]:
         return getattr(self.inner, "flush_error", None)
 
+    @property
+    def journal(self):
+        """The wrapped write-behind database's durable journal, or None
+        — surfaced so the /metrics journal gauges see through this
+        wrapper on dispatcher-tagged workloads."""
+        return getattr(self.inner, "journal", None)
+
     def close(self) -> None:
         self.inner.close()
 

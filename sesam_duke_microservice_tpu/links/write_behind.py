@@ -16,31 +16,66 @@ Consistency contract (the link wrapper):
 
   * **Ordering** — writes apply in arrival order; ``commit()`` seals the
     current buffer as one batch and enqueues it (non-blocking).
+  * **Durability** (ISSUE 10) — with a ``LinkJournal`` attached (the
+    default for sqlite-backed workloads, ``DUKE_JOURNAL``), sealing a
+    batch appends it to the append-only journal — rows + monotonic batch
+    seq + CRC, synced per ``DUKE_JOURNAL_SYNC`` — BEFORE ``commit()``
+    returns, i.e. before the HTTP ack.  The background flusher is then a
+    redo-log applier: it advances the journal's applied watermark after
+    each durable store commit, and startup ``recover()`` replays any
+    journaled batch a crash stranded through the idempotent
+    ``assert_links`` path.  Without a journal the pre-PR loss window
+    remains: an acked batch lives only in this buffer until the flush
+    lands (in-memory link-DB semantics for that window).
   * **Drain barrier** — every row-returning read (``/datasets`` feed
     pages, the one-to-one flush's batched link fetch, delete-retraction
     lookups) drains buffered and in-flight writes first, so a reader can
     never observe a torn batch.  ``close()`` and the workload's
     corpus-snapshot save drain too.  ``count()`` alone is non-draining:
     it feeds monitoring gauges, which must not block on flush latency.
-  * **Failure** — a background flush error latches the wrapper: the batch
-    that failed was ONE transaction (all-or-nothing on the sqlite
-    backend), and every subsequent write/commit/drain raises the latched
-    error so ingest cannot silently run ahead of a dead link store.
-    Recovery is a workload reload/restart, same as any persistent-store
-    failure.
+  * **Failure** — a background flush failure is retried per batch
+    (``DUKE_FLUSH_RETRIES``, default 3, capped exponential backoff with
+    full jitter) before latching the wrapper: transient disk errors heal
+    in place — safe because the batch is journaled (or, journal-less,
+    still held in the queue) across attempts — while a persistent error
+    still latches: the batch that failed was ONE transaction
+    (all-or-nothing on the sqlite backend), and every subsequent
+    write/commit/drain raises the latched error so ingest cannot
+    silently run ahead of a dead link store.  Recovery is a workload
+    reload/restart, same as any persistent-store failure — and with the
+    journal, the latched batches replay at that restart.
 """
 
 from __future__ import annotations
 
 import logging
 import threading
+import time
 from collections import deque
 from typing import Callable, List, Optional, Sequence
 
+from .. import telemetry
+from ..telemetry.env import env_int
 from ..utils import faults
+from ..utils.backoff import full_jitter_delay
 from .base import Link, LinkDatabase
+from .journal import LinkJournal
+from .replica import decode_link, encode_link
 
 logger = logging.getLogger("links-write-behind")
+
+# flush-retry backoff shape (satellite: transient disk errors must not
+# poison the wrapper until restart) — same ladder the feed lock retries
+# use; the retry COUNT is the env knob, the shape is policy
+_RETRY_BASE_S = 0.05
+_RETRY_CAP_S = 2.0
+
+
+def _flush_retries() -> int:
+    """Per-batch transient-failure retries before the latch (resolved at
+    failure time so tests and operators can flip it on a live process —
+    the failure path is rare, the env read is not hot)."""
+    return max(0, env_int("DUKE_FLUSH_RETRIES", 3))
 
 
 class WriteBehindBuffer:
@@ -64,8 +99,21 @@ class WriteBehindBuffer:
 
     def __init__(self, flush: Callable[[List], None], *,
                  max_pending: int = 4, drop_on_overflow: bool = False,
-                 name: str = "write-behind"):
+                 name: str = "write-behind",
+                 seal: Optional[Callable] = None,
+                 retries: Optional[Callable[[], int]] = None):
         self._flush = flush
+        # optional batch-sealing hook, called under self._cv the moment
+        # commit() closes a batch and BEFORE it is enqueued — the link
+        # wrapper journals the batch here, so the durability point
+        # precedes both the ack and the background flush.  May transform
+        # the batch (the flusher receives its return value); raising
+        # restores the items to the open buffer and propagates to the
+        # committer (no durability -> no ack).
+        self._seal = seal
+        # transient-flush-failure retries before the latch (callable so
+        # the env knob is read at failure time); None = never retry
+        self._retries = retries
         self._max_pending = max(1, max_pending)
         self._drop_on_overflow = drop_on_overflow
         self._name = name
@@ -98,12 +146,10 @@ class WriteBehindBuffer:
                     return  # closed and drained
                 batch = self._queue.popleft()
                 self._inflight = True
-            try:
-                self._flush(batch)
-            except BaseException as e:  # latch: readers/writers must see it
-                logger.exception("%s flush failed", self._name)
+            error = self._flush_with_retries(batch)
+            if error is not None:  # latch: readers/writers must see it
                 with self._cv:
-                    self._error = e
+                    self._error = error
                     self._inflight = False
                     self._queue.clear()
                     self._cv.notify_all()
@@ -111,6 +157,33 @@ class WriteBehindBuffer:
             with self._cv:
                 self._inflight = False
                 self._cv.notify_all()
+
+    def _flush_with_retries(self, batch) -> Optional[BaseException]:
+        """One batch through ``flush``, retried with capped-exponential
+        full-jitter backoff for transient failures (each attempt re-runs
+        the WHOLE batch — the one-transaction/idempotent-assert contract
+        makes that safe).  Returns the terminal error, or None."""
+        attempt = 0
+        while True:
+            try:
+                self._flush(batch)
+                return None
+            except BaseException as e:
+                limit = self._retries() if self._retries is not None else 0
+                if attempt >= limit:
+                    logger.exception(
+                        "%s flush failed terminally (%d attempt(s))",
+                        self._name, attempt + 1,
+                    )
+                    return e
+                attempt += 1
+                delay = full_jitter_delay(attempt, _RETRY_BASE_S,
+                                          _RETRY_CAP_S)
+                logger.warning(
+                    "%s flush failed (attempt %d/%d; retrying in "
+                    "%.3f s): %r", self._name, attempt, limit, delay, e,
+                )
+                time.sleep(delay)
 
     def _raise_latched(self) -> None:
         # dukecheck: holds self._cv
@@ -152,6 +225,16 @@ class WriteBehindBuffer:
                 self._cv.wait()
                 self._raise_latched()
             batch, self._buf = self._buf, []
+            if self._seal is not None:
+                try:
+                    batch = self._seal(batch)
+                except BaseException:
+                    # the durability point failed (journal append/sync):
+                    # put the items back so nothing is silently dropped,
+                    # and surface the error to the committer — an
+                    # unjournaled batch must never be acked
+                    self._buf = batch + self._buf
+                    raise
             self._queue.append(batch)
             self._ensure_thread()
             self._cv.notify_all()
@@ -173,6 +256,12 @@ class WriteBehindBuffer:
             self.drain()
         except RuntimeError:
             pass  # latched failure: nothing left to save
+        except Exception:
+            # a seal failure (journal device error) surfacing through
+            # the drain's commit: the batch stays in the open buffer and
+            # is lost with the process, but shutdown must still stop the
+            # thread and let the embedder close its resources
+            logger.exception("%s: drain failed during close", self._name)
         with self._cv:
             self._closed = True
             self._cv.notify_all()
@@ -189,21 +278,82 @@ class WriteBehindLinkDatabase(LinkDatabase):
     # flush transactions rather than an arbitrary backlog
     _MAX_PENDING = 4
 
-    def __init__(self, inner: LinkDatabase):
+    def __init__(self, inner: LinkDatabase,
+                 journal: Optional[LinkJournal] = None):
         self.inner = inner
+        # durable redo log (ISSUE 10): sealed batches append here before
+        # the ack; None preserves the legacy volatile-ack window
+        self.journal = journal
         self._wb = WriteBehindBuffer(
             self._flush_batch, max_pending=self._MAX_PENDING,
-            name="link write-behind",
+            name="link write-behind", seal=self._seal_batch,
+            retries=_flush_retries,
         )
 
-    def _flush_batch(self, batch: List[Link]) -> None:
+    def _seal_batch(self, links: List[Link]):
+        """Batch-sealing hook (runs inside ``commit()``): journal the
+        batch durably and stamp it with its redo sequence.  THE
+        durability point — everything after (enqueue, flush, ack) may
+        crash and the batch still replays."""
+        seq = None
+        if self.journal is not None:
+            seq = self.journal.append_batch(
+                [encode_link(link) for link in links])
+            faults.check_crash("post_journal_append")
+        return (seq, links)
+
+    def _flush_batch(self, sealed) -> None:
+        seq, links = sealed
         plan = faults.active()
         if plan is not None:
+            plan.check_crash("pre_flush")
             # chaos hook (DUKE_FAULTS flush_fail): a raised injection
-            # latches the buffer exactly like a real disk failure
+            # exercises the retry ladder and then the latch exactly like
+            # a real disk failure
             plan.check_flush("link write-behind")
-        self.inner.assert_links(batch)
+        self.inner.assert_links(links)
+        if plan is not None:
+            plan.check_crash("mid_flush")
         self.inner.commit()
+        if plan is not None:
+            plan.check_crash("post_flush_pre_truncate")
+        if self.journal is not None and seq is not None:
+            self.journal.mark_applied(seq)
+
+    def recover(self) -> int:
+        """Replay journaled-but-unapplied batches into the durable store
+        (startup only, before any concurrent use): the redo half of the
+        crash-consistency contract.  Replays ride the same idempotent
+        ``assert_links`` the flusher uses — an identical re-assert is a
+        no-op, so a batch that WAS applied (crash before its watermark
+        marker) converges instead of double-writing, and the feed sees
+        no spurious timestamp bumps.  Returns the batch count replayed
+        (counted in ``duke_recovery_replayed_total``)."""
+        if self.journal is None:
+            return 0
+        batches = self.journal.unapplied()
+        # replay in arrival order, coalesced into bounded transactions:
+        # assert_links applies a concatenated run of batches identically
+        # to applying them one by one (each key's final effective state
+        # wins either way), and one watermark marker per chunk covers
+        # every batch at or below it — a 10k-batch backlog replays in a
+        # few dozen transactions instead of 10k commits
+        chunk_size = 256
+        for start in range(0, len(batches), chunk_size):
+            chunk = batches[start:start + chunk_size]
+            self.inner.assert_links(
+                [decode_link(r) for _, rows in chunk for r in rows])
+            self.inner.commit()
+            self.journal.mark_applied(chunk[-1][0])
+        self.journal.compact()
+        if batches:
+            telemetry.RECOVERY_REPLAYED.inc(len(batches))  # dukecheck: ignore[DK502] startup recovery only, never per-batch
+            logger.warning(
+                "recovered %d journaled link batch(es) the previous "
+                "process never applied (crash between ack and flush)",
+                len(batches),
+            )
+        return len(batches)
 
     @property
     def flush_error(self) -> Optional[BaseException]:
@@ -266,5 +416,15 @@ class WriteBehindLinkDatabase(LinkDatabase):
     # -- lifecycle -----------------------------------------------------------
 
     def close(self) -> None:
-        self._wb.close()
-        self.inner.close()
+        try:
+            self._wb.close()
+        finally:
+            # journal and inner store close even if the drain blew up —
+            # fd/connection leaks on a failing shutdown would compound
+            # the original failure.  A drained close leaves an EMPTY
+            # journal (compacted when the watermark caught the head) —
+            # the graceful-shutdown contract: nothing to replay next
+            # start.
+            if self.journal is not None:
+                self.journal.close()
+            self.inner.close()

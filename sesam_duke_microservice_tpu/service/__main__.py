@@ -9,7 +9,7 @@ import argparse
 import logging
 
 from ..telemetry.env import env_int, env_str
-from .app import DEFAULT_PORT, create_app, serve
+from .app import DEFAULT_PORT, create_app, install_shutdown_handlers, serve
 
 
 def main() -> None:
@@ -75,22 +75,16 @@ def main() -> None:
         f", {jax.process_count()} hosts" if dispatcher else "",
     )
 
-    # graceful shutdown on SIGTERM (docker stop) / SIGINT: stop accepting,
-    # then close workloads — flushes link DBs and saves corpus snapshots
-    import signal
-    import threading
-
-    def _shutdown(signum, frame):
-        log.info("signal %d: shutting down", signum)
-        threading.Thread(target=server.shutdown, daemon=True).start()
-
-    signal.signal(signal.SIGTERM, _shutdown)
-    signal.signal(signal.SIGINT, _shutdown)
+    # graceful shutdown on SIGTERM (docker stop) / SIGINT (ISSUE 10):
+    # drain scheduler -> flush write-behind (journal compacts to empty)
+    # -> save snapshots -> close, so orchestrated restarts never need
+    # journal recovery (service.app.install_shutdown_handlers)
+    install_shutdown_handlers(app, server)
     # (SIGINT is rebound above, so no KeyboardInterrupt path exists)
     try:
         server.serve_forever()
     finally:
-        app.close()
+        app.close()  # idempotent: no-op when the handler already closed
         if dispatcher is not None:
             dispatcher.close()
         log.info("shutdown complete")

@@ -185,6 +185,14 @@ class DukeApp:
         # the replicated link state (in-memory replicas; the deposed
         # leader's disk is gone), so apply_config refuses to rebuild them
         self.adopted = prebuilt is not None
+        # close() runs from the signal-driven graceful-shutdown thread
+        # AND the CLI's serve_forever finally — one caller runs the
+        # drain sequence, every other caller BLOCKS until it completes
+        # (a no-op second call would let the CLI's main thread exit and
+        # take the daemon shutdown thread down mid drain/flush/snapshot)
+        self._close_lock = threading.Lock()
+        self._closed = False  # guarded by: self._close_lock [writes]
+        self._close_done = threading.Event()
         if prebuilt is not None:
             # leader-failover promotion (parallel.dispatch
             # .promote_follower): the workloads already exist — built
@@ -232,9 +240,15 @@ class DukeApp:
     def readiness(self) -> Tuple[bool, Dict[str, bool]]:
         """GET /readyz substance: config parsed, every configured workload
         built and swapped in, (non-host backends) the device backend
-        initialized with at least one device, and no workload's
-        write-behind link persistence latched on a flush failure."""
+        initialized with at least one device, no workload's write-behind
+        link persistence latched on a flush failure, and no link-journal
+        recovery replay still running (ISSUE 10: /readyz answers
+        ``recovering`` until startup replay completes, so orchestrators
+        never route traffic at a link DB that is still being redone)."""
+        from ..links import journal as link_journal
+
         checks = {"config_loaded": self.config is not None}
+        checks["recovery_complete"] = not link_journal.recovery_active()
         checks["workloads_built"] = bool(
             self.config is not None
             and set(self.deduplications) == set(self.config.deduplications)
@@ -339,27 +353,48 @@ class DukeApp:
         self.apply_config(parse_config(config_string))
 
     def close(self) -> None:
-        """Graceful shutdown: close every workload (flushes link DBs and
-        saves device-corpus snapshots).  Called by the CLI's signal
-        handlers — the reference has no shutdown hook at all (state safety
-        there rests on Lucene/H2 syncing every commit)."""
-        # drain the ingest scheduler FIRST: queued requests complete
-        # against still-open workloads (no lost requests), and the
-        # dispatcher must be able to take the workload locks this method
-        # is about to hold
-        if getattr(self, "scheduler", None) is not None:
-            self.scheduler.shutdown()
-        with self._swap_lock:
-            workloads = (list(self.deduplications.values())
-                         + list(self.record_linkages.values()))
-            self.deduplications = {}
-            self.record_linkages = {}
-        for wl in workloads:
-            with wl.lock:
-                try:
-                    wl.close()
-                except Exception:
-                    logger.exception("Error closing workload on shutdown")
+        """Graceful shutdown: drain the ingest scheduler, then close every
+        workload — each close drains its write-behind link flush (leaving
+        an EMPTY journal: the watermark catches the head and the file
+        compacts to zero bytes) and saves the device-corpus snapshot, so
+        an orchestrated restart (docker stop / k8s SIGTERM) starts warm
+        with nothing to recover.  Idempotent; called by the signal
+        handlers (``install_shutdown_handlers``) and the CLI's
+        ``finally`` — the reference has no shutdown hook at all (state
+        safety there rests on Lucene/H2 syncing every commit)."""
+        with self._close_lock:
+            if self._closed:
+                already = True
+            else:
+                self._closed = True
+                already = False
+        if already:
+            # wait for the winning caller's drain sequence: the CLI's
+            # finally must not let the process exit while the signal
+            # thread is still flushing/snapshotting
+            self._close_done.wait()
+            return
+        try:
+            # drain the ingest scheduler FIRST: queued requests complete
+            # against still-open workloads (no lost requests), and the
+            # dispatcher must be able to take the workload locks this
+            # method is about to hold
+            if getattr(self, "scheduler", None) is not None:
+                self.scheduler.shutdown()
+            with self._swap_lock:
+                workloads = (list(self.deduplications.values())
+                             + list(self.record_linkages.values()))
+                self.deduplications = {}
+                self.record_linkages = {}
+            for wl in workloads:
+                with wl.lock:
+                    try:
+                        wl.close()
+                    except Exception:
+                        logger.exception(
+                            "Error closing workload on shutdown")
+        finally:
+            self._close_done.set()
 
 
 class _HttpError(Exception):
@@ -655,8 +690,17 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
 
     def _handle_readyz(self) -> None:
         ready, checks = self.app.readiness()
+        if ready:
+            status = "ready"
+        elif not checks.get("recovery_complete", True):
+            # startup journal replay still running: a distinct status so
+            # orchestrators (and humans) can tell "redoing the link log"
+            # from a genuinely broken dependency
+            status = "recovering"
+        else:
+            status = "unready"
         body = json.dumps(
-            {"status": "ready" if ready else "unready", "checks": checks}
+            {"status": status, "checks": checks}
         ).encode("utf-8")
         self._reply(200 if ready else 503, body, "application/json")
 
@@ -1121,6 +1165,36 @@ def _extract_multipart_field(content_type: str, body: bytes,
             payload = part.get_payload(decode=True)
             return payload.decode("utf-8", errors="replace")
     return None
+
+
+def install_shutdown_handlers(app: DukeApp, server) -> None:
+    """SIGTERM/SIGINT graceful shutdown (ISSUE 10 satellite): stop
+    accepting, drain the ingest scheduler, flush the write-behind link
+    batches, save corpus snapshots, close — so an orchestrated restart
+    (docker stop, k8s rolling update) finds an empty journal and a warm
+    snapshot and never even enters recovery.
+
+    The handler itself only spawns the shutdown thread (signal context
+    must not block on workload locks); ``server.shutdown()`` unblocks
+    ``serve_forever`` and ``DukeApp.close()`` runs the drain sequence.
+    A second signal is a no-op (``close`` is idempotent), NOT an
+    escalation — a hard kill is what the crash-recovery journal exists
+    for."""
+    import signal
+
+    def _shutdown(signum, frame):
+        logger.info("signal %d: graceful shutdown (drain -> flush -> "
+                    "snapshot -> close)", signum)
+
+        def _run():
+            server.shutdown()  # stop accepting; in-flight requests finish
+            app.close()
+
+        threading.Thread(target=_run, daemon=True,
+                         name="graceful-shutdown").start()
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGINT, _shutdown)
 
 
 def create_app(config: Optional[ServiceConfig] = None, *, backend: str = "host",

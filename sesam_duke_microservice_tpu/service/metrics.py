@@ -197,6 +197,8 @@ def make_app_collector(app):
         ivf_cell_samples = []
         ivf_probe_samples = []
         link_samples = []
+        journal_batch_samples = []
+        journal_byte_samples = []
         queue_samples = []
         warm_samples = []
         finalize_samples = []
@@ -294,6 +296,18 @@ def make_app_collector(app):
                 link_samples.append(("", labels, wl.link_database.count()))
             except Exception:
                 pass  # a closed/raced link DB must never fail the scrape
+            # durable link journal (ISSUE 10): lock-free snapshots of the
+            # journal's plain int mirrors — pending (journaled, not yet
+            # applied to the durable store) batches and file bytes.  A
+            # pending count that grows without draining is the flusher
+            # falling behind; bytes that never compact mean the
+            # watermark stopped advancing.
+            journal = getattr(wl.link_database, "journal", None)
+            if journal is not None:
+                journal_batch_samples.append(
+                    ("", labels, float(journal.pending_batches)))
+                journal_byte_samples.append(
+                    ("", labels, float(journal.size_bytes)))
             queue_samples.append(("", labels, len(wl._mb_queue)))
             cache = getattr(wl.index, "scorer_cache", None) \
                 if corpus is not None else None
@@ -398,6 +412,17 @@ def make_app_collector(app):
             [("", (("reason", reason),), float(count))
              for reason, count in sorted(abort_counts.items())],
         ))
+        if journal_batch_samples:
+            out.append(FamilySnapshot(
+                "duke_journal_batches", "gauge",
+                "Journaled link batches not yet applied to the durable "
+                "store (the crash-recovery replay set if the process "
+                "died now)", journal_batch_samples))
+            out.append(FamilySnapshot(
+                "duke_journal_bytes", "gauge",
+                "Bytes in the append-only link journal (compacts to 0 "
+                "once the applied watermark catches the head)",
+                journal_byte_samples))
         if capacity_samples:
             out.append(FamilySnapshot(
                 "duke_corpus_capacity_rows", "gauge",

@@ -162,6 +162,31 @@ FAULTS_INJECTED = GLOBAL.counter(
     ("kind",),
 )
 
+# -- crash-consistent ingest (ISSUE 10: links/journal.py, recovery) ----------
+# Written on startup/rare paths only; the journal's per-workload gauges
+# (duke_journal_batches, duke_journal_bytes) are scrape-time snapshots in
+# the app collector, so the append path never writes a registry child.
+JOURNAL_TORN_TAILS = GLOBAL.counter(
+    "duke_journal_torn_tails_total",
+    "Torn or corrupt link-journal tails truncated by the startup scan "
+    "(a crash mid-append; bounded to the final partial frame, logged, "
+    "never fatal)",
+)
+RECOVERY_REPLAYED = GLOBAL.counter(
+    "duke_recovery_replayed_total",
+    "Journaled link batches replayed into the durable link store by "
+    "startup recovery (batches a crash stranded between ack and flush)",
+)
+SNAPSHOT_FALLBACKS = GLOBAL.counter(
+    "duke_snapshot_fallbacks_total",
+    "Corpus snapshots rejected into a full store replay, by reason "
+    "(corrupt = unreadable archive, checksum = stamped content checksum "
+    "mismatch, content = store drifted past the snapshot, schema = "
+    "plan/tensor-shape mismatch, fingerprint = env/plan fingerprint "
+    "mismatch)",
+    ("reason",),
+)
+
 # -- mesh (engine/sharded_matcher.py) ----------------------------------------
 MESH_DEVICES = GLOBAL.gauge(
     "duke_mesh_devices",

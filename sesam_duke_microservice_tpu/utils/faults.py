@@ -36,6 +36,19 @@ Spec tokens (``p`` in [0,1]; ``@tag`` filters to one dispatch op tag):
   ``slow_lock=<p>:<seconds>``      feed-path lock acquisitions sleep
                                    first (exercises the bounded-backoff
                                    deadline path)
+  ``crash_at=<site>:<n>``          SIGKILL this process the ``n``-th time
+                                   the named ingest crash site is
+                                   reached — no cleanup, no atexit, the
+                                   honest crash the kill-differential
+                                   harness (ISSUE 10) restarts from.
+                                   Sites: ``post_store_put``,
+                                   ``post_journal_append``, ``pre_flush``,
+                                   ``mid_flush``,
+                                   ``post_flush_pre_truncate``,
+                                   ``mid_journal_write`` (writes HALF the
+                                   journal frame first — torn-tail
+                                   synthesis), ``mid_snapshot_save``
+                                   (tmp written, ``os.replace`` pending)
 
 Every injected fault counts in ``duke_faults_injected_total{kind}``.
 This module is wired into ``parallel/dispatch.py`` (send path + follower
@@ -46,6 +59,7 @@ locks); with no spec set every hook is a no-op attribute read.
 from __future__ import annotations
 
 import hashlib
+import os
 import threading
 import time
 from typing import Dict, Optional, Tuple
@@ -103,9 +117,12 @@ class FaultPlan:
         self._leader_crash: Optional[int] = None
         self._flush_fail_at: Optional[int] = None
         self._slow_lock: Optional[Tuple[float, float]] = None
+        # crash site name -> 1-based occurrence that kills the process
+        self._crash_at: Dict[str, int] = {}
         self._flush_lock = threading.Lock()
         self._flush_count = 0  # guarded by: self._flush_lock
         self._lock_count = 0  # guarded by: self._flush_lock
+        self._crash_counts: Dict[str, int] = {}  # guarded by: self._flush_lock
         self._parse(spec)
 
     def _parse(self, spec: str) -> None:
@@ -138,6 +155,8 @@ class FaultPlan:
                     self._flush_fail_at = int(parts[0])
                 elif kind == "slow_lock":
                     self._slow_lock = (float(parts[0]), float(parts[1]))
+                elif kind == "crash_at":
+                    self._crash_at[str(parts[0])] = int(parts[1])
                 else:
                     raise ValueError(f"unknown fault kind {kind!r}")
             except (IndexError, ValueError) as e:
@@ -212,6 +231,37 @@ class FaultPlan:
                 f"injected {name} flush failure (DUKE_FAULTS flush_fail)"
             )
 
+    # -- ingest crash sites (ISSUE 10 kill differential) ----------------------
+
+    def crash_hit(self, site: str) -> bool:
+        """Count one arrival at ``site``; True iff this is the configured
+        occurrence.  Split from ``crash_now`` so a site that must do
+        site-specific damage first (``mid_journal_write`` writes half a
+        frame) can interleave the two; plain sites use ``check_crash``."""
+        n = self._crash_at.get(site)
+        if n is None:
+            return False
+        with self._flush_lock:
+            count = self._crash_counts.get(site, 0) + 1
+            self._crash_counts[site] = count
+        return count == n
+
+    def crash_now(self, site: str) -> None:
+        """Die the way a real crash dies: SIGKILL to self — no cleanup,
+        no flush, no atexit.  The kill-differential harness asserts the
+        restart recovers to the uncrashed control from exactly this."""
+        import signal
+        import sys
+
+        _count("crash_at")
+        print(f"duke-faults: injected crash at {site}", file=sys.stderr,
+              flush=True)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def check_crash(self, site: str) -> None:
+        if self.crash_hit(site):
+            self.crash_now(site)
+
     # -- lock paths -----------------------------------------------------------
 
     def lock_delay(self) -> float:
@@ -240,6 +290,17 @@ def configure(spec: Optional[str]) -> Optional[FaultPlan]:
     _override = FaultPlan(spec) if spec else None
     _override_set = spec is not None
     return _override
+
+
+def check_crash(site: str) -> None:
+    """Module-level crash-site hook (ISSUE 10): with an active plan
+    arming ``crash_at=<site>:<n>``, the n-th arrival SIGKILLs the
+    process; otherwise a no-op attribute read.  THE one copy of the
+    plan-resolution dance — call sites that need the plan for more than
+    one check (the flusher) fetch it once via ``active()`` instead."""
+    plan = active()
+    if plan is not None:
+        plan.check_crash(site)
 
 
 def active() -> Optional[FaultPlan]:

@@ -1,0 +1,140 @@
+"""Kill-differential child driver (ISSUE 10, tests/test_crash_recovery.py).
+
+One ingest process the harness can crash at an exact site and restart:
+
+  * builds ONE persistent dedup workload over ``--data`` (host backend by
+    default; ``--backend ann`` for the snapshot sites) — journal
+    recovery, store replay and snapshot load all run inside
+    ``build_workload`` exactly as a real service start;
+  * ingests the deterministic duplicate-heavy corpus batch by batch,
+    printing ``ACK <i>`` after each batch returns (the moment a real
+    client would see HTTP 200) — the parent resumes a crashed run from
+    the first unacked batch, the at-least-once retry contract every
+    Sesam client already implements;
+  * with ``DUKE_FAULTS=crash_at=<site>:<n>`` in the environment the
+    process SIGKILLs itself mid-flight (utils.faults) — no cleanup, no
+    atexit, an honest crash;
+  * ``--dump`` prints ``DUMP <json>``: the normalized link-DB rows, the
+    ``?since=`` feed (timestamps dropped — wall clock differs across
+    runs by construction; everything else must be byte-identical), and
+    the recovery counters the differential asserts on.
+
+Timestamps are the ONE normalized field: links carry wall-clock millis
+assigned at event time, so a crashed+recovered run can never equal the
+control on them.  Row content, pair set, statuses, kinds and confidences
+must match exactly.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# the package is imported from the repo checkout (same bootstrap as
+# tests/conftest.py — the child has no conftest)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_batches(n_batches: int, per_batch: int, identities: int = 4):
+    """Duplicate-heavy deterministic corpus: record (b, i) carries
+    identity ``(b*per_batch + i) % identities``, so every batch re-mints
+    identities earlier batches already ingested — each batch both links
+    internally and against prior batches' records."""
+    out = []
+    for b in range(n_batches):
+        rows = []
+        for i in range(per_batch):
+            ident = (b * per_batch + i) % identities
+            name = f"person number {ident}"
+            rows.append({
+                "_id": f"r{b}_{i}",
+                "name": name,
+                "email": f"{name.replace(' ', '.')}@x.no",
+            })
+        out.append(rows)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", required=True)
+    ap.add_argument("--backend", default="host")
+    ap.add_argument("--batches", type=int, default=6)
+    ap.add_argument("--per-batch", type=int, default=6)
+    ap.add_argument("--start", type=int, default=0)
+    ap.add_argument("--dump", action="store_true")
+    ap.add_argument("--close", action="store_true")
+    # keep the process alive after the last ack so a crash site on the
+    # BACKGROUND flusher thread (e.g. the final batch's pre_flush) is
+    # reached before process exit would reap the daemon thread
+    ap.add_argument("--linger", type=float, default=0.0)
+    args = ap.parse_args()
+
+    from sesam_duke_microservice_tpu import telemetry
+    from sesam_duke_microservice_tpu.core.config import parse_config
+    from sesam_duke_microservice_tpu.engine.workload import build_workload
+
+    xml = f"""
+<DukeMicroService dataFolder="{args.data}">
+  <Deduplication name="people">
+    <duke>
+      <schema>
+        <threshold>0.8</threshold>
+        <property><name>NAME</name><comparator>levenshtein</comparator><low>0.1</low><high>0.95</high></property>
+        <property><name>EMAIL</name><comparator>exact</comparator><low>0.2</low><high>0.95</high></property>
+      </schema>
+      <data-source class="io.sesam.dukemicroservice.IncrementalDeduplicationDataSource">
+        <param name="dataset-id" value="crm"/>
+        <column name="name" property="NAME"/>
+        <column name="email" property="EMAIL"/>
+      </data-source>
+    </duke>
+  </Deduplication>
+</DukeMicroService>
+"""
+    sc = parse_config(xml, env={"MIN_RELEVANCE": "0.05"})
+    wl = build_workload(sc.deduplications["people"], sc,
+                        backend=args.backend, persistent=True)
+
+    batches = make_batches(args.batches, args.per_batch)
+    for i in range(args.start, args.batches):
+        with wl.lock:
+            wl.process_batch("crm", batches[i])
+        print(f"ACK {i}", flush=True)
+    if args.linger:
+        import time
+
+        time.sleep(args.linger)
+
+    if args.dump:
+        links = sorted(
+            (l.id1, l.id2, l.status.value, l.kind.value,
+             round(l.confidence, 12))
+            for l in wl.link_database.get_all_links()
+        )
+        with wl.lock:
+            feed = wl.links_since(0)
+        for row in feed:
+            row.pop("_updated", None)
+        feed.sort(key=lambda r: r["_id"])
+        journal = getattr(wl.link_database, "journal", None)
+        print("DUMP " + json.dumps({
+            "links": links,
+            "feed": feed,
+            "store_rows": (wl.record_store.count()
+                           if wl.record_store is not None else None),
+            "journal_pending": (journal.pending_batches
+                                if journal is not None else None),
+            "torn": telemetry.JOURNAL_TORN_TAILS.single().value,
+            "replayed": telemetry.RECOVERY_REPLAYED.single().value,
+        }), flush=True)
+
+    if args.close:
+        with wl.lock:
+            wl.close()
+    print("DONE", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,7 +50,7 @@ def test_fault_spec_parses_every_kind():
     plan = faults.FaultPlan(
         "seed=42; drop=0.5@commit; dup=0.25; delay=0.1:0.05;"
         "partition=1:10:20; crash_follower=0:7; crash_leader=33;"
-        "flush_fail=2; slow_lock=0.5:0.01"
+        "flush_fail=2; slow_lock=0.5:0.01; crash_at=pre_flush:4"
     )
     assert plan.seed == 42
     assert plan._drop == [(0.5, "commit")]
@@ -61,6 +61,11 @@ def test_fault_spec_parses_every_kind():
     assert plan._leader_crash == 33
     assert plan._flush_fail_at == 2
     assert plan._slow_lock == (0.5, 0.01)
+    assert plan._crash_at == {"pre_flush": 4}
+    # counting without the kill: only the configured occurrence hits
+    assert [plan.crash_hit("pre_flush") for _ in range(5)] == [
+        False, False, False, True, False]
+    assert plan.crash_hit("unconfigured_site") is False
 
 
 def test_fault_spec_rejects_garbage():
@@ -204,7 +209,12 @@ def test_follower_crash_evicted_group_survives(monkeypatch):
 # -- write-behind flush failure ----------------------------------------------
 
 
-def test_flush_fail_latches_buffer(tmp_path):
+def test_flush_fail_latches_buffer(tmp_path, monkeypatch):
+    # retries off: the injected failure only hits the FIRST flush call
+    # (the fault counts attempts), so the default retry ladder would
+    # heal it — which is now its own test (test_crash_recovery's
+    # flush-retry satellite); this test pins the latch itself
+    monkeypatch.setenv("DUKE_FLUSH_RETRIES", "0")
     faults.configure("flush_fail=1")
     db = WriteBehindLinkDatabase(
         SqliteLinkDatabase(str(tmp_path / "links.db"))
@@ -224,9 +234,10 @@ def test_flush_fail_latches_buffer(tmp_path):
         db.close()
 
 
-def test_flush_latch_flips_readyz_and_healthz(tmp_path):
+def test_flush_latch_flips_readyz_and_healthz(tmp_path, monkeypatch):
     """ISSUE 8 satellite: a dead persistence thread goes unready in
     /readyz and is NAMED in /healthz — before any read drains into it."""
+    monkeypatch.setenv("DUKE_FLUSH_RETRIES", "0")  # latch on first failure
     xml = DEDUP_XML.replace(
         "<DukeMicroService>",
         f'<DukeMicroService dataFolder="{tmp_path}">',

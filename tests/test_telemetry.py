@@ -291,8 +291,9 @@ def test_health_probes(live_app):
     ready = json.loads(body)
     assert ready["status"] == "ready"
     assert ready["checks"] == {
-        "config_loaded": True, "workloads_built": True,
-        "device_backend": True, "link_persistence": True,
+        "config_loaded": True, "recovery_complete": True,
+        "workloads_built": True, "device_backend": True,
+        "link_persistence": True,
     }
 
 
