@@ -344,14 +344,49 @@ def duplicate_group_records(n, group, seed, dataset):
     return records
 
 
-def _e2e_run(schema, tmpdir, *, serial: bool) -> dict:
+class _EventTape:
+    """Ordered listener event tape for bit-identity assertions."""
+
+    def __init__(self):
+        self.events = []
+
+    def batch_ready(self, n):
+        pass
+
+    def batch_done(self):
+        pass
+
+    def matches(self, r1, r2, confidence):
+        self.events.append(("m", r1.record_id, r2.record_id, confidence))
+
+    def matches_perhaps(self, r1, r2, confidence):
+        self.events.append(("p", r1.record_id, r2.record_id, confidence))
+
+    def no_match_for(self, record):
+        self.events.append(("n", record.record_id))
+
+
+def _e2e_link_rows(db):
+    return sorted(
+        (l.id1, l.id2, l.status.value, l.kind.value, l.confidence)
+        for l in db.get_all_links()
+    )
+
+
+def _e2e_run(schema, tmpdir, *, serial: bool, finalizer=None,
+             mode=None, capture: bool = False) -> dict:
     """One end-to-end ingest measurement: deduplicate (device scoring +
     host finalization) + link persist to a durable sqlite store.
 
     ``serial=True`` pins the pre-finalization-subsystem configuration —
-    one finalize thread, no decisive-band skip, per-link synchronous
-    sqlite writes — so the headline can report the speedup of the new
-    defaults over the legacy path in one bench invocation.
+    one finalize thread, no decisive-band skip, no device finalize,
+    per-link synchronous sqlite writes — so the headline can report the
+    speedup of the new defaults over the legacy path in one bench
+    invocation.  ``finalizer`` overrides the executor outright (the
+    ``device_finalize`` on/off arms pin threads=1 and toggle only
+    ``DUKE_DEVICE_FINALIZE`` semantics); ``capture`` additionally
+    returns the ordered event tape + link rows for bit-identity
+    assertions.
     """
     from sesam_duke_microservice_tpu.engine.device_matcher import (
         DeviceIndex,
@@ -364,7 +399,7 @@ def _e2e_run(schema, tmpdir, *, serial: bool) -> dict:
         WriteBehindLinkDatabase,
     )
 
-    mode = "serial" if serial else "parallel"
+    mode = mode or ("serial" if serial else "parallel")
     linkdb = SqliteLinkDatabase(os.path.join(tmpdir, f"links-{mode}.sqlite"))
     if serial:
         db, listener = linkdb, LinkMatchListener(linkdb, batch=False)
@@ -377,9 +412,14 @@ def _e2e_run(schema, tmpdir, *, serial: bool) -> dict:
     # thread fan-out is actually measured; DUKE_FINALIZE_THREADS still
     # overrides inside FinalizeExecutor
     proc = DeviceProcessor(schema, index, threads=(os.cpu_count() or 2))
-    if serial:
+    if finalizer is not None:
+        proc.finalizer = finalizer
+    elif serial:
         proc.finalizer = FinalizeExecutor(1, decisive=False, use_env=False)
     proc.add_match_listener(listener)
+    tape = _EventTape()
+    if capture:
+        proc.add_match_listener(tape)
 
     corpus = duplicate_group_records(E2E_CORPUS, E2E_GROUP, seed=42,
                                      dataset="base")
@@ -394,13 +434,16 @@ def _e2e_run(schema, tmpdir, *, serial: bool) -> dict:
     proc.deduplicate(warm)
     for r in warm:
         index.delete(r)
+    tape.events.clear()
 
     rescored0 = proc.stats.pairs_rescored
     skipped0 = proc.stats.pairs_skipped
+    certified0 = proc.stats.pairs_device_certified
+    finalize0 = proc.stats.compare_seconds
     t0 = time.perf_counter()
     for run in range(E2E_RUNS):
         batch = duplicate_group_records(
-            E2E_QUERIES, E2E_GROUP, seed=42, dataset=f"ing{mode}{run}"
+            E2E_QUERIES, E2E_GROUP, seed=42, dataset=f"ing{run}"
         )
         proc.deduplicate(batch)
         for r in batch:
@@ -409,23 +452,52 @@ def _e2e_run(schema, tmpdir, *, serial: bool) -> dict:
     # records/s includes persist, not just the enqueue
     db.drain()
     dt = time.perf_counter() - t0
-    db.close()
-    return {
+    finalize_dt = proc.stats.compare_seconds - finalize0
+    out = {
         "records_per_sec": round(E2E_RUNS * E2E_QUERIES / dt, 1),
         "rescored": proc.stats.pairs_rescored - rescored0,
         "skipped": proc.stats.pairs_skipped - skipped0,
+        "device_certified": proc.stats.pairs_device_certified - certified0,
+        "finalize_seconds": round(finalize_dt, 3),
+        # finalize share of e2e wall clock (the ISSUE 12 target figure)
+        "finalize_fraction": round(finalize_dt / dt, 4),
         "finalize_threads": proc.finalizer.threads,
     }
+    if capture:
+        out["events"] = list(tape.events)
+        out["links"] = _e2e_link_rows(db)
+    db.close()
+    return out
 
 
 def e2e_ingest(schema) -> dict:
     """records/s through ``deduplicate`` + persist, new defaults vs the
-    legacy serial path (see _e2e_run)."""
+    legacy serial path, plus the ISSUE 12 ``device_finalize`` arm:
+    DUKE_DEVICE_FINALIZE on vs off at DUKE_FINALIZE_THREADS=1, link rows
+    AND ordered event streams asserted bit-identical, and
+    ``finalize_fraction`` (finalize share of e2e wall clock) reported
+    per arm so the <10% target is a measured number."""
     import tempfile
+
+    from sesam_duke_microservice_tpu.engine.finalize import FinalizeExecutor
 
     with tempfile.TemporaryDirectory(prefix="duke-e2e-bench") as tmpdir:
         serial = _e2e_run(schema, tmpdir, serial=True)
         parallel = _e2e_run(schema, tmpdir, serial=False)
+        dev_on = _e2e_run(
+            schema, tmpdir, serial=False, mode="dd-on", capture=True,
+            finalizer=FinalizeExecutor(1, device=True, use_env=False),
+        )
+        dev_off = _e2e_run(
+            schema, tmpdir, serial=False, mode="dd-off", capture=True,
+            finalizer=FinalizeExecutor(1, device=False, use_env=False),
+        )
+    if dev_on["events"] != dev_off["events"]:
+        raise AssertionError(
+            "device-finalize event stream diverged from the host control")
+    if dev_on["links"] != dev_off["links"]:
+        raise AssertionError(
+            "device-finalize link rows diverged from the host control")
     return {
         "metric": "ingest_records_per_sec",
         "value": parallel["records_per_sec"],
@@ -437,6 +509,19 @@ def e2e_ingest(schema) -> dict:
         "finalize_threads": parallel["finalize_threads"],
         "finalize_rescored": parallel["rescored"],
         "finalize_skipped": parallel["skipped"],
+        "finalize_fraction": parallel["finalize_fraction"],
+        "device_finalize": {
+            # both arms pin DUKE_FINALIZE_THREADS=1 (the ISSUE 12 target
+            # configuration); bit-identity of events+links was asserted
+            "on_records_per_sec": dev_on["records_per_sec"],
+            "off_records_per_sec": dev_off["records_per_sec"],
+            "finalize_fraction_on": dev_on["finalize_fraction"],
+            "finalize_fraction_off": dev_off["finalize_fraction"],
+            "finalize_seconds_on": dev_on["finalize_seconds"],
+            "finalize_seconds_off": dev_off["finalize_seconds"],
+            "device_certified": dev_on["device_certified"],
+            "bit_identical": True,
+        },
         "corpus": E2E_CORPUS,
         "queries_per_batch": E2E_QUERIES,
         "dup_group": E2E_GROUP,

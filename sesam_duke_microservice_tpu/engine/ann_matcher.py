@@ -361,11 +361,15 @@ class _AnnScorerCache(_ScorerCache):
         # pruning bound (or sat inside the int8 ambiguity band at the
         # cutoff) the search saturated — double C (and, under IVF,
         # nprobe) so truncation can never pass silently
-        return _PendingBlock(
+        pending = _PendingBlock(
             corpus.capacity, n, min_logit, c0, call,
             lambda cmax, cc: cmax >= cc, *call(c0),
             stage="ivf" if ivf is not None else self.escalation_stage,
         )
+        # dd rescore context (ISSUE 12): the kernel feature tensors only
+        # (the ANN_PROP embedding tree was already split off above)
+        pending.dd_ctx = (qfeats, from_rows, query_row_j)
+        return pending
 
 
 class AnnProcessor(DeviceProcessor):

@@ -1570,13 +1570,25 @@ class _BlockResult:
         self.top_logit = top_logit
         self.top_index = top_index
         self.min_logit = min_logit
+        # device-finalize attachments (ISSUE 12): the query-side device
+        # context the dd rescore re-uses (set by dispatch_block via
+        # resolve_block) and the resolved dd rescore output
+        # (hi, lo, unsafe numpy arrays aligned with top_index) consumed
+        # by engine.finalize
+        self.dd_ctx = None
+        self.dd = None
+
+    def survivor_triples(self, q: int) -> List[Tuple[int, int, float]]:
+        """(k_position, corpus_row, device_logit) survivors of query q —
+        the position indexes the dd rescore arrays (engine.finalize)."""
+        logits = self.top_logit[q]
+        rows = self.top_index[q]
+        keep = np.nonzero(logits > self.min_logit)[0]
+        return [(int(k), int(rows[k]), float(logits[k])) for k in keep]
 
     def survivors(self, q: int) -> List[Tuple[int, float]]:
         """(corpus_row, device_logit) pairs that may clear the threshold."""
-        logits = self.top_logit[q]
-        rows = self.top_index[q]
-        keep = logits > self.min_logit
-        return [(int(r), float(l)) for r, l in zip(rows[keep], logits[keep])]
+        return [(row, logit) for _, row, logit in self.survivor_triples(q)]
 
 
 # Daemon threads killed mid-XLA-compile abort the process at interpreter
@@ -1903,15 +1915,69 @@ class _ScorerCache:
         k = min(_INITIAL_TOP_K, corpus.capacity)
         # brute force is exact for any K that fits every candidate above
         # the bound: escalate while some query overflowed K
-        return _PendingBlock(
+        pending = _PendingBlock(
             corpus.capacity, n, min_logit, k, call,
             lambda cmax, kk: cmax > kk, *call(k)
         )
+        # query-side context for the post-resolve dd rescore (ISSUE 12):
+        # the same uploaded/gathered query features the scorer used
+        pending.dd_ctx = (qfeats, from_rows, query_row_j)
+        return pending
 
     def score_block(self, records: Sequence[Record], *,
                     group_filtering: bool) -> _BlockResult:
         pending = self.dispatch_block(records, group_filtering=group_filtering)
         return resolve_block(pending)
+
+    # device-resident certified finalization (ISSUE 12): the sharded
+    # caches disable it — their corpus feature tensors live record-axis
+    # sharded across the mesh, so a global survivor gather would need
+    # collectives that the multi-host follower replay never enqueues
+    supports_dd = True
+
+    def dd_rescore(self, result: _BlockResult):
+        """Run the dd survivor rescore for a resolved block.
+
+        Returns (hi, lo, unsafe) numpy arrays aligned with
+        ``result.top_index`` — the two-float emulated-f64 logit over the
+        dd-certifiable device properties plus the truncation-safety mask
+        (ops.scoring.build_dd_rescorer) — or None when the block cannot
+        ride the device (no certifiable property, no survivors at all,
+        sharded corpus).  Collective-free: under a multi-host dispatcher
+        this extra device program runs on the frontend only, which is
+        safe exactly because it never synchronizes across hosts.
+        """
+        if not self.supports_dd:
+            return None
+        ctx = result.dd_ctx
+        if ctx is None:
+            return None
+        from ..ops import scoring as S
+        import jax.numpy as jnp
+
+        plan = self.index.plan
+        # block-level dispatch gate: only survivors whose f32 logit sits
+        # low enough to possibly be a certified reject justify the
+        # program (dd_gate_bound — certified events and residue take the
+        # host compare either way).  Also skips empty blocks, and small
+        # tests never pay the first-contact compile.
+        gate = S.dd_gate_bound(self.index.schema, plan)
+        candidates = ((result.top_logit > result.min_logit)
+                      & (result.top_logit <= gate))
+        if not bool(candidates.any()):
+            return None
+        qfeats, from_rows, query_row_j = ctx
+        fn = S.dd_rescorer(
+            plan, queries_from_rows=from_rows,
+            value_slots_cap=_VALUE_SLOTS_MAX,
+        )
+        if fn is None:
+            return None
+        cfeats_all = self.index.corpus.device_arrays()[0]
+        cfeats = {s.name: cfeats_all[s.name] for s in S.dd_plan_specs(plan)}
+        hi, lo, unsafe = fn(qfeats, cfeats, query_row_j,
+                            jnp.asarray(result.top_index))
+        return (np.asarray(hi), np.asarray(lo), np.asarray(unsafe))
 
 
 class _PendingBlock:
@@ -1981,7 +2047,9 @@ def resolve_block(pending) -> _BlockResult:
         )
         cmax = int(count_np[: pending.n].max(initial=0))
         if k >= pending.capacity or not pending.needs_escalation(cmax, k):
-            return _BlockResult(logit_np, index_np, pending.min_logit)
+            res = _BlockResult(logit_np, index_np, pending.min_logit)
+            res.dd_ctx = getattr(pending, "dd_ctx", None)
+            return res
         k = min(k * 2, pending.capacity)
         _count_escalation(getattr(pending, "stage", "top_k"))
         logger.info(
@@ -2172,6 +2240,12 @@ class DeviceProcessor:
 
             if not self.finalize_survivors:
                 continue
+            if self.finalizer.device:
+                # dd survivor rescore (ISSUE 12): one more collective-
+                # free device program over the resolved (Q, K) pair
+                # list; engine.finalize certifies verdicts against it
+                # and skips the host compare for certified rejects
+                result.dd = self._scorers.dd_rescore(result)
             # parallel host finalization: workers compute the exact f64
             # rescores (and the decisive-band skips) per query; events
             # then emit HERE, serially and in query order, so listener
@@ -2195,6 +2269,10 @@ class DeviceProcessor:
                 self.stats.candidates_retrieved += out.survivors
                 self.stats.pairs_rescored += out.rescored
                 self.stats.pairs_skipped += out.skipped
+                self.stats.pairs_device_certified += out.device_certified
+                self.stats.dd_residue_margin += out.residue_margin
+                self.stats.dd_residue_kind += out.residue_kind
+                self.stats.dd_residue_truncation += out.residue_truncation
                 if self.exhaustive:
                     # the device ran the exact comparator kernels against
                     # every live corpus row for this query

@@ -38,6 +38,8 @@ import math
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from ..core.bayes import probability_logit
 from ..core.records import Record
 from ..telemetry.decisions import classify, explanation_digest
@@ -191,7 +193,8 @@ def _frozen_plan(plan):
 
 
 def device_breakdown(index, r1: Record, r2: Record, *,
-                     decisive: bool = True) -> Optional[Dict[str, Any]]:
+                     decisive: bool = True,
+                     device: bool = True) -> Optional[Dict[str, Any]]:
     """The pair's device-path f32 verdict with per-property provenance.
 
     Extracts both records under a frozen copy of the CORPUS plan (so
@@ -200,8 +203,6 @@ def device_breakdown(index, r1: Record, r2: Record, *,
     logit program.  Returns None for backends without a feature plan
     (host inverted index).
     """
-    import numpy as np
-
     from ..ops import scoring as S
 
     plan = getattr(index, "plan", None)
@@ -229,7 +230,7 @@ def device_breakdown(index, r1: Record, r2: Record, *,
         verdict = "pruned"
     else:
         verdict = "rescored"
-    return {
+    out = {
         "per_property": [
             {"name": spec.name, "logit": float(x)}
             for spec, x in zip(frozen.device_props, per_prop)
@@ -243,6 +244,89 @@ def device_breakdown(index, r1: Record, r2: Record, *,
         "decisive_band_enabled": bool(decisive),
         "band_verdict": verdict,
     }
+    out.update(_dd_breakdown(index, frozen, feats, r1, r2, verdict,
+                             device=device))
+    return out
+
+
+def _dd_breakdown(index, frozen, feats, r1: Record, r2: Record,
+                  band_verdict: str, *, device: bool) -> Dict[str, Any]:
+    """Certified-finalization provenance (ISSUE 12): the pair's dd logit,
+    the dd margin/bounds, and ``decided_path`` — which finalization path
+    decided this pair (``device_certified`` | ``host_rescore`` |
+    ``band_skip``) — so an operator can audit why a pair never touched
+    the host.  Replays the same dd rescore program the live path runs
+    (1x1 gathered layout, Pallas branches off for the one-off shape).
+    """
+    from ..ops import scoring as S
+
+    schema = index.schema
+    if not device:
+        return {"decided_path": ("band_skip"
+                                 if band_verdict in ("filtered", "pruned")
+                                 else "host_rescore"),
+                "device_finalize_enabled": False}
+    dd_specs = S.dd_plan_specs(frozen)
+    fallback = S.dd_fallback_props(schema, frozen)
+    out: Dict[str, Any] = {
+        "device_finalize_enabled": True,
+        "dd_certifiable": [s.name for s in dd_specs],
+        "dd_fallback_properties": [p.name for p in fallback],
+    }
+    if band_verdict in ("filtered", "pruned"):
+        out["decided_path"] = "band_skip"
+        return out
+    if not getattr(index.scorer_cache, "supports_dd", True):
+        # sharded backends: the survivor gather would need collectives,
+        # so the live path always rescores on host
+        out["decided_path"] = "host_rescore"
+        out["dd_residue_reason"] = "backend"
+        return out
+    if not dd_specs:
+        out["decided_path"] = "host_rescore"
+        out["dd_residue_reason"] = "kind"
+        return out
+    from ..engine.finalize import fallback_pair_logit
+    from .device_matcher import _VALUE_SLOTS_MAX
+
+    # same value-slot cap as the live dd rescore (device_matcher), so
+    # the replayed dd_unsafe/decided_path agrees with what the live
+    # finalizer did for value-slot-saturated records
+    fn = S.dd_rescorer(frozen, queries_from_rows=False, pallas_ok=False,
+                       value_slots_cap=_VALUE_SLOTS_MAX)
+    dd_names = {s.name for s in dd_specs}
+    qf = {prop: {name: arr[0:1] for name, arr in tensors.items()}
+          for prop, tensors in feats.items() if prop in dd_names}
+    cf = {prop: {name: arr[1:2] for name, arr in tensors.items()}
+          for prop, tensors in feats.items() if prop in dd_names}
+    hi, lo, unsafe = fn(qf, cf, np.full((1,), -1, np.int32),
+                        np.zeros((1, 1), np.int32))
+    dd_logit = float(np.float64(np.asarray(hi)[0, 0])
+                     + np.float64(np.asarray(lo)[0, 0]))
+    total = dd_logit + fallback_pair_logit(fallback, r1, r2)
+    dd_margin = S.certified_dd_margin(frozen)
+    reject = S.dd_reject_bound(schema, frozen)
+    event = S.dd_event_bound(schema, frozen)
+    out.update(
+        dd_logit=dd_logit,
+        certified_dd_margin=dd_margin,
+        dd_total_logit=total,
+        dd_reject_bound=reject,
+        dd_event_bound=event,
+        dd_unsafe=bool(np.asarray(unsafe)[0, 0]),
+    )
+    if out["dd_unsafe"]:
+        out["decided_path"] = "host_rescore"
+        out["dd_residue_reason"] = "truncation"
+    elif total <= reject or total >= event:
+        # certified verdict: a reject skips the host entirely; a
+        # certified event still fetches its bit-exact confidence from
+        # one host compare, but the CLASS was decided on device
+        out["decided_path"] = "device_certified"
+    else:
+        out["decided_path"] = "host_rescore"
+        out["dd_residue_reason"] = "margin"
+    return out
 
 
 # -- retrieval provenance -----------------------------------------------------
@@ -332,6 +416,7 @@ def explain_pair(workload, r1: Record, r2: Record) -> Dict[str, Any]:
     device = device_breakdown(
         workload.index, r1, r2,
         decisive=finalizer.decisive if finalizer is not None else True,
+        device=finalizer.device if finalizer is not None else True,
     )
     out: Dict[str, Any] = {
         "workload": workload.name,

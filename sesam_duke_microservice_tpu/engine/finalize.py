@@ -26,6 +26,61 @@ makes that loop parallel, bounded, and mostly skippable:
     retains; emitted pairs always get the exact f64 rescore, preserving
     the bit-identical-probability contract.  The skipped/rescored split
     rides ``ProfileStats`` (``duke_finalize_pairs_total`` on /metrics).
+
+  * **Device-certified** (ISSUE 12, ``DUKE_DEVICE_FINALIZE``, default
+    on): survivors above the decisive band used to round-trip to host
+    Python regardless of how far from a threshold they sat, because the
+    f32 margin is too coarse to decide near-band pairs.  The dd rescore
+    (``ops.scoring.build_dd_rescorer`` over ``ops.dd``) re-scores the
+    surviving pairs on device in two-float emulated-f64 and certifies a
+    three-way verdict split per pair:
+
+      - **certified reject** — the dd logit (plus the EXACTLY-computed
+        host-side logits of any non-certifiable property, see below)
+        sits provably below every decision boundary by more than
+        ``certified_dd_margin``: no event is possible, no host
+        ``compare`` runs, no candidate ``Record`` is even resolved for
+        all-certifiable schemas.  This is where the win lives for
+        schemas with host-only properties (the survivor filter widens
+        by the optimistic host bound, so most survivors are non-events)
+        and for sharp/degenerate ``[low, high]`` ranges whose f32
+        certified margin collapsed the decisive band.  For mild
+        all-device schemas the 1e-3 survivor filter already sits at the
+        emit bound — survivors are essentially emitters — and the
+        block-level gate (``ops.scoring.dd_gate_bound``) skips the dd
+        program outright rather than paying it for nothing.
+      - **certified event** — provably above the lowest boundary: the
+        event class is certain, but the emitted confidence must be the
+        bit-exact f64 value, so the pair takes one host ``compare`` —
+        O(emitted links) host work, not O(survivors).  That compare is
+        served through a comparison-signature confidence memo
+        (``compare`` is a pure function of the comparison properties'
+        value lists, so a cached result is the bit-identical f64 by
+        construction — and the tuple keys compare by full string
+        equality, no hashing caveat): dedup traffic is dominated by
+        repeated identity groups, where every copy pair shares one
+        signature pair and the whole group costs ONE compare instead
+        of O(group^2).
+      - **ambiguous residue** — within the (tiny, ~1e-10) dd band of a
+        boundary, or carrying tensors that may have truncated the
+        record (``unsafe``): exactly today's host path.
+
+    Properties whose kind is not dd-certifiable (weighted-lev, numeric,
+    geo — and host-only comparators) fall back to the host PER PROPERTY
+    and PER PAIR: their exact f64 logits are computed with the same
+    ``Property.compare_probability`` + ``probability_logit`` fold the
+    oracle uses and added to the dd device logit, so one numeric
+    property costs per-survivor host arithmetic for that property only
+    — it does not collapse the whole schema to the legacy path.  The
+    fallback is logged once per workload (not per batch).
+
+    Events still emit from the coordinating thread in strict query
+    order through the same path — the dd rescore introduces no new
+    lock and no new emission site, so event streams and link rows stay
+    bit-identical to ``DUKE_DEVICE_FINALIZE=0`` by construction (the
+    only behavioral delta is *skipping* compares that provably emit
+    nothing).  ``duke_finalize_pairs_total{outcome=device_certified}``
+    and ``duke_dd_residue_total{reason}`` ride ``ProfileStats``.
 """
 
 from __future__ import annotations
@@ -35,9 +90,37 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence, Tuple
 
+from ..core.bayes import probability_logit
 from ..core.records import Record
+from ..store.records import record_digest
 from ..telemetry.decisions import PairDecision
 from ..telemetry.env import env_flag, env_str
+
+
+def fallback_pair_logit(props, r1: Record, r2: Record) -> float:
+    """Exact f64 logit contribution of the host-fallback properties.
+
+    The same per-property fold ``Processor.compare`` performs — max over
+    value pairs of ``Property.compare_probability``, clamped
+    ``probability_logit``, properties missing on either side contribute
+    nothing — restricted to ``props`` (core Property objects in schema
+    order, from ``ops.scoring.dd_fallback_props``).  Adding this to the
+    device dd logit reproduces the oracle's total up to f64 summation
+    order, which ``certified_dd_margin`` charges."""
+    total = 0.0
+    for prop in props:
+        vs1 = [v for v in r1.get_values(prop.name) if v]
+        vs2 = [v for v in r2.get_values(prop.name) if v]
+        if not vs1 or not vs2:
+            continue
+        best = 0.0
+        for v1 in vs1:
+            for v2 in vs2:
+                p = prop.compare_probability(v1, v2)
+                if p > best:
+                    best = p
+        total += probability_logit(best)
+    return total
 
 
 class QueryOutcome:
@@ -55,14 +138,20 @@ class QueryOutcome:
     """
 
     __slots__ = ("events", "survivors", "rescored", "skipped",
-                 "decisions", "prune", "margin", "host_bound")
+                 "decisions", "prune", "margin", "host_bound",
+                 "device_certified", "residue_margin", "residue_kind",
+                 "residue_truncation")
 
     def __init__(self, events: List[Tuple[str, Record, float]],
                  survivors: int, rescored: int, skipped: int,
                  decisions: Optional[list] = None,
                  prune: Optional[float] = None,
                  margin: Optional[float] = None,
-                 host_bound: float = 0.0):
+                 host_bound: float = 0.0,
+                 device_certified: int = 0,
+                 residue_margin: int = 0,
+                 residue_kind: int = 0,
+                 residue_truncation: int = 0):
         self.events = events
         self.survivors = survivors
         self.rescored = rescored
@@ -71,6 +160,10 @@ class QueryOutcome:
         self.prune = prune
         self.margin = margin
         self.host_bound = host_bound
+        self.device_certified = device_certified
+        self.residue_margin = residue_margin
+        self.residue_kind = residue_kind
+        self.residue_truncation = residue_truncation
 
 
 def _resolve_threads(threads: int, use_env: bool) -> int:
@@ -88,6 +181,12 @@ def _resolve_threads(threads: int, use_env: bool) -> int:
     return max(1, threads)
 
 
+# Confidence-memo capacity: keys are two 20-byte content digests + a
+# float (~100 B/entry, ~6 MB full).  Reset wholesale when full — dedup
+# traffic is dominated by a small working set of identity-pair digests.
+_CONF_CACHE_MAX = 1 << 16
+
+
 class FinalizeExecutor:
     """Block-scoped survivor-finalization executor for device processors.
 
@@ -99,11 +198,33 @@ class FinalizeExecutor:
     """
 
     def __init__(self, threads: int = 1, *, decisive: Optional[bool] = None,
-                 use_env: bool = True):
+                 device: Optional[bool] = None, use_env: bool = True):
         self.threads = _resolve_threads(threads, use_env)
         if decisive is None:
             decisive = not use_env or env_flag("DUKE_DECISIVE_BAND", True)
         self.decisive = decisive
+        # device-resident certified finalization (ISSUE 12): default on;
+        # =0 pins the legacy host path exactly.  use_env=False without an
+        # explicit ``device`` pins the legacy path too (bench baselines).
+        if device is None:
+            device = use_env and env_flag("DUKE_DEVICE_FINALIZE", True)
+        self.device = device
+        # once-per-workload notice when property kinds force host residue
+        self._kind_fallback_logged = False
+        # confidence memo (device-finalize path only, so =0 pins the
+        # legacy path exactly): (sig1, sig2) -> Processor.compare f64
+        # result, where a record's ``sig`` is the tuple of its
+        # comparison-property value lists — compare is a pure function
+        # of exactly those values, so a hit returns the bit-identical
+        # confidence, and key equality is EXACT (tuples of strings, no
+        # hash-collision caveat).  ``_sig_cache`` memoizes content
+        # digest -> sig so a candidate's signature is built once per
+        # distinct record content, not once per pair.  NO lock by design
+        # (ISSUE 12): individual dict get/set are atomic under the GIL,
+        # and the over-capacity reset rebinds a fresh dict atomically —
+        # a racing worker at worst misses a cached entry and recomputes.
+        self._conf_cache: dict = {}
+        self._sig_cache: dict = {}
         self._pool: Optional[ThreadPoolExecutor] = None  # guarded by: self._pool_lock
         self._pool_lock = threading.Lock()
 
@@ -153,6 +274,37 @@ class FinalizeExecutor:
                   if record_decisions and prune is not None else None)
         host_bound = (S.host_bound_logit(database.plan.host_props)
                       if record_decisions else 0.0)
+        # device-certified finalization (ISSUE 12): the caller attaches
+        # the block's dd rescore output (hi, lo, unsafe numpy arrays) to
+        # the result; None means the block could not ride the device
+        # (sharded corpus, http-transform probes, dd rescore disabled)
+        dd = getattr(result, "dd", None) if self.device else None
+        plan = database.plan
+        plan_has_dd = self.device and bool(S.dd_plan_specs(plan))
+        dd_reject = dd_event = None
+        fallback: List = []
+        if dd is not None and plan_has_dd:
+            dd_reject = S.dd_reject_bound(proc.schema, plan)
+            dd_event = S.dd_event_bound(proc.schema, plan)
+            fallback = S.dd_fallback_props(proc.schema, plan)
+        if self.device and not self._kind_fallback_logged:
+            # once per workload, not per batch: which properties force
+            # the per-pair host-residue path (uncertifiable kinds +
+            # host-only comparators), or that the whole schema does
+            kinds_forced = (S.dd_fallback_props(proc.schema, plan)
+                            if plan_has_dd else
+                            list(proc.schema.comparison_properties()))
+            self._kind_fallback_logged = True
+            if kinds_forced:
+                logging.getLogger("finalize").info(
+                    "device finalize: %s fall back to per-pair host "
+                    "scoring (no certified dd kernel for their "
+                    "comparator kinds)%s",
+                    sorted(p.name for p in kinds_forced),
+                    "" if plan_has_dd else
+                    " — no property is dd-certifiable, every survivor "
+                    "takes the host path",
+                )
         resolver = records_map.get
         if not isinstance(records_map, dict):
             # lazy store-backed mirrors (LazyRecordMap) mutate an LRU on
@@ -166,14 +318,31 @@ class FinalizeExecutor:
 
         compare = proc.compare
         row_ids = corpus.row_ids
+        comparison_props = list(proc.schema.comparison_properties())
+
+        def sig(rec: Record):
+            """Comparison signature: the value tuple ``compare`` is a
+            pure function of, memoized per distinct record content."""
+            d = record_digest(rec)
+            s = self._sig_cache.get(d)
+            if s is None:
+                s = tuple(tuple(rec.get_values(p.name))
+                          for p in comparison_props)
+                sc = self._sig_cache
+                if len(sc) >= _CONF_CACHE_MAX:
+                    sc = self._sig_cache = {}
+                sc[d] = s
+            return s
 
         def one(qi: int, record: Record) -> QueryOutcome:
             events: List[Tuple[str, Record, float]] = []
-            survivors = result.survivors(qi)
-            rescored = skipped = 0
+            survivors = result.survivor_triples(qi)
+            rescored = skipped = certified = 0
+            res_margin = res_kind = res_trunc = 0
             decisions: List[PairDecision] = []
             rec_id = record.record_id
-            for row, device_logit in survivors:
+            query_sig = None  # built lazily, once per query
+            for pos, row, device_logit in survivors:
                 rid = row_ids[row]
                 if rid is None or rid == rec_id:
                     continue
@@ -185,11 +354,75 @@ class FinalizeExecutor:
                         decisions.append(
                             PairDecision(rid, device_logit, True, None))
                     continue
-                candidate = resolver(rid)
+                candidate = None
+                reason = None  # why this pair takes the host compare
+                if dd_reject is not None:
+                    if dd[2][qi, pos]:
+                        # tensors may have truncated the record: the dd
+                        # counts are not certifiably the full-value
+                        # counts — host residue
+                        reason = "truncation"
+                    else:
+                        # f32 pair sums exactly in f64
+                        total = float(dd[0][qi, pos]) + float(dd[1][qi, pos])
+                        if fallback:
+                            # per-property host fallback: exact f64
+                            # logits of the non-certifiable properties
+                            candidate = resolver(rid)
+                            if candidate is None:
+                                continue
+                            total += fallback_pair_logit(
+                                fallback, record, candidate)
+                        if total <= dd_reject:
+                            # certified reject: the host f64 probability
+                            # provably cannot clear any threshold — no
+                            # compare, no event
+                            certified += 1
+                            if record_decisions:
+                                decisions.append(PairDecision(
+                                    rid, device_logit, True, None,
+                                    path="device_certified"))
+                            continue
+                        if total < dd_event:
+                            # inside the (tiny) ambiguous band around a
+                            # boundary: only the exact host compare can
+                            # decide
+                            reason = "margin"
+                        # else: certified event — the class is certain,
+                        # but the emitted confidence must be the exact
+                        # f64 value, so the pair still takes ONE compare
+                        # (O(links) host work, not residue)
+                elif self.device and not plan_has_dd:
+                    reason = "kind"
                 if candidate is None:
-                    continue
-                prob = compare(record, candidate)
+                    candidate = resolver(rid)
+                    if candidate is None:
+                        continue
+                if self.device:
+                    # comparison-signature confidence memo: a duplicate
+                    # group's every copy pair shares one (sig, sig) key,
+                    # so the group costs ONE compare.  Ordered key —
+                    # PersonName-style greedy token matching is not
+                    # provably symmetric.
+                    if query_sig is None:
+                        query_sig = sig(record)
+                    ckey = (query_sig, sig(candidate))
+                    cache = self._conf_cache
+                    prob = cache.get(ckey)
+                    if prob is None:
+                        prob = compare(record, candidate)
+                        if len(cache) >= _CONF_CACHE_MAX:
+                            cache = self._conf_cache = {}
+                        cache[ckey] = prob
+                else:
+                    prob = compare(record, candidate)
                 rescored += 1
+                if reason == "margin":
+                    res_margin += 1
+                elif reason == "kind":
+                    res_kind += 1
+                elif reason == "truncation":
+                    res_trunc += 1
                 if record_decisions:
                     decisions.append(
                         PairDecision(rid, device_logit, False, prob))
@@ -198,7 +431,8 @@ class FinalizeExecutor:
                 elif maybe is not None and maybe != 0.0 and prob > maybe:
                     events.append(("matches_perhaps", candidate, prob))
             return QueryOutcome(events, len(survivors), rescored, skipped,
-                                decisions, prune, margin, host_bound)
+                                decisions, prune, margin, host_bound,
+                                certified, res_margin, res_kind, res_trunc)
 
         if self.threads <= 1 or len(block) <= 1:
             return [one(qi, r) for qi, r in enumerate(block)]

@@ -62,10 +62,18 @@ class ProfileStats:
     pairs_compared: int = 0
     # host-finalization split (device backends): survivors rescored with
     # the exact f64 compare vs survivors skipped by decisive-band pruning
-    # (engine.finalize) — always zero on the host engine, whose candidate
-    # loop has no device pre-score to prune against
+    # vs certified-rejected on device by the dd rescore (engine.finalize)
+    # — always zero on the host engine, whose candidate loop has no
+    # device pre-score to prune against
     pairs_rescored: int = 0
     pairs_skipped: int = 0
+    pairs_device_certified: int = 0
+    # dd residue attribution (ISSUE 12): why a rescored pair could not be
+    # device-certified — ambiguous band (margin), tensor truncation, or a
+    # schema with no dd-certifiable property at all (kind)
+    dd_residue_margin: int = 0
+    dd_residue_kind: int = 0
+    dd_residue_truncation: int = 0
     retrieval_seconds: float = 0.0
     compare_seconds: float = 0.0
 
@@ -76,6 +84,10 @@ class ProfileStats:
         self.pairs_compared += other.pairs_compared
         self.pairs_rescored += other.pairs_rescored
         self.pairs_skipped += other.pairs_skipped
+        self.pairs_device_certified += other.pairs_device_certified
+        self.dd_residue_margin += other.dd_residue_margin
+        self.dd_residue_kind += other.dd_residue_kind
+        self.dd_residue_truncation += other.dd_residue_truncation
         self.retrieval_seconds += other.retrieval_seconds
         self.compare_seconds += other.compare_seconds
 

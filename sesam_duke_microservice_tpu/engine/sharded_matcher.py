@@ -192,6 +192,11 @@ class _ShardedScorerCache(_ScorerCache):
     """Brute-force scorer cache over the mesh (parallel.sharded program)."""
 
     queries_from_rows = False
+    # no device finalize on the sharded backends: the corpus feature
+    # tensors are record-axis sharded, so the survivor gather would need
+    # cross-shard collectives the follower replay never enqueues
+    # (engine.finalize falls back to the host path for every survivor)
+    supports_dd = False
 
     def _build(self, top_k: int, group_filtering: bool, from_rows: bool,
                plan=None):
@@ -215,6 +220,7 @@ class _ShardedAnnScorerCache(_AnnScorerCache):
     """ANN scorer cache over the mesh (parallel.ann_sharded program)."""
 
     queries_from_rows = False
+    supports_dd = False  # see _ShardedScorerCache
 
     def _build(self, top_c: int, group_filtering: bool, from_rows: bool,
                plan=None):

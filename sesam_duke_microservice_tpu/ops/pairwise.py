@@ -196,7 +196,14 @@ def weighted_levenshtein_sim(
 # -- Jaro-Winkler ------------------------------------------------------------
 
 
-def _jaro(c1, l1, c2, l2):
+def jaro_counts(c1, l1, c2, l2):
+    """The integer core of Jaro: (matches, transpositions) as exact int32.
+
+    Exposed for the certified dd rescore (ops.scoring): the Jaro-Winkler
+    similarity is a rational function of these counts plus the lengths
+    and the common-prefix length, so the double-double pipeline only
+    needs the counts — the float math is redone in dd.
+    """
     p, l = c1.shape
     jidx = jnp.arange(l, dtype=jnp.int32)
     window = jnp.maximum(jnp.maximum(l1, l2) // 2 - 1, 0)  # (P,)
@@ -238,7 +245,11 @@ def _jaro(c1, l1, c2, l2):
     kidx = jnp.arange(l, dtype=jnp.int32)
     diff = (m1 != m2) & (kidx < nmatch[:, None])
     transpositions = diff.sum(axis=1) // 2
+    return nmatch, transpositions.astype(jnp.int32)
 
+
+def _jaro(c1, l1, c2, l2):
+    nmatch, transpositions = jaro_counts(c1, l1, c2, l2)
     m = nmatch.astype(jnp.float32)
     n1 = jnp.maximum(l1, 1).astype(jnp.float32)
     n2 = jnp.maximum(l2, 1).astype(jnp.float32)
@@ -246,17 +257,22 @@ def _jaro(c1, l1, c2, l2):
     return jnp.where((nmatch == 0) | (l1 == 0) | (l2 == 0), 0.0, jaro)
 
 
+def common_prefix_count(c1, c2, l1, l2, *, max_prefix):
+    """Winkler common-prefix length (exact int32, capped at max_prefix)."""
+    l = c1.shape[1]
+    k = min(int(max_prefix), l)
+    kidx = jnp.arange(k, dtype=jnp.int32)
+    both = jnp.minimum(l1, l2)[:, None]
+    eq = (c1[:, :k] == c2[:, :k]) & (kidx < both)
+    return jnp.cumprod(eq.astype(jnp.int32), axis=1).sum(axis=1)
+
+
 def jaro_winkler_sim(
     c1, l1, c2, l2, equal, *, prefix_scale=0.1, boost_threshold=0.7, max_prefix=4
 ):
     """core.comparators.JaroWinkler.compare."""
     j = _jaro(c1, l1, c2, l2)
-    l = c1.shape[1]
-    k = min(max_prefix, l)
-    kidx = jnp.arange(k, dtype=jnp.int32)
-    both = jnp.minimum(l1, l2)[:, None]
-    eq = (c1[:, :k] == c2[:, :k]) & (kidx < both)
-    prefix = jnp.cumprod(eq.astype(jnp.int32), axis=1).sum(axis=1)
+    prefix = common_prefix_count(c1, c2, l1, l2, max_prefix=max_prefix)
     boosted = j + prefix.astype(jnp.float32) * prefix_scale * (1.0 - j)
     sim = jnp.where(j < boost_threshold, j, boosted)
     return jnp.where(equal, 1.0, sim)

@@ -68,7 +68,12 @@ def certified_f32_margin(plan: "F.SchemaFeatures") -> float:
       * **similarity error through the map**: a per-kernel-kind
         similarity budget (``_SIM_ERROR_BOUND``: 64 ulps for the
         integer-count-ratio kernels, wider for weighted-Levenshtein and
-        numeric, uncertifiable for geoposition), amplified by the
+        numeric, uncertifiable for THAT PROPERTY under geoposition —
+        an ``inf`` entry collapses this whole-schema bound, so decisive
+        pruning degrades to rescore-everything, but the device-finalize
+        split in ``engine.finalize`` falls back to the host PER
+        PROPERTY: the remaining certifiable properties keep their
+        device verdicts), amplified by the
         worst-case slope of the probability→log-odds composition.
         ``|dlogit/dp| = 1/(p(1-p))`` and ``|dp/dsim| <= 1``, so the
         amplification is bounded by ``1/min(high(1-high), low(1-low))``
@@ -116,11 +121,14 @@ def certified_f32_margin(plan: "F.SchemaFeatures") -> float:
 # counts with one final f32 division — 64 ulps is generous.  Weighted
 # Levenshtein accumulates up to ~256 f32 weight additions; numeric is a
 # ratio of f32-quantized doubles; both get wider budgets.  Geoposition is
-# NOT certifiable: f32 lat/lon quantization alone is meters of position
-# error, arbitrarily large in similarity units for small max-distance —
-# its inf entry collapses the decisive band (rescore everything) for any
-# schema carrying a geo property, which is the sound default for unknown
-# future kinds too.
+# NOT certifiable — but only PER PROPERTY: f32 lat/lon quantization alone
+# is meters of position error, arbitrarily large in similarity units for
+# small max-distance.  Because this whole-schema margin takes a sum over
+# properties, one inf entry still collapses the decisive band (rescore
+# everything) for any schema carrying a geo property — the sound default
+# for unknown future kinds too — while the per-property device-finalize
+# split (``engine.finalize``, ISSUE 12) routes ONLY the geo property to
+# the host and keeps certified device verdicts for the rest.
 _SIM_ERROR_BOUND = {
     F.CHARS: 64.0 * _F32_EPS,
     F.GRAM_SET: 64.0 * _F32_EPS,
@@ -163,6 +171,625 @@ def decisive_prune_logit(schema, plan: "F.SchemaFeatures") -> float:
     everything above it is rescored host-exact, so emitted probabilities
     stay bit-identical to the host engine."""
     return emit_bound_logit(schema, plan, certified_f32_margin(plan))
+
+
+# -- certified double-double (emulated-f64) finalization ---------------------
+#
+# ISSUE 12 tentpole.  The f32 margin above is a PRUNING bound: sharp
+# schemas amplify 64 float32 ulps into a band wide enough that most
+# survivors still need the host's exact f64 ``compare``.  The dd rescore
+# re-runs the comparator->probability->log-odds pipeline for the
+# surviving top-K pairs in two-float (~49-bit) arithmetic (ops.dd): the
+# integer counts the comparators reduce to (edit distances, set
+# intersection sizes, match/transposition counts, lengths) are already
+# exact on device, so only the final ratio, Duke's quadratic probability
+# map, and the clamped Bayes logit sum need the extended precision.  The
+# resulting per-pair dd logit is within ``certified_dd_margin`` —
+# typically ~1e-10 logit units — of the host's f64 value, so a verdict
+# whose logit sits farther than the margin from every decision boundary
+# is *bit-certified*: the host compare provably classifies it the same
+# way, and a certified reject can skip the host entirely.
+#
+# Branch-discontinuity soundness: every branch predicate in the
+# certified family compares a rational of BOUNDED INTEGERS against a
+# constant.  For the single-division kinds (Levenshtein, sets) the
+# argument is spacing: a rational a/b differs from a non-equal constant
+# p/q by at least 1/(qb) — >= ~1e-7 at the width caps, five orders above
+# the dd evaluation error — and when the exact ratio EQUALS the constant
+# the division is exact in both f64 and dd (dyadic results round clean),
+# so both sides take the same branch.  Jaro-Winkler is different: its
+# ``j`` is a SUM of three ratios, so an exactly-attainable boundary value
+# (j == 1/2 or 7/10 — e.g. (1/3 + 1/2 + 2/3)/3 == 0.5 exactly) is
+# computed INEXACTLY by both the host f64 chain and the dd chain, and
+# the two roundings can land on opposite sides of the comparison
+# (observed in the randomized differential: host j == 0.5 took the map's
+# high branch, dd j == 0.5 - 2^-45 took ``low`` — a 1.17-logit verdict
+# flip).  JW pairs whose dd ``j`` sits within ``_DD_JW_BRANCH_GUARD`` of
+# a branch constant are therefore flagged into the host residue; off the
+# guard band, |host j - dd j| <= ~1e-12 << guard keeps the branches
+# aligned.  Hash-collision exposure (``equal`` and gram/token ids ride
+# 64/32-bit FNV hashes) is exactly the f32 certified path's existing
+# featurization assumption — and a false-positive ``equal`` only RAISES
+# the dd logit, pushing the pair toward host rescore, never toward a
+# wrong certified reject.
+
+def _dd():
+    from . import dd as D
+
+    return D
+
+
+# Feature kinds whose device counts are exact integers — the certified
+# dd family.  CHARS_WEIGHTED (f32 weight accumulation), NUMERIC (inputs
+# f32-quantized at extraction) and GEO (uncertifiable per the f32 table)
+# fall back to the host per property.
+DD_KINDS = (F.CHARS, F.GRAM_SET, F.TOKEN_SET, F.HASH, F.PHONETIC)
+
+# Jaro-Winkler's branch constants (boost 0.7, the 0.5 map split) are
+# compared against rationals with denominator 3*n1*n2*m; past this char
+# width the rational spacing argument above thins below 1e-7, so wider
+# JW properties fall back to the host instead of eroding the proof.
+_DD_JW_MAX_CHARS = 64
+
+# dd similarity-error budgets, in units absorbed by certified_dd_margin:
+# ratio kinds pay one dd division + the map's ~6 dd ops; JW pays three
+# divisions, the 3-term average and the boost; hash/phonetic are
+# constants reproduced from the oracle's own f64 values.  All generous
+# multiples of the per-op DD_EPS.
+_DD_SIM_OPS = {
+    F.CHARS: 64.0,
+    F.GRAM_SET: 64.0,
+    F.TOKEN_SET: 64.0,
+    F.HASH: 16.0,
+    F.PHONETIC: 16.0,
+}
+_DD_JW_SIM_OPS = 256.0
+
+
+def dd_certifiable_spec(spec: "F.PropertyFeatureSpec") -> bool:
+    """Can this device property's verdict ride the certified dd rescore?
+
+    Kind must be in the integer-count-ratio family; Jaro-Winkler
+    additionally caps the char width (see ``_DD_JW_MAX_CHARS``).
+    """
+    if spec.kind not in DD_KINDS:
+        return False
+    if spec.kind == F.CHARS and isinstance(spec.comparator, C.JaroWinkler):
+        return spec.chars <= _DD_JW_MAX_CHARS
+    return True
+
+
+def dd_plan_specs(plan: "F.SchemaFeatures"):
+    """The dd-certifiable subset of the plan's device properties."""
+    return [s for s in plan.device_props if dd_certifiable_spec(s)]
+
+
+def dd_fallback_props(schema, plan: "F.SchemaFeatures"):
+    """Properties the device-finalize path evaluates on host PER PAIR:
+    the plan's host-only properties plus device properties whose kind is
+    not dd-certifiable (weighted-lev / numeric / geo — the per-property
+    fallback, not a per-schema collapse).  Returns core Property objects
+    in schema order so the host-side fold matches the oracle's."""
+    dd_names = {s.name for s in dd_plan_specs(plan)}
+    return [p for p in schema.comparison_properties()
+            if p.name not in dd_names]
+
+
+def certified_dd_margin(plan: "F.SchemaFeatures") -> float:
+    """Certified bound on |dd device logit - host f64 logit| for the
+    dd-certifiable properties of ``plan``.
+
+    Sibling of ``certified_f32_margin`` with the same structure — a
+    per-property similarity budget amplified by the worst-case
+    probability->log-odds slope, a per-property log-evaluation budget,
+    and a sum-accumulation term — but charged at the dd per-op epsilon
+    (``ops.dd.DD_EPS`` = 2^-44, itself generous against the ~2^-47 true
+    double-float bounds) instead of float32 ulps.  The slack also
+    absorbs the HOST side's own f64 rounding (u64 = 2^-53 per op,
+    hundreds of times below DD_EPS), so the bound is against the host's
+    computed value, not the exact real — which is what verdict
+    certification needs.  Typical schemas land near 1e-10 logit units,
+    ~7 orders of magnitude inside the f32 margin; even a degenerate
+    high=1-1e-8 property (amplification 1e8) keeps the dd band at
+    ~1e-3, where the f32 band has long since collapsed.
+
+    Only dd-certifiable properties contribute: the uncertifiable kinds
+    are evaluated on host per property (``dd_fallback_props``), exactly,
+    so they add f64 noise covered by the accumulation term, never an
+    amplified similarity error.
+    """
+    D = _dd()
+    specs = dd_plan_specs(plan)
+    n_all = max(1, len(plan.device_props) + len(plan.host_props))
+    # f64 accumulation-order slack: the oracle interleaves dd and host
+    # properties in schema order, the split path sums them in two runs
+    total = n_all * D.DD_EPS * (n_all * _MAX_LOGIT)
+    for spec in specs:
+        high = min(max(float(spec.high), _EPS), 1.0 - _EPS)
+        low = min(max(float(spec.low), _EPS), 1.0 - _EPS)
+        amplification = 1.0 / min(high * (1.0 - high), low * (1.0 - low))
+        if spec.kind == F.CHARS and isinstance(spec.comparator,
+                                               C.JaroWinkler):
+            sim_err = _DD_JW_SIM_OPS * D.DD_EPS
+        else:
+            sim_err = _DD_SIM_OPS[spec.kind] * D.DD_EPS
+        total += min(sim_err * amplification, 2.0 * _MAX_LOGIT)
+        # dd log evaluation: absolute + relative parts (ops.dd bounds)
+        total += 2.0 * (D.LOG_ERR_ABS + D.DD_EPS * _MAX_LOGIT)
+    return total
+
+
+def _dd_threshold_slack(threshold: float) -> float:
+    """Logit-space slack covering the host's PROBABILITY-space compare.
+
+    The oracle classifies ``sigmoid(logit) > t`` with both sides in f64;
+    certification compares logits against ``probability_to_logit(t)``.
+    The translation costs a few f64 ulps of the sigmoid evaluation
+    amplified by the logit slope at ``t`` plus the rounding of
+    ``logit(t)`` itself — generous at 64 u64 per part.
+    """
+    t = min(max(float(threshold), _EPS), 1.0 - _EPS)
+    u64 = 2.0 ** -53
+    return 64.0 * u64 * (1.0 / (t * (1.0 - t))) + 64.0 * u64 * _MAX_LOGIT
+
+
+def dd_reject_bound(schema, plan: "F.SchemaFeatures") -> float:
+    """Total-logit bound below which a survivor is a *certified reject*:
+    ``dd_logit + exact host-property logits <= this`` implies the host
+    f64 probability cannot exceed ``min(threshold, maybe_threshold)``,
+    so no event is possible and the host ``compare`` is skipped.
+
+    Unlike ``decisive_prune_logit`` there is no optimistic host-property
+    bound to subtract — the fallback properties are evaluated EXACTLY on
+    host per pair — so the band around the boundary is just the dd
+    margin plus the probability-space comparison slack."""
+    thresholds = [schema.threshold]
+    if schema.maybe_threshold:
+        thresholds.append(schema.maybe_threshold)
+    t = min(thresholds)
+    return (probability_to_logit(t) - certified_dd_margin(plan)
+            - _dd_threshold_slack(t))
+
+
+def dd_gate_bound(schema, plan: "F.SchemaFeatures") -> float:
+    """f32-device-logit bound above which a survivor certifiably CANNOT
+    be a dd certified reject — the block-level dispatch gate.
+
+    A pair's certification total is the f64 logit over every property:
+    the f32 device logit approximates the device-property part within
+    ``certified_f32_margin`` (infinite for geo/degenerate schemas —
+    then the gate is +inf and the dd program always dispatches, which
+    is sound), and the host-only properties contribute at least
+    ``sum(min(0, logit(min(low, 0.5))))`` (each is missing-neutral 0 or
+    at worst its clamped ``low``).  A survivor whose f32 logit already
+    exceeds ``dd_reject_bound`` plus those two allowances can only be a
+    certified EVENT or residue — both take the host compare regardless
+    — so a block with no survivor under this bound skips the dd rescore
+    program entirely (the common shape for duplicate-heavy ingest,
+    where every survivor is an emitter)."""
+    lmin = 0.0
+    for p in plan.host_props:
+        lmin += min(0.0, probability_to_logit(min(float(p.low), 0.5)))
+    return (dd_reject_bound(schema, plan) + certified_f32_margin(plan)
+            - lmin)
+
+
+def dd_event_bound(schema, plan: "F.SchemaFeatures") -> float:
+    """Total-logit bound above which a survivor *certifiably emits* some
+    event (match or maybe).  Such pairs still take one host ``compare``
+    — the emitted confidence must be the bit-exact f64 value — but they
+    are a certified verdict, not ambiguous residue: the host work is
+    O(emitted events), not O(survivors)."""
+    thresholds = [schema.threshold]
+    if schema.maybe_threshold:
+        thresholds.append(schema.maybe_threshold)
+    t = min(thresholds)
+    return (probability_to_logit(t) + certified_dd_margin(plan)
+            + _dd_threshold_slack(t))
+
+
+# -- the dd rescore program ---------------------------------------------------
+
+
+def _dd_map_probability(spec, sim, one):
+    """Duke's probability map in dd, returning (p, one_minus_p).
+
+    ``p`` mirrors the oracle's f64 expression ``(high-0.5)*sim^2 + 0.5``
+    term for term (the dd constants are splits of the very f64
+    intermediates the host computes), while ``one_minus_p`` uses the
+    cancellation-free rearrangement ``0.5*(1-sim^2) + (1-high)*sim^2``
+    so its RELATIVE accuracy survives ``high`` near 1 — the log of the
+    complement is where a naive ``1 - p`` would burn the whole margin.
+    """
+    D = _dd()
+    like = sim[0]
+    half = D.const(0.5, like=like)
+    ge05 = D.ge(sim, half)
+    s2 = D.mul(sim, sim)
+    hc = D.const(float(spec.high) - 0.5, like=like)
+    p_hi = D.add(D.mul(hc, s2), half)
+    omp_hi = D.add(
+        D.mul(half, D.sub(one, s2)),
+        D.mul(D.const(1.0 - float(spec.high), like=like), s2),
+    )
+    p_lo = D.const(float(spec.low), like=like)
+    omp_lo = D.const(1.0 - float(spec.low), like=like)
+    return D.where(ge05, p_hi, p_lo), D.where(ge05, omp_hi, omp_lo)
+
+
+def _dd_levenshtein_sim(c1, l1, c2, l2, equal, *, dist=None):
+    """Levenshtein similarity in dd from the exact integer distance."""
+    D = _dd()
+    if dist is None:
+        if c1.shape[1] <= 32:
+            dist = pw.levenshtein_distance_myers(c1, l1, c2, l2)
+        else:
+            dist = pw.levenshtein_distance(c1, l1, c2, l2)
+    shorter = jnp.minimum(l1, l2)
+    longer = jnp.maximum(l1, l2)
+    dist = jnp.minimum(dist, shorter)
+    one = D.from_f32(jnp.ones(dist.shape, jnp.float32))
+    sim = D.sub(one, D.div(D.from_int(dist),
+                           D.from_int(jnp.maximum(shorter, 1))))
+    zero = ((longer - shorter) * 2 > shorter) | (shorter == 0)
+    sim = D.where(zero, D.const(0.0, like=sim[0]), sim)
+    return D.where(equal, one, sim)
+
+
+# JW branch-guard half-width (see the soundness block above): far above
+# the ~1e-12 dd + f64 evaluation noise of ``j``, far below the ~1e-7
+# rational spacing of non-boundary j values — pairs inside it go to the
+# host residue instead of trusting a branch both sides computed
+# inexactly.
+_DD_JW_BRANCH_GUARD = 1e-9
+
+
+def _dd_jaro_winkler_sim(c1, l1, c2, l2, equal, cmp):
+    """Jaro-Winkler in dd from the exact match/transposition counts.
+
+    Returns (sim, branch_unsafe): pairs whose ``j`` sits inside the
+    guard band of the 0.5 map split or the boost threshold cannot be
+    certified (host f64 and dd may round an exactly-boundary ``j`` to
+    opposite sides) and must take the host path.
+    """
+    D = _dd()
+    m, t = pw.jaro_counts(c1, l1, c2, l2)
+    prefix = pw.common_prefix_count(c1, c2, l1, l2,
+                                    max_prefix=int(cmp.max_prefix))
+    md = D.from_int(m)
+    a = D.div(md, D.from_int(jnp.maximum(l1, 1)))
+    b = D.div(md, D.from_int(jnp.maximum(l2, 1)))
+    cpart = D.div(D.from_int(m - t), D.from_int(jnp.maximum(m, 1)))
+    like = a[0]
+    j = D.div(D.add(D.add(a, b), cpart), D.const(3.0, like=like))
+    zero = (m == 0) | (l1 == 0) | (l2 == 0)
+    j = D.where(zero, D.const(0.0, like=like), j)
+    one = D.from_f32(jnp.ones_like(like))
+    # oracle: j + prefix * prefix_scale * (1.0 - j), left-associated
+    boosted = D.add(j, D.mul(
+        D.mul(D.from_int(prefix), D.const(float(cmp.prefix_scale),
+                                          like=like)),
+        D.sub(one, j),
+    ))
+    boost_c = D.const(float(cmp.boost_threshold), like=like)
+    sim = D.where(D.lt(j, boost_c), j, boosted)
+    # the dd sub's hi word carries the (cancellation-exact) distance to
+    # the branch constants at full small-magnitude f32 resolution
+    guard = jnp.float32(_DD_JW_BRANCH_GUARD)
+    near_map = jnp.abs(D.sub(j, D.const(0.5, like=like))[0]) < guard
+    near_boost = jnp.abs(D.sub(j, boost_c)[0]) < guard
+    unsafe = (near_map | near_boost) & ~equal & ~zero
+    return D.where(equal, one, sim), unsafe
+
+
+def _dd_set_sim(common, f1, f2, equal, *, formula):
+    """Set-overlap similarity in dd from exact intersection counts."""
+    D = _dd()
+    c = D.from_int(common)
+    if formula == "jaccard":
+        sim = D.div(c, D.from_int(jnp.maximum(f1 + f2 - common, 1)))
+    elif formula == "dice":
+        sim = D.div(D.from_int(2 * common),
+                    D.from_int(jnp.maximum(f1 + f2, 1)))
+    else:
+        sim = D.div(c, D.from_int(jnp.maximum(jnp.minimum(f1, f2), 1)))
+    one = D.from_f32(jnp.ones(common.shape, jnp.float32))
+    sim = D.where((f1 == 0) | (f2 == 0), D.const(0.0, like=sim[0]), sim)
+    return D.where(equal, one, sim)
+
+
+def _dd_property_sim(spec: "F.PropertyFeatureSpec", qf, cf,
+                     pallas_ok: bool):
+    """(dd sim, combo_valid, branch_unsafe | None) for one certified
+    property, gathered layout ((Q, Vq, ...) queries x (Q, C, Vc, ...)
+    candidates), flat combos.  ``branch_unsafe`` is non-None only for
+    kinds with a multi-op similarity (Jaro-Winkler) whose boundary
+    values need the runtime guard band."""
+    D = _dd()
+    expand = _pair_expand_gathered
+    hh1, hh2 = expand(qf["hash_hi"], cf["hash_hi"])
+    hl1, hl2 = expand(qf["hash_lo"], cf["hash_lo"])
+    v1, v2 = expand(qf["valid"], cf["valid"])
+    combo_valid = v1 & v2
+    equal = (hh1 == hh2) & (hl1 == hl2) & combo_valid
+
+    kind = spec.kind
+    cmp = spec.comparator
+    if kind == F.CHARS and isinstance(cmp, C.JaroWinkler):
+        c1, c2 = expand(qf["chars"], cf["chars"])
+        l1, l2 = expand(qf["length"], cf["length"])
+        sim, branch_unsafe = _dd_jaro_winkler_sim(c1, l1, c2, l2, equal,
+                                                  cmp)
+        return sim, combo_valid, branch_unsafe
+    if kind == F.CHARS:
+        if (
+            pallas_ok
+            and qf["chars"].shape[1] == 1      # single value slot per side
+            and cf["chars"].shape[2] == 1
+            and qf["chars"].shape[2] <= 32
+            and pk.pallas_enabled()
+        ):
+            # ride the existing gathered Myers Pallas tile kernel — the
+            # dd path only needs its exact integer DISTANCE, the ratio
+            # and map run in dd outside the kernel
+            q = qf["valid"].shape[0]
+            c = cf["valid"].shape[1]
+            dist = pk.myers_distance_gathered(
+                qf["chars"][:, 0], qf["length"][:, 0],
+                cf["chars"][:, :, 0], cf["length"][:, :, 0],
+            ).reshape(-1)
+            l1 = jnp.broadcast_to(
+                qf["length"][:, None, 0], (q, c)).reshape(-1)
+            l2 = cf["length"][:, :, 0].reshape(-1)
+            return (_dd_levenshtein_sim(None, l1, None, l2, equal,
+                                        dist=dist), combo_valid,
+                    None)
+        c1, c2 = expand(qf["chars"], cf["chars"])
+        l1, l2 = expand(qf["length"], cf["length"])
+        return (_dd_levenshtein_sim(c1, l1, c2, l2, equal), combo_valid,
+                None)
+    if kind == F.GRAM_SET:
+        g1, g2 = expand(qf["grams"], cf["grams"])
+        n1, n2 = expand(qf["gram_count"], cf["gram_count"])
+        common = pw.set_intersection_count(g1, n1, g2, n2)
+        return _dd_set_sim(common, n1, n2, equal,
+                           formula=cmp.formula), combo_valid, None
+    if kind == F.TOKEN_SET:
+        t1, t2 = expand(qf["tokens"], cf["tokens"])
+        n1, n2 = expand(qf["token_count"], cf["token_count"])
+        formula = "dice" if isinstance(cmp, C.DiceCoefficient) else "jaccard"
+        common = pw.set_intersection_count(t1, n1, t2, n2)
+        return _dd_set_sim(common, n1, n2, equal,
+                           formula=formula), combo_valid, None
+    if kind == F.HASH:
+        one = D.from_f32(jnp.ones(equal.shape, jnp.float32))
+        zero = D.const(0.0, like=one[0])
+        if isinstance(cmp, C.Different):
+            return D.where(equal, zero, one), combo_valid, None
+        return D.where(equal, one, zero), combo_valid, None
+    if kind == F.PHONETIC:
+        ch1, ch2 = expand(qf["code_hi"], cf["code_hi"])
+        cl1, cl2 = expand(qf["code_lo"], cf["code_lo"])
+        cv1, cv2 = expand(qf["code_valid"], cf["code_valid"])
+        one = D.from_f32(jnp.ones(equal.shape, jnp.float32))
+        code_eq = (ch1 == ch2) & (cl1 == cl2) & cv1 & cv2
+        sim = D.where(code_eq, D.const(0.9, like=one[0]),
+                      D.const(0.0, like=one[0]))
+        return D.where(equal, one, sim), combo_valid, None
+    raise ValueError(  # pragma: no cover - dd_certifiable_spec gates kinds
+        f"no dd kernel for feature kind {kind!r}")
+
+
+# The oracle's clamp rails (core.bayes.probability_logit): pairs whose
+# best probability clamps reproduce the host's exact f64 logit constant.
+_DD_EPS_P = 1e-10
+
+
+def _dd_property_logit(spec, qf, cf, q: int, c: int, pallas_ok: bool):
+    """One certified property's clamped log-odds in dd plus its
+    branch-unsafety: (((Q, C) hi, lo), (Q, C) bool).
+
+    Mirrors ``_property_logit`` — max over value-pair combos in
+    probability space, then the clamped logit — with every float step in
+    dd and the clamp rails emitting the oracle's own f64 constants.  A
+    pair is branch-unsafe when ANY of its valid combos carries a
+    branch-guard flag (conservative: a flagged non-best combo still
+    flags the pair — the best-combo fold itself is only dd-accurate).
+    """
+    D = _dd()
+    sim, combo_valid, branch_unsafe = _dd_property_sim(spec, qf, cf,
+                                                       pallas_ok)
+    one = D.from_f32(jnp.ones_like(sim[0]))
+    p, omp = _dd_map_probability(spec, sim, one)
+    # fold the combo axis: max in probability space, carrying the
+    # matching complement (combo count is small and static — unrolled)
+    ncombo = sim[0].shape[0] // (q * c)
+    p3 = (p[0].reshape(q, c, ncombo), p[1].reshape(q, c, ncombo))
+    omp3 = (omp[0].reshape(q, c, ncombo), omp[1].reshape(q, c, ncombo))
+    valid3 = combo_valid.reshape(q, c, ncombo)
+    neg = D.const(-1.0, like=p3[0][:, :, 0])
+    best_p = neg
+    best_omp = D.const(1.0, like=neg[0])
+    for i in range(ncombo):
+        pi = (p3[0][:, :, i], p3[1][:, :, i])
+        oi = (omp3[0][:, :, i], omp3[1][:, :, i])
+        take = valid3[:, :, i] & D.lt(best_p, pi)
+        best_p = D.where(take, pi, best_p)
+        best_omp = D.where(take, oi, best_omp)
+    any_valid = valid3.any(axis=2)
+
+    like = best_p[0]
+    eps = D.const(_DD_EPS_P, like=like)
+    ome = D.const(1.0 - _DD_EPS_P, like=like)
+    below = D.le(best_p, eps)
+    above = D.ge(best_p, ome)
+    pc = D.clamp(best_p, eps, ome)
+    # complement floor far below the real rail: rail lanes are overridden
+    # with the oracle's exact constants right after, this only keeps the
+    # division finite
+    ompc = D.clamp(best_omp, D.const(1e-12, like=like),
+                   D.const(1.0, like=like))
+    logit = D.log(D.div(pc, ompc))
+    logit = D.where(above, D.const(probability_to_logit(1.0), like=like),
+                    logit)
+    logit = D.where(below, D.const(probability_to_logit(0.0), like=like),
+                    logit)
+    zero = D.const(0.0, like=like)
+    if branch_unsafe is None:
+        unsafe_qc = jnp.zeros((q, c), bool)
+    else:
+        unsafe_qc = (branch_unsafe.reshape(q, c, ncombo)
+                     & valid3).any(axis=2)
+    return D.where(any_valid, logit, zero), unsafe_qc
+
+
+def _dd_unsafe_mask(spec, qf, cf, *, value_slots_cap: int) -> jnp.ndarray:
+    """(Q, C) bool: pairs whose tensors MAY have truncated the records.
+
+    Certification needs the device counts to be the counts of the FULL
+    record values; the padded layout truncates in three places — value
+    slots past the auto-growth cap, char widths at the per-property
+    width, set sizes at the gram/token tensor width.  The tensors carry
+    the evidence conservatively: a saturated slot (length == width,
+    count == capacity, all value slots valid at the cap) may or may not
+    have truncated, so it flags the pair into the host-rescore residue
+    (reason="truncation").  False positives (a value exactly at the
+    width) cost one host compare; false negatives cannot happen.
+    """
+    def side(f):
+        valid = f["valid"]
+        u = jnp.zeros(valid.shape[:-1], bool)
+        if value_slots_cap and valid.shape[-1] >= value_slots_cap:
+            u = u | valid.all(axis=-1)
+        if spec.kind == F.CHARS:
+            width = f["chars"].shape[-1]
+            u = u | ((f["length"] >= width) & valid).any(axis=-1)
+        elif spec.kind == F.GRAM_SET:
+            cap = f["grams"].shape[-1]
+            u = u | ((f["gram_count"] >= cap) & valid).any(axis=-1)
+        elif spec.kind == F.TOKEN_SET:
+            cap = f["tokens"].shape[-1]
+            u = u | ((f["token_count"] >= cap) & valid).any(axis=-1)
+        return u
+
+    uq = side(qf)                # (Q,)
+    uc = side(cf)                # (Q, C)
+    return uq[:, None] | uc
+
+
+def build_dd_rescorer(plan: "F.SchemaFeatures", *,
+                      queries_from_rows: bool = True,
+                      value_slots_cap: int = 0,
+                      pallas_ok: bool = True):
+    """The jitted survivor dd-rescore program, or None when no property
+    is dd-certifiable.
+
+    Signature::
+
+        fn(qfeats, corpus_feats, query_row, top_index)
+          -> (logit_hi (Q, K) f32, logit_lo (Q, K) f32, unsafe (Q, K) bool)
+
+    ``top_index`` is the resolved block's (Q, K) global candidate rows
+    (-1 padding gathers row 0, results ignored by the caller);
+    ``qfeats`` is ``{}`` under ``queries_from_rows`` (query features
+    gather on device from the corpus at ``query_row``, the same
+    convention as ``build_corpus_scorer``).  ``logit_hi + logit_lo``
+    (summed in f64 on host — exact for a float32 pair) is the dd logit
+    over the dd-certifiable device properties; ``unsafe`` marks pairs
+    whose tensors may have truncated the records (``_dd_unsafe_mask``).
+
+    Rides ``rescore_retrieved``'s gathered layout: candidate k of query
+    q is a specific corpus row, and the dominant single-value CHARS
+    shape rides the existing gathered Myers Pallas kernel for its
+    integer distance.
+    """
+    specs = dd_plan_specs(plan)
+    if not specs:
+        return None
+    D = _dd()
+
+    @jax.jit
+    def rescore(qfeats, corpus_feats, query_row, top_index):
+        q, k = top_index.shape
+        rows = jnp.clip(top_index, 0).reshape(-1)
+        if queries_from_rows:
+            qrows = jnp.clip(query_row, 0)
+            qfeats_l = {
+                spec.name: {
+                    name: jnp.take(arr, qrows, axis=0)
+                    for name, arr in corpus_feats[spec.name].items()
+                }
+                for spec in specs
+            }
+        else:
+            qfeats_l = qfeats
+        total = (jnp.zeros((q, k), jnp.float32),
+                 jnp.zeros((q, k), jnp.float32))
+        unsafe = jnp.zeros((q, k), bool)
+        for spec in specs:
+            cf = {
+                name: jnp.take(arr, rows, axis=0).reshape(
+                    (q, k) + arr.shape[1:]
+                )
+                for name, arr in corpus_feats[spec.name].items()
+            }
+            qf = qfeats_l[spec.name]
+            prop_logit, branch_unsafe = _dd_property_logit(
+                spec, qf, cf, q, k, pallas_ok
+            )
+            total = D.add(total, prop_logit)
+            unsafe = unsafe | branch_unsafe | _dd_unsafe_mask(
+                spec, qf, cf, value_slots_cap=value_slots_cap
+            )
+        return total[0], total[1], unsafe
+
+    return rescore
+
+
+# Process-wide memo of built dd rescorers by plan VALUE fingerprint: many
+# workloads (and, in the test suite, many short-lived indexes) share one
+# schema shape, and each jitted instance pays its own XLA compiles —
+# sharing one instance turns that into per-unique-(plan, shape) compiles
+# for the whole process.  Deliberately LOCK-FREE (ISSUE 12: the dd
+# rescore introduces no new lock): a concurrent miss builds twice and
+# one instance wins the dict slot — benign, the loser is just an extra
+# tracing.  Bounded FIFO like engine.explain's per-plan cache.
+_DD_CACHE: Dict[tuple, object] = {}
+_DD_CACHE_CAP = 64
+
+
+def _dd_plan_key(plan: "F.SchemaFeatures", extra: tuple) -> tuple:
+    key = [extra]
+    for s in dd_plan_specs(plan):
+        cmp = s.comparator
+        key.append((
+            s.name, s.kind, float(s.low), float(s.high), s.v, s.chars,
+            type(cmp).__name__,
+            getattr(cmp, "formula", None),
+            float(getattr(cmp, "prefix_scale", 0.0)),
+            float(getattr(cmp, "boost_threshold", 0.0)),
+            int(getattr(cmp, "max_prefix", 0)),
+        ))
+    return tuple(key)
+
+
+def dd_rescorer(plan: "F.SchemaFeatures", *, queries_from_rows: bool = True,
+                value_slots_cap: int = 0, pallas_ok: bool = True):
+    """Memoized ``build_dd_rescorer`` (None when nothing is certifiable)."""
+    specs = dd_plan_specs(plan)
+    if not specs:
+        return None
+    key = _dd_plan_key(plan, (queries_from_rows, value_slots_cap, pallas_ok))
+    fn = _DD_CACHE.get(key)
+    if fn is None:
+        fn = build_dd_rescorer(
+            plan, queries_from_rows=queries_from_rows,
+            value_slots_cap=value_slots_cap, pallas_ok=pallas_ok,
+        )
+        if len(_DD_CACHE) >= _DD_CACHE_CAP:
+            _DD_CACHE.pop(next(iter(_DD_CACHE)))
+        _DD_CACHE[key] = fn
+    return fn
 
 
 # -- per-property pair similarity -------------------------------------------

@@ -203,6 +203,7 @@ def make_app_collector(app):
         warm_samples = []
         finalize_samples = []
         finalize_threads = []
+        dd_residue_samples = []
         decision_samples = []
         disagreement_samples = []
         pair_logit_samples = []
@@ -243,15 +244,29 @@ def make_app_collector(app):
                         hist.samples(labels + (("property", prop),)))
             finalizer = getattr(proc, "finalizer", None)
             if finalizer is not None and stats is not None:
-                # decisive-band split: survivors rescored host-exact vs
-                # certifiably skipped without a compare (engine.finalize)
+                # finalization split: survivors rescored host-exact vs
+                # skipped by the decisive band vs certified-rejected on
+                # device by the dd rescore (engine.finalize, ISSUE 12)
                 finalize_samples.append((
                     "", labels + (("outcome", "rescored"),),
                     stats.pairs_rescored))
                 finalize_samples.append((
                     "", labels + (("outcome", "skipped"),),
                     stats.pairs_skipped))
+                finalize_samples.append((
+                    "", labels + (("outcome", "device_certified"),),
+                    stats.pairs_device_certified))
                 finalize_threads.append(("", labels, finalizer.threads))
+                # why rescored pairs could not be device-certified
+                dd_residue_samples.append((
+                    "", labels + (("reason", "margin"),),
+                    stats.dd_residue_margin))
+                dd_residue_samples.append((
+                    "", labels + (("reason", "kind"),),
+                    stats.dd_residue_kind))
+                dd_residue_samples.append((
+                    "", labels + (("reason", "truncation"),),
+                    stats.dd_residue_truncation))
             live = getattr(wl.index, "live_records", None)
             indexed = None
             corpus = getattr(wl.index, "corpus", None)
@@ -457,17 +472,26 @@ def make_app_collector(app):
             out.append(FamilySnapshot(
                 "duke_finalize_pairs_total", "counter",
                 "Device-scored survivors by finalization outcome: "
-                "rescored host-exact vs skipped by decisive-band pruning",
+                "rescored host-exact, skipped by decisive-band pruning, "
+                "or certified-rejected on device by the dd rescore",
                 finalize_samples))
             out.append(FamilySnapshot(
                 "duke_finalize_threads", "gauge",
                 "Worker threads in the host-finalization pool "
                 "(DUKE_FINALIZE_THREADS)", finalize_threads))
+            out.append(FamilySnapshot(
+                "duke_dd_residue_total", "counter",
+                "Host-rescored survivors the dd rescore could not "
+                "certify, by reason: ambiguous margin band, "
+                "uncertifiable property kind, or an unsafe pair "
+                "(tensor truncation / JW branch-boundary guard)",
+                dd_residue_samples))
         if decision_samples:
             out.append(FamilySnapshot(
                 "duke_decisions_total", "counter",
-                "Match decisions by outcome (match, maybe, reject, or "
-                "pruned by the decisive band)", decision_samples))
+                "Match decisions by outcome (match, maybe, reject, "
+                "pruned by the decisive band, or device_certified by "
+                "the dd rescore)", decision_samples))
             out.append(FamilySnapshot(
                 "duke_decision_disagreements_total", "counter",
                 "Decisions where the float32 device verdict crossed a "

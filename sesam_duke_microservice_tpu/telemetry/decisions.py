@@ -160,14 +160,19 @@ class PairDecision:
     workers (cheap tuple-of-scalars) and consumed serially by the
     coordinator's ``DecisionRecorder.observe``."""
 
-    __slots__ = ("candidate_id", "device_logit", "skipped", "probability")
+    __slots__ = ("candidate_id", "device_logit", "skipped", "probability",
+                 "path")
 
     def __init__(self, candidate_id: str, device_logit: Optional[float],
-                 skipped: bool, probability: Optional[float]):
+                 skipped: bool, probability: Optional[float],
+                 path: Optional[str] = None):
         self.candidate_id = candidate_id
         self.device_logit = device_logit
         self.skipped = skipped
         self.probability = probability
+        # which finalization path skipped the pair: None (band skip /
+        # rescored) or "device_certified" (dd certified reject, ISSUE 12)
+        self.path = path
 
 
 _DECISION_SEQ = itertools.count(1)
@@ -212,6 +217,7 @@ class DecisionRecorder:
         # single-writer drift-monitor state (scrape-time snapshots)
         self.outcomes: Dict[str, int] = {
             "match": 0, "maybe": 0, "reject": 0, "pruned": 0,
+            "device_certified": 0,
         }
         self.disagreements = 0
         self.latched = 0
@@ -237,14 +243,25 @@ class DecisionRecorder:
             latch = None
             pair_logit = None
             if d.skipped:
-                outcome = "pruned"
-                if prune is not None and d.device_logit is not None:
-                    slack = prune - d.device_logit
-                    self.margin_slack_hist.observe(slack)
-                    if margin is not None and slack <= margin:
-                        # the skips that would flip first if the
-                        # certified margin were wrong: always retained
-                        latch = "near-band-skip"
+                if getattr(d, "path", None) == "device_certified":
+                    # dd certified reject (ISSUE 12): not a band skip —
+                    # the dd logit sat ABOVE the prune bound, so the
+                    # band-slack histogram and near-band latch do not
+                    # apply (its own certification band is ~1e-10 and
+                    # residue pairs go to the host instead of
+                    # skipping).  Ring SAMPLING below still does:
+                    # certified rejects must stay auditable.
+                    outcome = "device_certified"
+                else:
+                    outcome = "pruned"
+                    if prune is not None and d.device_logit is not None:
+                        slack = prune - d.device_logit
+                        self.margin_slack_hist.observe(slack)
+                        if margin is not None and slack <= margin:
+                            # the skips that would flip first if the
+                            # certified margin were wrong: always
+                            # retained
+                            latch = "near-band-skip"
             else:
                 outcome = classify(d.probability, threshold, maybe)
                 pair_logit = probability_to_logit(d.probability)

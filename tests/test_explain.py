@@ -448,7 +448,8 @@ class TestEnginePathRecording:
         total = sum(rec.outcomes.values())
         assert total > 0
         assert total == (proc.stats.pairs_rescored
-                         + proc.stats.pairs_skipped)
+                         + proc.stats.pairs_skipped
+                         + proc.stats.pairs_device_certified)
         assert len(rec.ring) > 0
         one = rec.records()[0]
         assert one["query"].startswith("r")

@@ -300,25 +300,45 @@ def test_feed_midstream_deadline_abort(monkeypatch):
             Link(f"crm__a{i}", f"crm__b{i}", LinkStatus.INFERRED,
                  LinkKind.DUPLICATE, 0.9, timestamp=base_ts + i))
 
-    release = threading.Event()
-    stolen = threading.Event()
+    # Deterministic contention: after page 1 the wrapped lock DENIES the
+    # feed's mid-stream re-acquisitions (simulating a writer holding the
+    # lock past the deadline).  A racing "thief" thread was flaky two
+    # ways — it could miss the whole stream (all pages fit in one GIL
+    # slice before the thread ever contended) and even a pre-parked
+    # waiter loses to CPython lock barging (release -> immediate
+    # re-acquire by the same thread) — while the denial drives the real
+    # retry/backoff/deadline code path every run.
+    deny = threading.Event()
+    inner_lock = wl.lock
+
+    class DenyingLock:
+        def acquire(self, *a, **kw):
+            if deny.is_set():
+                return False
+            return inner_lock.acquire(*a, **kw)
+
+        def release(self):
+            return inner_lock.release()
+
+        def __enter__(self):
+            self.acquire()
+            return self
+
+        def __exit__(self, *exc):
+            self.release()
+            return False
+
     real_page = wl.links_page
     pages = []
 
     def hooked(since, limit):
         pages.append(since)
         if len(pages) == 1:
-            # after this page the handler releases the lock; a thief
-            # grabs it and holds past the feed deadline
-            def thief():
-                with wl.lock:
-                    stolen.set()
-                    release.wait(timeout=30)
-
-            threading.Thread(target=thief, daemon=True).start()
+            deny.set()
         return real_page(since, limit)
 
     wl.links_page = hooked
+    wl.lock = DenyingLock()
     server = serve(app, port=0, host="127.0.0.1")
     threading.Thread(target=server.serve_forever, daemon=True).start()
     base = f"http://127.0.0.1:{server.server_address[1]}"
@@ -336,12 +356,12 @@ def test_feed_midstream_deadline_abort(monkeypatch):
                 break
             time.sleep(0.05)
         assert app.feed_aborts["deadline"] == 1
-        release.set()
+        deny.clear()
         with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
             text = r.read().decode()
         assert 'duke_feed_aborts_total{reason="deadline"} 1' in text
     finally:
-        release.set()
+        deny.clear()
         server.shutdown()
         app.close()
 
