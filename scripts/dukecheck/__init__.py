@@ -1,8 +1,10 @@
-"""dukecheck — project-native static analysis for concurrency + telemetry
-invariants (ISSUE 7 tentpole).
+"""dukecheck — project-native static analysis for concurrency, telemetry
+and certified-numerics invariants (ISSUE 7 tentpole; numerics suite
+ISSUE 13).
 
-Five checkers over ``sesam_duke_microservice_tpu/`` (stdlib ``ast`` only,
-no installs — runs in the CI lint job like scripts/check_metrics_docs.py):
+Eight checkers over ``sesam_duke_microservice_tpu/`` (stdlib ``ast``
+except the compiled-HLO gate, which needs jax — runs in the CI lint job
+like scripts/check_metrics_docs.py):
 
   DK101  lock-order cycle in the inter-lock acquisition graph
   DK190  stale generated docs/LOCK_HIERARCHY.md
@@ -11,29 +13,57 @@ no installs — runs in the CI lint job like scripts/check_metrics_docs.py):
   DK203  conflicting ``# guarded by:`` annotations for one field name
   DK301  raw os.environ access outside telemetry/env.py
   DK401  impure call (time/random/environ/global-mutation) in
-         jit-reachable code
+         jit-reachable code (pl.pallas_call kernel closures included)
   DK402  cache keyed on bare ``id(...)``
   DK501  ``.labels(...)`` child lookup on an engine hot path
   DK502  direct registry write on an engine hot path
+  DK601  raw float arithmetic on dd (hi, lo) components
+  DK602  error-free-transform intermediate escaping uncommitted
+  DK603  inexact float literal fed to a dd op (use the const ctor)
+  DK604  feature kind missing from the certified budget tables
+  DK611  budget constant fails to cover its re-derived bound / headroom
+  DK612  two-sided budget constant exceeds its ceiling
+  DK613  unparseable/unevaluable ``# dd-budget:`` annotation
+  DK690  stale generated docs/ERROR_BUDGETS.md
+  DK701  compiled HLO lost reduce-precision commits (simplifier strip)
+  DK702  dd-attributed mul feeding add directly (FMA-contraction
+         exposure) in optimized HLO
+  DK703  hlocheck program failed to build/lower/compile
 
 Usage:
 
-    python -m scripts.dukecheck                # check (CI gate)
-    python -m scripts.dukecheck --write-docs   # regenerate LOCK_HIERARCHY
-    python -m scripts.dukecheck --list         # print every finding,
-                                               # baselined or not
+    python -m scripts.dukecheck                 # check (CI gate)
+    python -m scripts.dukecheck --only numerics --only budgets
+                                                # subset (pre-commit)
+    python -m scripts.dukecheck --write-docs    # regenerate
+                                                # LOCK_HIERARCHY.md +
+                                                # ERROR_BUDGETS.md
+    python -m scripts.dukecheck --list          # print every finding,
+                                                # baselined or not
 
 Exit 0 iff every finding is inline-suppressed or baselined AND no
-baseline entry is stale (the baseline only shrinks).
+baseline entry is stale (the baseline only shrinks).  hlocheck findings
+(DK7xx) are NEVER baselinable: a contraction regression is a release
+blocker by definition, and the runner rejects both DK7xx baseline
+entries and DK7xx baseline matches outright.
 """
 
 from __future__ import annotations
 
 import argparse
 from pathlib import Path
-from typing import List
+from typing import List, Optional, Sequence
 
-from . import envknob, guardedby, jitpurity, lockorder, metricwrite
+from . import (
+    budgets,
+    envknob,
+    guardedby,
+    hlocheck,
+    jitpurity,
+    lockorder,
+    metricwrite,
+    numerics,
+)
 from .core import (
     Finding,
     apply_baseline,
@@ -44,40 +74,98 @@ from .core import (
 
 BASELINE_RELPATH = "scripts/dukecheck/baseline.txt"
 
+# name, check fn, the finding codes the checker owns (drives --only's
+# stale-baseline scoping: a subset run must not flag other checkers'
+# baseline entries as stale)
 CHECKERS = (
-    ("lock-order", lockorder.check),
-    ("guarded-by", guardedby.check),
-    ("env-knob", envknob.check),
-    ("jit-purity", jitpurity.check),
-    ("metrics", metricwrite.check),
+    ("lock-order", lockorder.check, ("DK101", "DK190")),
+    ("guarded-by", guardedby.check, ("DK201", "DK202", "DK203")),
+    ("env-knob", envknob.check, ("DK301",)),
+    ("jit-purity", jitpurity.check, ("DK401", "DK402")),
+    ("metrics", metricwrite.check, ("DK501", "DK502")),
+    ("numerics", numerics.check, ("DK601", "DK602", "DK603", "DK604")),
+    ("budgets", budgets.check, ("DK611", "DK612", "DK613", "DK690")),
+    ("hlocheck", hlocheck.check, ("DK701", "DK702", "DK703")),
 )
 
+CHECKER_NAMES = tuple(name for name, _, _ in CHECKERS)
 
-def collect_findings(root: Path, modules=None) -> List[Finding]:
+# DK7xx findings may never enter the baseline (see module docstring)
+UNBASELINABLE_PREFIX = "DK7"
+
+
+def collect_findings(root: Path, modules=None,
+                     only: Optional[Sequence[str]] = None) -> List[Finding]:
     if modules is None:
         modules = load_modules(root)
     by_rel = {m.rel: m for m in modules}
     findings: List[Finding] = []
-    for _, fn in CHECKERS:
+    for name, fn, _ in CHECKERS:
+        if only and name not in only:
+            continue
         findings.extend(fn(modules, root))
     findings = filter_suppressed(by_rel, findings)
     findings.sort(key=lambda f: (f.rel, f.line, f.code))
     return findings
 
 
-def run(root: Path, *, write_docs: bool = False,
-        list_all: bool = False) -> int:
+def write_docs(root: Path, modules=None) -> int:
+    """Regenerate both generated docs; non-zero when the ledger cannot
+    render (a pre-commit doc refresh must not report success over a
+    stale ERROR_BUDGETS.md)."""
+    if modules is None:
+        modules = load_modules(root)
+    graph = lockorder.build_graph(modules)
+    doc = root / lockorder.DOC_RELPATH
+    doc.parent.mkdir(parents=True, exist_ok=True)
+    doc.write_text(lockorder.render_doc(graph), encoding="utf-8")
+    print(f"wrote {lockorder.DOC_RELPATH} "
+          f"({len(graph.locks)} locks, {len(graph.edges)} edges)")
+    entries, ledger_findings = budgets.collect(modules)
+    bad = [f for f in ledger_findings if f.code == "DK613"]
+    if bad:
+        print("cannot render the error-budget ledger — fix the "
+              "annotation(s) first:")
+        for f in bad:
+            print("  " + f.render())
+        return 1
+    bdoc = root / budgets.DOC_RELPATH
+    bdoc.parent.mkdir(parents=True, exist_ok=True)
+    bdoc.write_text(budgets.render_doc(entries), encoding="utf-8")
+    print(f"wrote {budgets.DOC_RELPATH} ({len(entries)} budget "
+          f"entr{'y' if len(entries) == 1 else 'ies'})")
+    return 0
+
+
+def run(root: Path, *, write_docs_only: bool = False,
+        list_all: bool = False,
+        only: Optional[Sequence[str]] = None) -> int:
     modules = load_modules(root)
-    if write_docs:
-        graph = lockorder.build_graph(modules)
-        doc = root / lockorder.DOC_RELPATH
-        doc.parent.mkdir(parents=True, exist_ok=True)
-        doc.write_text(lockorder.render_doc(graph), encoding="utf-8")
-        print(f"wrote {lockorder.DOC_RELPATH} "
-              f"({len(graph.locks)} locks, {len(graph.edges)} edges)")
-        return 0
-    findings = collect_findings(root, modules)
+    if write_docs_only:
+        return write_docs(root, modules)
+    findings = collect_findings(root, modules, only=only)
     baseline = load_baseline(root / BASELINE_RELPATH)
+    ok = True
+    # hlocheck findings are never baselinable — both directions
+    poisoned = [k for k in baseline
+                if k.startswith(UNBASELINABLE_PREFIX)]
+    if poisoned:
+        ok = False
+        print("dukecheck: hlocheck findings (DK7xx) are NEVER "
+              "baselinable — a contraction regression is a release "
+              "blocker; remove:")
+        for key in poisoned:
+            print("  " + key)
+        baseline = {k: v for k, v in baseline.items() if k not in poisoned}
+    if only:
+        # scope the stale check to the selected checkers' codes — a
+        # subset run knows nothing about other checkers' findings
+        codes = set()
+        for name, _, owned in CHECKERS:
+            if name in only:
+                codes.update(owned)
+        baseline = {k: v for k, v in baseline.items()
+                    if k.split(" ", 1)[0] in codes}
     new, stale = apply_baseline(findings, baseline)
     if list_all:
         for f in findings:
@@ -85,12 +173,11 @@ def run(root: Path, *, write_docs: bool = False,
             print(f.render() + mark)
         print(f"{len(findings)} findings "
               f"({len(findings) - len(new)} baselined)")
-    ok = True
     if new:
         ok = False
         print(f"dukecheck: {len(new)} new finding(s) "
               "(fix, suppress inline with a justification, or — last "
-              "resort — baseline):")
+              "resort, and never for DK7xx — baseline):")
         for f in new:
             print("  " + f.render())
     if stale:
@@ -101,8 +188,9 @@ def run(root: Path, *, write_docs: bool = False,
         for key in stale:
             print("  " + key)
     if ok and not list_all:
-        print(f"dukecheck: clean ({len(findings)} finding(s), all "
-              f"baselined; {len(baseline)} baseline entr"
+        scope = f" [{', '.join(only)}]" if only else ""
+        print(f"dukecheck: clean{scope} ({len(findings)} finding(s), "
+              f"all baselined; {len(baseline)} baseline entr"
               f"{'y' if len(baseline) == 1 else 'ies'})")
     return 0 if ok else 1
 
@@ -112,12 +200,21 @@ def main(argv=None) -> int:
         prog="python -m scripts.dukecheck",
         description="project-native static analysis "
                     "(lock order, guarded-by, env knobs, jit purity, "
-                    "metrics discipline)",
+                    "metrics discipline, certified numerics, error "
+                    "budgets, compiled-HLO contraction gate)",
     )
     parser.add_argument("--write-docs", action="store_true",
-                        help="regenerate docs/LOCK_HIERARCHY.md and exit")
+                        help="regenerate docs/LOCK_HIERARCHY.md + "
+                             "docs/ERROR_BUDGETS.md and exit")
     parser.add_argument("--list", action="store_true", dest="list_all",
                         help="print every finding including baselined")
+    parser.add_argument("--only", action="append", choices=CHECKER_NAMES,
+                        metavar="CHECKER",
+                        help="run only the named checker(s) (repeatable; "
+                             f"one of: {', '.join(CHECKER_NAMES)}) — "
+                             "lets the numerics gates run standalone "
+                             "pre-commit without paying the full-suite "
+                             "or HLO-compile cost")
     parser.add_argument("--root", default=None,
                         help="repo root (default: two levels above this "
                              "package)")
@@ -125,4 +222,5 @@ def main(argv=None) -> int:
     root = Path(args.root) if args.root else (
         Path(__file__).resolve().parent.parent.parent
     )
-    return run(root, write_docs=args.write_docs, list_all=args.list_all)
+    return run(root, write_docs_only=args.write_docs,
+               list_all=args.list_all, only=args.only)

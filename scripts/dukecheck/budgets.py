@@ -1,0 +1,453 @@
+"""Checker 7 — machine-checked error-budget ledger (DK611..DK613, DK690).
+
+The certified margins compose hand-derived constants: the per-op dd
+epsilon, the log budget, per-kind similarity-error budgets, the JW
+branch guard.  Each was derived once in a PR and then became a bare
+float no machine ever re-checks — an innocent "tighten this constant"
+edit (or a derivation that was wrong all along) voids certification
+while every test stays green, because the tests validate against the
+budgets, not the budgets against the math.
+
+``# dd-budget:`` annotations close the loop.  On (or adjacent to) the
+defining line of a budget constant::
+
+    # dd-budget: DD_EPS covers max(3*u32**2, 5*u32**2, 12*u32**2) headroom 1.25
+    DD_EPS = 2.0 ** -44
+
+    F.CHARS: 64.0 * _F32_EPS,  # dd-budget: _SIM_ERROR_BOUND[CHARS] covers 8 * eps32 headroom 4
+
+Grammar::
+
+    dd-budget: <target> covers <expr> [headroom <float>] [below <expr>]
+
+* ``<target>`` — a module-level constant name, or ``TABLE[KEY]`` for a
+  static dict entry (``KEY`` is the attribute/name of the dict key).
+* ``covers <expr>`` — the re-derived bound.  The expression is evaluated
+  in outward-rounded **interval arithmetic** and the code constant must
+  be >= its upper bound (DK611 otherwise); the recorded headroom is
+  ``constant / derived``.
+* ``headroom <h>`` — minimum required headroom (DK611 when violated).
+  Policy: every budget keeps slack against its own derivation so host
+  f64 rounding, theorem looseness, and platform drift are absorbed by
+  construction — a constant that only *equals* its derivation is one
+  epsilon of drift from unsound.
+* ``below <expr>`` — two-sided constants (guard bands): the constant
+  must also stay <= the lower bound of this ceiling (DK612) — e.g. the
+  JW branch guard must cover evaluation noise yet stay under the
+  rational-spacing floor that makes flagged-pair residue finite.
+
+Builtin symbols: ``u32`` = 2^-24 / ``u64`` = 2^-53 (unit roundoffs),
+``eps32`` = 2^-23 (f32 machine epsilon), plus every previously-declared
+ledger constant by name (so ``LOG_ERR_ABS`` can be derived in units of
+``DD_EPS``).  Code-side value expressions are evaluated with the same
+engine plus the pinned symbols in ``CODE_SYMBOLS``.
+
+The ledger renders ``docs/ERROR_BUDGETS.md`` (generated, committed);
+a stale doc is DK690, exactly like the lock hierarchy's DK190 — the
+derivations are review surface, not just gate state.
+"""
+
+from __future__ import annotations
+
+import ast
+import math
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .core import Finding, Module
+
+DOC_RELPATH = "docs/ERROR_BUDGETS.md"
+
+# code-expression symbols the AST evaluator cannot derive itself
+# (``np.finfo(np.float32).eps`` and friends) — reviewed facts
+CODE_SYMBOLS = {
+    "_F32_EPS": 2.0 ** -23,
+}
+
+_BUILTINS = {
+    "u32": 2.0 ** -24,
+    "u64": 2.0 ** -53,
+    "eps32": 2.0 ** -23,
+}
+
+_ANNOT_RE = re.compile(
+    r"#\s*dd-budget:\s*"
+    r"(?P<target>[A-Za-z_][A-Za-z0-9_]*(?:\[[A-Za-z_][A-Za-z0-9_]*\])?)\s+"
+    r"covers\s+(?P<covers>.+?)"
+    r"(?:\s+headroom\s+(?P<headroom>[0-9.eE+-]+))?"
+    r"(?:\s+below\s+(?P<below>.+?))?\s*$"
+)
+_TARGET_RE = re.compile(
+    r"^([A-Za-z_][A-Za-z0-9_]*)(?:\[([A-Za-z_][A-Za-z0-9_]*)\])?$"
+)
+
+
+# -- outward-rounded interval arithmetic --------------------------------------
+
+
+def _down(x: float) -> float:
+    return math.nextafter(x, -math.inf)
+
+
+def _up(x: float) -> float:
+    return math.nextafter(x, math.inf)
+
+
+class Interval:
+    __slots__ = ("lo", "hi")
+
+    def __init__(self, lo: float, hi: float):
+        if not (math.isfinite(lo) and math.isfinite(hi)) or lo > hi:
+            raise ValueError(f"bad interval [{lo}, {hi}]")
+        self.lo = lo
+        self.hi = hi
+
+    @classmethod
+    def point(cls, x: float) -> "Interval":
+        return cls(x, x)
+
+    def __add__(self, o: "Interval") -> "Interval":
+        return Interval(_down(self.lo + o.lo), _up(self.hi + o.hi))
+
+    def __sub__(self, o: "Interval") -> "Interval":
+        return Interval(_down(self.lo - o.hi), _up(self.hi - o.lo))
+
+    def __mul__(self, o: "Interval") -> "Interval":
+        c = (self.lo * o.lo, self.lo * o.hi,
+             self.hi * o.lo, self.hi * o.hi)
+        return Interval(_down(min(c)), _up(max(c)))
+
+    def __truediv__(self, o: "Interval") -> "Interval":
+        if o.lo <= 0.0 <= o.hi:
+            raise ValueError("division by an interval containing zero")
+        c = (self.lo / o.lo, self.lo / o.hi,
+             self.hi / o.lo, self.hi / o.hi)
+        return Interval(_down(min(c)), _up(max(c)))
+
+    def pow(self, e: float) -> "Interval":
+        c = (math.pow(self.lo, e), math.pow(self.hi, e))
+        return Interval(_down(min(c)), _up(max(c)))
+
+    def neg(self) -> "Interval":
+        return Interval(-self.hi, -self.lo)
+
+
+def eval_interval(expr: str, env: Dict[str, float]) -> Interval:
+    """Evaluate a budget expression to an outward-rounded interval.
+    The builtin unit-roundoff symbols are always in scope."""
+    env = {**_BUILTINS, **env}
+    tree = ast.parse(expr, mode="eval")
+
+    def ev(node: ast.AST) -> Interval:
+        if isinstance(node, ast.Expression):
+            return ev(node.body)
+        if isinstance(node, ast.Constant) and isinstance(
+                node.value, (int, float)):
+            return Interval.point(float(node.value))
+        if isinstance(node, ast.Name):
+            if node.id not in env:
+                raise ValueError(f"unknown symbol `{node.id}`")
+            return Interval.point(env[node.id])
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+            return ev(node.operand).neg()
+        if isinstance(node, ast.BinOp):
+            if isinstance(node.op, ast.Pow):
+                exp = node.right
+                neg = False
+                if isinstance(exp, ast.UnaryOp) \
+                        and isinstance(exp.op, ast.USub):
+                    exp, neg = exp.operand, True
+                if not (isinstance(exp, ast.Constant)
+                        and isinstance(exp.value, (int, float))):
+                    raise ValueError("pow exponent must be a literal")
+                e = -float(exp.value) if neg else float(exp.value)
+                base = ev(node.left)
+                if base.lo <= 0.0:
+                    raise ValueError("pow base must be positive")
+                return base.pow(e)
+            a, b = ev(node.left), ev(node.right)
+            if isinstance(node.op, ast.Add):
+                return a + b
+            if isinstance(node.op, ast.Sub):
+                return a - b
+            if isinstance(node.op, ast.Mult):
+                return a * b
+            if isinstance(node.op, ast.Div):
+                return a / b
+            raise ValueError(f"unsupported operator {node.op}")
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id in ("max", "min") and not node.keywords:
+            vals = [ev(a) for a in node.args]
+            if not vals:
+                raise ValueError("empty max()/min()")
+            if node.func.id == "max":
+                return Interval(max(v.lo for v in vals),
+                                max(v.hi for v in vals))
+            return Interval(min(v.lo for v in vals),
+                            min(v.hi for v in vals))
+        raise ValueError(
+            f"unsupported expression node {type(node).__name__}")
+
+    return ev(tree)
+
+
+# -- annotation + code-value extraction ---------------------------------------
+
+
+class Entry:
+    __slots__ = ("target", "table", "key", "covers", "headroom", "below",
+                 "rel", "line", "value", "derived", "ceiling", "actual")
+
+    def __init__(self, target: str, table: Optional[str],
+                 key: Optional[str], covers: str,
+                 headroom: Optional[float], below: Optional[str],
+                 rel: str, line: int):
+        self.target = target      # display name (NAME or TABLE[KEY])
+        self.table = table        # dict name when a table entry
+        self.key = key
+        self.covers = covers
+        self.headroom = headroom
+        self.below = below
+        self.rel = rel
+        self.line = line
+        self.value: Optional[float] = None      # resolved code constant
+        self.derived: Optional[float] = None    # upper bound of covers
+        self.ceiling: Optional[float] = None    # lower bound of below
+        self.actual: Optional[float] = None     # value / derived
+
+
+def _parse_annotations(mod: Module) -> Tuple[List[Entry], List[Finding]]:
+    entries: List[Entry] = []
+    findings: List[Finding] = []
+    for lineno, text in enumerate(mod.lines, start=1):
+        if "dd-budget:" not in text:
+            continue
+        m = _ANNOT_RE.search(text)
+        if not m:
+            findings.append(Finding(
+                "DK613", mod.rel, lineno,
+                "unparseable `# dd-budget:` annotation — expected "
+                "`<target> covers <expr> [headroom <h>] [below <expr>]`",
+                f"syntax:{lineno}",
+            ))
+            continue
+        tm = _TARGET_RE.match(m.group("target"))
+        hr = m.group("headroom")
+        headroom = None
+        if hr:
+            try:
+                headroom = float(hr)
+            except ValueError:
+                findings.append(Finding(
+                    "DK613", mod.rel, lineno,
+                    f"unparseable headroom value {hr!r} in "
+                    "`# dd-budget:` annotation",
+                    f"headroom-syntax:{lineno}",
+                ))
+                continue
+        entries.append(Entry(
+            m.group("target"), tm.group(1) if tm.group(2) else None,
+            tm.group(2), m.group("covers").strip(),
+            headroom,
+            (m.group("below") or "").strip() or None,
+            mod.rel, lineno,
+        ))
+    return entries, findings
+
+
+def _eval_code_expr(node: ast.expr, env: Dict[str, float]) -> float:
+    """Evaluate a code-side constant expression (plain f64 semantics —
+    the value IS what Python computed; intervals are for derivations)."""
+    if isinstance(node, ast.Constant) and isinstance(
+            node.value, (int, float)):
+        return float(node.value)
+    if isinstance(node, ast.Name):
+        if node.id in env:
+            return env[node.id]
+        raise ValueError(f"unknown code symbol `{node.id}`")
+    if isinstance(node, ast.Attribute):
+        if node.attr in env:
+            return env[node.attr]
+        raise ValueError(f"unknown code symbol `{node.attr}`")
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return -_eval_code_expr(node.operand, env)
+    if isinstance(node, ast.BinOp):
+        a = _eval_code_expr(node.left, env)
+        b = _eval_code_expr(node.right, env)
+        if isinstance(node.op, ast.Add):
+            return a + b
+        if isinstance(node.op, ast.Sub):
+            return a - b
+        if isinstance(node.op, ast.Mult):
+            return a * b
+        if isinstance(node.op, ast.Div):
+            return a / b
+        if isinstance(node.op, ast.Pow):
+            return a ** b
+    raise ValueError(
+        f"unsupported code expression {type(node).__name__}")
+
+
+def _find_code_value(mod: Module, entry: Entry,
+                     env: Dict[str, float]) -> float:
+    """Resolve the annotated constant's value from the module AST."""
+    if entry.table is None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == entry.target:
+                        return _eval_code_expr(node.value, env)
+        raise ValueError(f"no assignment `{entry.target} = ...` found")
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+            names = [t.id for t in node.targets
+                     if isinstance(t, ast.Name)]
+            if entry.table not in names:
+                continue
+            for key, val in zip(node.value.keys, node.value.values):
+                kname = (key.attr if isinstance(key, ast.Attribute)
+                         else key.id if isinstance(key, ast.Name) else None)
+                if kname == entry.key:
+                    return _eval_code_expr(val, env)
+            raise ValueError(
+                f"`{entry.table}` has no key `{entry.key}`")
+    raise ValueError(f"no dict `{entry.table}` found")
+
+
+def collect(modules: Sequence[Module]) -> Tuple[List[Entry], List[Finding]]:
+    """Parse + evaluate every ledger entry in module order."""
+    entries: List[Entry] = []
+    findings: List[Finding] = []
+    env: Dict[str, float] = dict(_BUILTINS)
+    env.update(CODE_SYMBOLS)
+    seen: Dict[str, Entry] = {}
+    for mod in sorted(modules, key=lambda m: m.rel):
+        mod_entries, mod_findings = _parse_annotations(mod)
+        findings.extend(mod_findings)
+        for entry in mod_entries:
+            if entry.target in seen:
+                findings.append(Finding(
+                    "DK613", entry.rel, entry.line,
+                    f"duplicate `# dd-budget:` target `{entry.target}` "
+                    f"(first declared at {seen[entry.target].rel}:"
+                    f"{seen[entry.target].line})",
+                    f"duplicate:{entry.target}",
+                ))
+                continue
+            seen[entry.target] = entry
+            try:
+                entry.value = _find_code_value(mod, entry, env)
+                derived = eval_interval(entry.covers, env)
+                entry.derived = derived.hi
+                if entry.below is not None:
+                    entry.ceiling = eval_interval(entry.below, env).lo
+            except (ValueError, SyntaxError) as exc:
+                findings.append(Finding(
+                    "DK613", entry.rel, entry.line,
+                    f"ledger entry `{entry.target}`: {exc}",
+                    f"eval:{entry.target}",
+                ))
+                continue
+            # make the constant available to later derivations by its
+            # bare name (DD_EPS usable from scoring's annotations)
+            if entry.table is None:
+                env[entry.target] = entry.value
+            if entry.derived > 0:
+                entry.actual = entry.value / entry.derived
+            entries.append(entry)
+            if entry.value < entry.derived:
+                findings.append(Finding(
+                    "DK611", entry.rel, entry.line,
+                    f"budget constant `{entry.target}` = "
+                    f"{entry.value:.6g} does NOT cover its derived bound "
+                    f"{entry.derived:.6g} (`{entry.covers}`) — the "
+                    "certification margin is unsound; widen the constant "
+                    "or fix the derivation",
+                    f"covers:{entry.target}",
+                ))
+            elif entry.headroom is not None \
+                    and entry.value < _up(entry.derived * entry.headroom):
+                findings.append(Finding(
+                    "DK611", entry.rel, entry.line,
+                    f"budget constant `{entry.target}` = "
+                    f"{entry.value:.6g} covers its derived bound "
+                    f"{entry.derived:.6g} with only "
+                    f"{entry.actual:.3g}x headroom (policy minimum "
+                    f"{entry.headroom:g}x) — the slack that absorbs "
+                    "host-f64 rounding and theorem looseness is gone",
+                    f"headroom:{entry.target}",
+                ))
+            if entry.ceiling is not None and entry.value > entry.ceiling:
+                findings.append(Finding(
+                    "DK612", entry.rel, entry.line,
+                    f"budget constant `{entry.target}` = "
+                    f"{entry.value:.6g} exceeds its ceiling "
+                    f"{entry.ceiling:.6g} (`{entry.below}`) — the "
+                    "two-sided band (e.g. guard under the rational-"
+                    "spacing floor) is violated",
+                    f"below:{entry.target}",
+                ))
+    return entries, findings
+
+
+# -- generated doc ------------------------------------------------------------
+
+
+def render_doc(entries: Sequence[Entry]) -> str:
+    lines = [
+        "# Certified-numerics error-budget ledger",
+        "",
+        "**GENERATED** by `python -m scripts.dukecheck --write-docs` from "
+        "the `# dd-budget:` annotations",
+        "in `ops/dd.py` / `ops/scoring.py`.  Do not edit by hand — "
+        "dukecheck fails (DK690) when this",
+        "file is stale, and fails (DK611/DK612) when a code constant "
+        "stops covering its re-derived",
+        "bound or escapes its ceiling.  Derivations are evaluated in "
+        "outward-rounded interval",
+        "arithmetic; `headroom` is `constant / derived upper bound` and "
+        "must stay above the",
+        "declared policy minimum.",
+        "",
+        "| constant | where | value | derived bound (covers) | headroom "
+        "(min) | ceiling (below) |",
+        "|---|---|---|---|---|---|",
+    ]
+    for e in sorted(entries, key=lambda e: (e.rel, e.line)):
+        hr = (f"{e.actual:.3g}x ({e.headroom:g}x)"
+              if e.headroom is not None else f"{e.actual:.3g}x")
+        ceil = (f"{e.ceiling:.6g} = `{e.below}`"
+                if e.ceiling is not None else "—")
+        lines.append(
+            f"| `{e.target}` | {e.rel} | {e.value:.6g} "
+            f"| {e.derived:.6g} = `{e.covers}` | {hr} | {ceil} |"
+        )
+    lines += [
+        "",
+        "Builtin symbols: `u32` = 2^-24, `u64` = 2^-53 (unit roundoffs), "
+        "`eps32` = 2^-23 (f32",
+        "machine epsilon); previously-declared constants are available "
+        "by name, so composed",
+        "budgets (`LOG_ERR_ABS` in units of `DD_EPS`) re-derive from "
+        "their actual inputs.",
+        "",
+    ]
+    return "\n".join(lines)
+
+
+def check(modules: Sequence[Module], root=None) -> List[Finding]:
+    entries, findings = collect(modules)
+    if root is not None:
+        doc_path = Path(root) / DOC_RELPATH
+        want = render_doc(entries)
+        have = (doc_path.read_text(encoding="utf-8")
+                if doc_path.exists() else "")
+        if have != want:
+            findings.append(Finding(
+                "DK690", DOC_RELPATH, 1,
+                "error-budget ledger doc is stale — run "
+                "`python -m scripts.dukecheck --write-docs`",
+                "stale-doc",
+            ))
+    return findings

@@ -157,3 +157,60 @@ HOT_MODULE_PREFIXES = (
 # modules whose names mean "wall clock" / "nondeterminism" inside traced
 # code; calling into them from a jit-reachable function is a finding
 IMPURE_MODULES = ("time", "random")
+
+# -- checker 6 (certified numerics, DK6xx) ------------------------------------
+
+# Modules under the full EFT commit discipline (DK602/DK603): every
+# traced float binop must be committed through the barrier helper, so
+# neither the HLO algebraic simplifier nor backend FMA contraction can
+# see a cancellable/contractible pattern.  This is the dd arithmetic
+# core only — the discipline is what makes its error bounds theorems
+# instead of measurements.
+DD_CORE_MODULES = (
+    "sesam_duke_microservice_tpu/ops/dd.py",
+)
+
+# The commit-barrier spellings (a call wrapping a binop commits it).
+DD_COMMIT_FUNCS = ("_f32", "reduce_precision")
+
+# dd constant constructors: Python f64 arithmetic inside their arguments
+# is HOST-side and exact (the result is split into a dd pair), so binops
+# and float literals there are exempt from the commit/literal checks.
+DD_CONST_FUNCS = ("const", "const_pair")
+
+# dd lift helpers that reproduce their argument EXACTLY AS A FLOAT32 —
+# feeding them a Python float literal that is not f32-representable
+# silently rounds it (DK603's sharpest case: ``from_f32(0.1)`` loses the
+# f64 image the oracle computes with; the fix is ``const(0.1)``).
+DD_LIFT_FUNCS = ("from_f32", "from_int")
+
+# Modules carrying dd *program* code outside the core, mapped to the
+# function-name prefixes that mark their dd-marked functions (DK601:
+# no raw float arithmetic on (hi, lo) components there — everything
+# goes through the ops.dd helpers; DK603: no inexact float literals
+# fed to dd ops outside the constant constructors).
+DD_PROGRAM_FUNCTIONS = {
+    "sesam_duke_microservice_tpu/ops/scoring.py": ("_dd_", "build_dd_"),
+}
+
+# dd arithmetic entry points (module-qualified as D.<name> in program
+# modules, bare in the core) whose arguments DK603 scans for inexact
+# float literals.
+DD_OP_FUNCS = (
+    "add", "sub", "mul", "div", "neg", "maximum", "minimum", "clamp",
+    "where", "lt", "le", "ge", "log", "scale_pow2",
+)
+
+# -- budget-table completeness (DK604) ----------------------------------------
+
+# where the kind registry and the budget tables live
+DD_KINDS_MODULE = "sesam_duke_microservice_tpu/ops/features.py"
+DD_KINDS_REGISTRY = "ALL_KINDS"
+DD_BUDGET_MODULE = "sesam_duke_microservice_tpu/ops/scoring.py"
+# every kind needs an entry here (the f32 certified margin)
+DD_F32_TABLE = "_SIM_ERROR_BOUND"
+# every certified dd kind needs an entry here (the dd margin)
+DD_OPS_TABLE = "_DD_SIM_OPS"
+# the two tuples that must partition the registry exactly
+DD_CERTIFIED_LIST = "DD_KINDS"
+DD_FALLBACK_LIST = "DD_FALLBACK_KINDS"

@@ -1,0 +1,295 @@
+"""Checker 8 — compiled-HLO contraction gate (DK701..DK703).
+
+The source-level EFT discipline (``numerics``, DK602) proves the commit
+barriers are *written*; nothing source-level can prove the compiler
+still *honors* them.  Both regressions PR 11 measured live entirely
+inside XLA: the algebraic simplifier cancelling ``x - (x - a)`` (2.2e-8
+vs 3e-16) and backend FMA contraction of ``a*b + c`` (a full f32 ulp on
+``log``'s reduction term).  A jaxlib upgrade that starts treating
+``reduce-precision(f32 -> f32)`` as the identity — or re-associating
+through it — voids certification with every bit-identity test green,
+surfacing months later as a 1-ulp verdict flip in production.
+
+This gate lowers and compiles the registered dd programs on the current
+(CI) backend and asserts over the **optimized HLO text**:
+
+  * **DK701 — commit survival**: the optimized module must define at
+    least as many ``reduce-precision`` instructions as the unoptimized
+    lowering.  Fusion legally *duplicates* commits (producers are cloned
+    into consumers), so the count may grow; any NET LOSS means a commit
+    was eliminated — the precise signature of a simplifier that learned
+    to see through the barrier.
+  * **DK702 — contraction exposure**: no f32 ``add``/``subtract``
+    instruction attributed (via HLO metadata) to ``ops/dd.py`` may
+    consume a ``multiply`` as a direct operand.  The EFT discipline puts
+    a commit between every product and sum, so a mul feeding an add
+    *inside dd-attributed code* is an uncommitted pair the LLVM backend
+    is licensed to contract into an fma (contraction is invisible in
+    HLO — this adjacency is its necessary precondition, so the gate
+    forbids the precondition).
+  * **DK703 — gate integrity**: a program that fails to build, lower or
+    compile (or produces zero commits where commits are expected) is a
+    loud failure, never a silent skip.
+
+Each program is compiled under a **matrix of XLA compiler options**
+(fast-math, fast-min-max, max backend optimization) so the next jaxlib
+bump that changes a default — or starts honoring one of these flags
+differently around ``reduce-precision`` — fails in lint, not in prod.
+
+Findings here are **never baselinable** (enforced by the runner): a
+contraction regression is a release blocker by definition — there is no
+"known, justified, and grandfathered" compiler miscompilation.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Sequence, Tuple
+
+from .core import Finding
+
+# (name, compiler_options) combos every program must survive.  Values
+# must be real Python bools/ints — the PJRT option parser rejects
+# strings for typed flags.
+FLAG_MATRIX: Tuple[Tuple[str, Dict], ...] = (
+    ("default", {}),
+    ("fast-math", {"xla_cpu_enable_fast_math": True}),
+    ("fast-min-max", {"xla_cpu_enable_fast_min_max": True}),
+    ("opt-level-3", {"xla_backend_optimization_level": 3}),
+)
+
+REL = "scripts/dukecheck/hlocheck.py"  # finding anchor for gate failures
+
+# one definition line: `%name = f32[...] opcode(...operands...)`
+_INST_RE = re.compile(
+    r"%([\w.-]+)\s*=\s*(\S+)\s+([\w-]+)\(([^)]*)\)(.*)$"
+)
+_OPERAND_RE = re.compile(r"%([\w.-]+)")
+
+
+def parse_instructions(hlo_text: str) -> Dict[str, Tuple[str, str,
+                                                         List[str], str]]:
+    """``{name: (type, opcode, operand names, trailing metadata)}`` for
+    every instruction definition in an HLO text dump (fused computations
+    included — their instructions print like any other)."""
+    out: Dict[str, Tuple[str, str, List[str], str]] = {}
+    for line in hlo_text.splitlines():
+        m = _INST_RE.search(line)
+        if not m:
+            continue
+        name, typ, opcode, operands, rest = m.groups()
+        out[name] = (typ, opcode, _OPERAND_RE.findall(operands), rest)
+    return out
+
+
+def count_commits(hlo_text: str) -> int:
+    """Number of ``reduce-precision`` instruction *definitions* (operand
+    references share the name, so substring counting over-counts)."""
+    return sum(1 for _, (_, opcode, _, _) in
+               parse_instructions(hlo_text).items()
+               if opcode == "reduce-precision")
+
+
+def count_commits_mlir(stablehlo_text: str) -> int:
+    """Commit count in the unoptimized (StableHLO MLIR) lowering."""
+    return stablehlo_text.count("stablehlo.reduce_precision")
+
+
+def exposed_contractions(hlo_text: str,
+                         source_marker: str = "ops/dd.py") -> List[str]:
+    """f32 add/subtract instructions attributed to the dd core that take
+    a multiply as a DIRECT operand — the FMA-contraction precondition
+    the commit discipline exists to forbid."""
+    insts = parse_instructions(hlo_text)
+    bad = []
+    for name, (typ, opcode, operands, rest) in insts.items():
+        if opcode not in ("add", "subtract"):
+            continue
+        if not typ.startswith("f32"):
+            continue
+        if source_marker not in rest:
+            continue
+        for op in operands:
+            other = insts.get(op)
+            if other is not None and other[1] == "multiply":
+                line = ""
+                lm = re.search(r"source_line=(\d+)", rest)
+                if lm:
+                    line = f" (dd.py:{lm.group(1)})"
+                bad.append(f"%{name} = {opcode}(.., %{op}=multiply){line}")
+                break
+    return bad
+
+
+# -- program registry ---------------------------------------------------------
+
+
+def _build_dd_core():
+    """A composite over every ops.dd primitive (add/sub/mul/div, the
+    comparisons' select path, scale_pow2 and the full log chain) — the
+    smallest program that exercises each EFT at least once."""
+    import jax
+    import jax.numpy as jnp
+
+    from sesam_duke_microservice_tpu.ops import dd as D
+
+    def prog(a, b):
+        x = D.from_f32(a)
+        y = D.from_f32(b)
+        s = D.add(D.mul(x, y), D.div(x, y))
+        s = D.sub(s, D.maximum(x, D.neg(y)))
+        s = D.add(s, D.scale_pow2(x, jnp.full(a.shape, 3, jnp.int32)))
+        mag = D.maximum(D.where(D.lt(s, D.const(0.0, like=a)),
+                                D.neg(s), s), D.const(1e-6, like=a))
+        return D.add(D.log(mag), D.const(1.5, like=a))
+
+    args = (jnp.linspace(0.5, 2.0, 64, dtype=jnp.float32),
+            jnp.linspace(1.0, 3.0, 64, dtype=jnp.float32))
+    return jax.jit(prog), args
+
+
+def _build_dd_rescorer():
+    """The REAL registered survivor-rescore program for a representative
+    plan covering every certified comparator kind (Levenshtein,
+    Jaro-Winkler incl. the branch guard, q-gram, token set, exact hash,
+    phonetic) over really-extracted feature tensors — the margin-
+    critical kernel the finalize verdict split dispatches."""
+    import numpy as np
+
+    from sesam_duke_microservice_tpu.core import comparators as C
+    from sesam_duke_microservice_tpu.core.config import DukeSchema
+    from sesam_duke_microservice_tpu.core.records import (
+        ID_PROPERTY_NAME,
+        Property,
+        Record,
+    )
+    from sesam_duke_microservice_tpu.ops import features as F
+    from sesam_duke_microservice_tpu.ops import scoring as S
+
+    schema = DukeSchema(
+        threshold=0.8,
+        maybe_threshold=0.6,
+        properties=[
+            Property(ID_PROPERTY_NAME, id_property=True),
+            Property("name", C.Levenshtein(), 0.3, 0.9),
+            Property("alias", C.JaroWinkler(), 0.35, 0.85),
+            Property("street", C.QGram(), 0.3, 0.8),
+            Property("tokens", C.DiceCoefficient(), 0.4, 0.8),
+            Property("city", C.Exact(), 0.4, 0.8),
+            Property("surname", C.Metaphone(), 0.45, 0.75),
+        ],
+        data_sources=[],
+    )
+    plan = F.SchemaFeatures.plan(schema)
+
+    def rec(rid, **props):
+        r = Record()
+        r.add_value(ID_PROPERTY_NAME, rid)
+        for k, v in props.items():
+            r.add_value(k, v)
+        return r
+
+    rows = [
+        rec("a", name="acme corp", alias="acme", street="main street 1",
+            tokens="acme corp oslo", city="oslo", surname="smith"),
+        rec("b", name="acme corporation", alias="acme co",
+            street="main str 1", tokens="acme corporation oslo",
+            city="oslo", surname="smyth"),
+    ]
+    feats = F.extract_batch(plan, rows)
+    dd_names = {s.name for s in S.dd_plan_specs(plan)}
+    qf = {p: {n: a[0:1] for n, a in t.items()}
+          for p, t in feats.items() if p in dd_names}
+    cf = {p: {n: a[1:2] for n, a in t.items()}
+          for p, t in feats.items() if p in dd_names}
+    fn = S.build_dd_rescorer(plan, queries_from_rows=False,
+                             pallas_ok=False)
+    args = (qf, cf, np.full((1,), -1, np.int32),
+            np.zeros((1, 1), np.int32))
+    return fn, args
+
+
+PROGRAMS = (
+    ("dd-core", _build_dd_core),
+    ("dd-rescorer", _build_dd_rescorer),
+)
+
+
+# -- the gate -----------------------------------------------------------------
+
+
+def check(modules: Sequence = (), root=None) -> List[Finding]:
+    findings: List[Finding] = []
+    # the gate compiles for the host backend; pin CPU before jax's
+    # backend init so the lint job never tries to grab an accelerator
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        import jax  # noqa: F401
+    except Exception as exc:  # pragma: no cover - jax is a hard dep
+        return [Finding(
+            "DK703", REL, 1,
+            f"hlocheck cannot import jax ({exc}) — the contraction gate "
+            "must run, not silently skip (install the package in the "
+            "lint job)",
+            "jax-import",
+        )]
+    for name, build in PROGRAMS:
+        try:
+            fn, args = build()
+            lowered = fn.lower(*args)
+            unopt = count_commits_mlir(lowered.as_text())
+        except Exception as exc:
+            findings.append(Finding(
+                "DK703", REL, 1,
+                f"program `{name}` failed to build/lower: {exc!r}",
+                f"build:{name}",
+            ))
+            continue
+        if unopt == 0:
+            findings.append(Finding(
+                "DK703", REL, 1,
+                f"program `{name}` lowered with ZERO reduce-precision "
+                "commits — the EFT barriers are gone before the "
+                "compiler even ran (source regression or lowering "
+                "change)",
+                f"no-commits:{name}",
+            ))
+            continue
+        for combo, options in FLAG_MATRIX:
+            try:
+                compiled = lowered.compile(
+                    compiler_options=dict(options))
+                opt_text = compiled.as_text()
+            except Exception as exc:
+                findings.append(Finding(
+                    "DK703", REL, 1,
+                    f"program `{name}` failed to compile under "
+                    f"[{combo}]: {exc!r}",
+                    f"compile:{name}:{combo}",
+                ))
+                continue
+            opt = count_commits(opt_text)
+            if opt < unopt:
+                findings.append(Finding(
+                    "DK701", REL, 1,
+                    f"program `{name}` [{combo}]: optimized HLO defines "
+                    f"{opt} reduce-precision commit(s), unoptimized has "
+                    f"{unopt} — the compiler ELIMINATED commits "
+                    "(fusion only duplicates; a net loss means the "
+                    "simplifier sees through the barrier).  This is a "
+                    "release blocker, not a baselinable finding.",
+                    f"commit-loss:{name}:{combo}",
+                ))
+            exposed = exposed_contractions(opt_text)
+            if exposed:
+                findings.append(Finding(
+                    "DK702", REL, 1,
+                    f"program `{name}` [{combo}]: {len(exposed)} "
+                    "dd-attributed f32 add/subtract instruction(s) "
+                    "consume a multiply directly — FMA contraction "
+                    "exposure (first: " + exposed[0] + ").  Commit the "
+                    "product before the sum.",
+                    f"fma-exposure:{name}:{combo}",
+                ))
+    return findings

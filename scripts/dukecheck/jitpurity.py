@@ -40,12 +40,57 @@ def _is_jit_expr(node: ast.expr) -> bool:
     return False
 
 
+def _is_pallas_call(node: ast.expr) -> bool:
+    """``pl.pallas_call`` / ``pallas_call`` — the kernel argument traces
+    exactly like a jitted closure (ISSUE 13 satellite: these were
+    unscanned since the Pallas kernels landed)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr == "pallas_call"
+    if isinstance(node, ast.Name):
+        return node.id == "pallas_call"
+    return False
+
+
+def _partial_target(node: ast.expr):
+    """``functools.partial(_kernel, ...)`` -> the ``_kernel`` name (the
+    idiom every Pallas call site here uses to bind static params)."""
+    if not (isinstance(node, ast.Call) and node.args):
+        return None
+    func = node.func
+    is_partial = ((isinstance(func, ast.Name) and func.id == "partial")
+                  or (isinstance(func, ast.Attribute)
+                      and func.attr == "partial"))
+    if not is_partial:
+        return None
+    target = node.args[0]
+    if isinstance(target, ast.Name):
+        return target.id
+    if isinstance(target, ast.Attribute):
+        return target.attr
+    return None
+
+
 def _jit_roots(mod: Module) -> Set[str]:
     """Names of functions whose bodies are jit-reachable: decorated
     (``@jax.jit`` / ``@partial(jit, ...)``), wrapped (``jit(f)``), or
     *factories* whose RESULT is jitted (``jax.jit(build(...))``) — a
     factory's closures trace, so its whole body is jit-reachable too."""
     roots: Set[str] = set()
+    # local bindings of partial-wrapped kernels: every Pallas call site
+    # here spells ``kernel = functools.partial(_kernel, ...)`` then
+    # ``pl.pallas_call(kernel, ...)``, so a bare Name argument must
+    # resolve through the binding (over-approximate: a reused local
+    # name maps to ALL its bound targets)
+    partial_bindings: Dict[str, Set[str]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            bound = node.targets[0].id
+            target = _partial_target(node.value)
+            if target is None and isinstance(node.value, ast.Name):
+                target = node.value.id  # plain alias: kernel = _kernel
+            if target is not None:
+                partial_bindings.setdefault(bound, set()).add(target)
     for node in ast.walk(mod.tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             for dec in node.decorator_list:
@@ -70,6 +115,20 @@ def _jit_roots(mod: Module) -> Set[str]:
             elif (isinstance(arg, ast.Call)
                   and isinstance(arg.func, ast.Attribute)):
                 roots.add(arg.func.attr)
+        elif isinstance(node, ast.Call) and _is_pallas_call(node.func):
+            # pallas_call(kernel, ...) / pallas_call(partial(kernel, ..))
+            # — the kernel closure traces, so its body is jit-reachable;
+            # a bare Name resolves through its partial/alias binding
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Name):
+                roots.add(arg.id)
+                roots.update(partial_bindings.get(arg.id, ()))
+            else:
+                target = _partial_target(arg)
+                if target is not None:
+                    roots.add(target)
     return roots
 
 

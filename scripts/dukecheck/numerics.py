@@ -1,0 +1,437 @@
+"""Checker 6 — certified-numerics EFT discipline (DK601..DK604).
+
+The double-double (two-float) emulated-f64 pipeline (``ops/dd.py`` and
+the ``_dd_*`` program functions in ``ops/scoring.py``) is sound only
+under conventions no test can see breaking on today's compiler:
+
+  * **DK601** — raw float arithmetic on dd ``(hi, lo)`` components in a
+    dd program function.  ``x[0] + y[0]`` silently discards the low
+    word; everything must go through the ``ops.dd`` helpers.
+  * **DK602** — an error-free-transform intermediate that escapes
+    uncommitted: inside the dd core modules every traced float binop
+    (``+ - * /``) must be the direct argument of the commit barrier
+    (``_f32`` / ``lax.reduce_precision``).  An uncommitted intermediate
+    is exactly what XLA's algebraic simplifier cancels (``x - (x - a)``
+    -> ``a``: measured 2.2e-8 vs 3e-16) and what the CPU/GPU backends
+    FMA-contract (a full f32 ulp on ``log``'s reduction term) — the two
+    compiler passes that silently collapse dd to plain f32 while every
+    bit-identity test stays green.
+  * **DK603** — a Python float literal that is NOT exactly representable
+    in float32 fed to a dd op or lift helper (``from_f32(0.1)``): the
+    device then computes with a silently rounded constant while the host
+    oracle uses the exact f64 — the dd-constant constructor (``const``)
+    carries the full f64 image and is the only blessed spelling.
+  * **DK604** — budget-table completeness: every feature kind in
+    ``ops.features.ALL_KINDS`` must carry a ``_SIM_ERROR_BOUND`` entry
+    and be claimed by exactly one of ``DD_KINDS`` /
+    ``DD_FALLBACK_KINDS``, and every certified kind must have a
+    ``_DD_SIM_OPS`` budget.  Today a new comparator kind silently gets
+    no margin entry (``.get(kind, inf)``) — sound but invisible; this
+    makes adding a kind without a reviewed budget decision a CI failure.
+
+All checks are pure stdlib-``ast``; the compiled-HLO counterpart
+(``hlocheck``) catches what source-level analysis cannot (a jaxlib
+upgrade changing what the barriers mean).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+import struct
+
+from .config import (
+    DD_BUDGET_MODULE,
+    DD_CERTIFIED_LIST,
+    DD_COMMIT_FUNCS,
+    DD_CONST_FUNCS,
+    DD_CORE_MODULES,
+    DD_F32_TABLE,
+    DD_FALLBACK_LIST,
+    DD_KINDS_MODULE,
+    DD_KINDS_REGISTRY,
+    DD_LIFT_FUNCS,
+    DD_OPS_TABLE,
+    DD_OP_FUNCS,
+    DD_PROGRAM_FUNCTIONS,
+)
+from .core import Finding, Module, expr_text
+
+_FLOAT_BINOPS = (ast.Add, ast.Sub, ast.Mult, ast.Div)
+
+
+def _call_name(func: ast.expr) -> Optional[str]:
+    """Bare/attribute callable name: ``_f32`` / ``lax.reduce_precision``
+    / ``D.add`` all resolve to their terminal name."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _f32_exact(value: float) -> bool:
+    """Does ``float32(value)`` round-trip to the same f64?"""
+    try:
+        return struct.unpack("f", struct.pack("f", value))[0] == value
+    except (OverflowError, struct.error):
+        return False
+
+
+def _parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+    return parents
+
+
+def _module_functions(mod: Module) -> Dict[str, List[ast.AST]]:
+    defs: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    return defs
+
+
+def _traced_functions(mod: Module) -> Set[str]:
+    """Functions whose bodies build traced computations: they reference
+    ``jnp``/``lax``, call a commit barrier, or (fixpoint) call another
+    traced same-module function.  Host-side helpers (numpy/math only —
+    ``const_pair``, ``to_f64``) are exempt from the commit discipline:
+    Python f64 arithmetic there is exact and never sees XLA."""
+    defs = _module_functions(mod)
+    direct: Set[str] = set()
+    calls: Dict[str, Set[str]] = {name: set() for name in defs}
+    for name, bodies in defs.items():
+        for body in bodies:
+            for node in ast.walk(body):
+                if isinstance(node, ast.Name) and node.id in ("jnp", "lax"):
+                    direct.add(name)
+                elif isinstance(node, ast.Call):
+                    callee = _call_name(node.func)
+                    if callee in DD_COMMIT_FUNCS:
+                        direct.add(name)
+                    if callee in defs:
+                        calls[name].add(callee)
+    traced = set(direct)
+    changed = True
+    while changed:
+        changed = False
+        for name, callees in calls.items():
+            if name not in traced and callees & traced:
+                traced.add(name)
+                changed = True
+    return traced
+
+
+def _in_const_call(node: ast.AST,
+                   parents: Dict[ast.AST, ast.AST]) -> bool:
+    """Is ``node`` inside an argument of a dd-constant constructor?
+    (Host f64 arithmetic there is exact by design.)"""
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.Call) \
+                and _call_name(cur.func) in DD_CONST_FUNCS:
+            return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        cur = parents.get(cur)
+    return False
+
+
+def _constant_only(node: ast.expr) -> bool:
+    """Arithmetic over literals and ALL_CAPS module constants is host
+    Python (folded to an exact f64 before any tracing)."""
+    for leaf in ast.walk(node):
+        if isinstance(leaf, ast.Name) and not leaf.id.isupper():
+            return False
+        if isinstance(leaf, (ast.Call, ast.Attribute, ast.Subscript)):
+            return False
+    return True
+
+
+def _component_subscript(node: ast.expr) -> Optional[str]:
+    """``x[0]`` / ``x[1]`` (possibly negated) -> the component text."""
+    if isinstance(node, ast.UnaryOp):
+        node = node.operand
+    if (isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Name)
+            and isinstance(node.slice, ast.Constant)
+            and node.slice.value in (0, 1)):
+        return f"{node.value.id}[{node.slice.value}]"
+    return None
+
+
+# -- DK602: commit discipline in the dd core ----------------------------------
+
+
+def _check_core(mod: Module) -> Iterable[Finding]:
+    traced = _traced_functions(mod)
+    defs = _module_functions(mod)
+    parents = _parent_map(mod.tree)
+    for name in sorted(traced):
+        for body in defs[name]:
+            for node in ast.walk(body):
+                if not (isinstance(node, ast.BinOp)
+                        and isinstance(node.op, _FLOAT_BINOPS)):
+                    continue
+                parent = parents.get(node)
+                if (isinstance(parent, ast.Call)
+                        and _call_name(parent.func) in DD_COMMIT_FUNCS
+                        and node in parent.args):
+                    continue  # committed: _f32(a + b)
+                if _in_const_call(node, parents):
+                    continue  # host f64 constant expression
+                if _constant_only(node):
+                    continue  # literal/module-constant arithmetic
+                yield Finding(
+                    "DK602", mod.rel, node.lineno,
+                    f"uncommitted EFT intermediate in `{name}`: "
+                    f"`{expr_text(node)}` must be wrapped in the commit "
+                    "barrier (`_f32(...)`) or XLA's algebraic simplifier "
+                    "/ backend FMA contraction can collapse the "
+                    "error-free transform",
+                    f"{name}:{expr_text(node)}",
+                )
+
+
+# -- DK601/DK603: dd program functions ----------------------------------------
+
+
+def _dd_functions(mod: Module, prefixes) -> List[ast.AST]:
+    out = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and any(node.name.startswith(p) for p in prefixes):
+            out.append(node)
+    return out
+
+
+def _check_components(mod: Module, func: ast.AST) -> Iterable[Finding]:
+    fname = getattr(func, "name", "<lambda>")
+    for node in ast.walk(func):
+        if not (isinstance(node, ast.BinOp)
+                and isinstance(node.op, _FLOAT_BINOPS)):
+            continue
+        for side in (node.left, node.right):
+            comp = _component_subscript(side)
+            if comp is not None:
+                yield Finding(
+                    "DK601", mod.rel, node.lineno,
+                    f"raw float arithmetic on dd component `{comp}` in "
+                    f"`{fname}` (`{expr_text(node)}`) — the low word is "
+                    "silently discarded; use the ops.dd helpers",
+                    f"{fname}:{comp}",
+                )
+                break
+
+
+def _check_literals(mod: Module, func: ast.AST) -> Iterable[Finding]:
+    fname = getattr(func, "name", "<lambda>")
+    parents = _parent_map(func)
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _call_name(node.func)
+        if callee not in DD_OP_FUNCS and callee not in DD_LIFT_FUNCS:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for leaf in ast.walk(arg):
+                if (isinstance(leaf, ast.Constant)
+                        and isinstance(leaf.value, float)
+                        and not _f32_exact(leaf.value)
+                        and not _in_const_call(leaf, parents)):
+                    yield Finding(
+                        "DK603", mod.rel, node.lineno,
+                        f"float literal {leaf.value!r} fed to dd op "
+                        f"`{callee}` in `{fname}` is not exactly "
+                        "representable in float32 — it silently rounds; "
+                        "route it through the dd-constant constructor "
+                        "(`const(...)`) so the device computes with the "
+                        "host oracle's f64 image",
+                        f"{fname}:{callee}:{leaf.value!r}",
+                    )
+
+
+# -- DK604: budget-table completeness -----------------------------------------
+
+
+def _tuple_names(node: ast.expr) -> Optional[List[str]]:
+    """Names in a tuple/list literal of Names/Attributes, else None."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out = []
+    for elt in node.elts:
+        if isinstance(elt, ast.Name):
+            out.append(elt.id)
+        elif isinstance(elt, ast.Attribute):
+            out.append(elt.attr)
+        else:
+            return None
+    return out
+
+
+def _module_assign(mod: Module, name: str) -> Optional[ast.expr]:
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    return node.value
+    return None
+
+
+def _dict_key_names(node: ast.expr) -> Optional[List[str]]:
+    if not isinstance(node, ast.Dict):
+        return None
+    out = []
+    for key in node.keys:
+        if isinstance(key, ast.Attribute):
+            out.append(key.attr)
+        elif isinstance(key, ast.Name):
+            out.append(key.id)
+        else:
+            return None
+    return out
+
+
+def _check_tables(mods_by_rel: Dict[str, Module]) -> Iterable[Finding]:
+    kinds_mod = mods_by_rel.get(DD_KINDS_MODULE)
+    budget_mod = mods_by_rel.get(DD_BUDGET_MODULE)
+    if kinds_mod is None or budget_mod is None:
+        return
+    registry = _module_assign(kinds_mod, DD_KINDS_REGISTRY)
+    kinds = _tuple_names(registry) if registry is not None else None
+    if kinds is None:
+        yield Finding(
+            "DK604", DD_KINDS_MODULE, 1,
+            f"kind registry `{DD_KINDS_REGISTRY}` missing or not a "
+            "plain tuple of kind names — the budget-table completeness "
+            "check has nothing to check against",
+            f"{DD_KINDS_REGISTRY}:missing",
+        )
+        return
+
+    # the registry itself must be complete: every kind `feature_kind()`
+    # can RETURN must be registered, or a new comparator branch bypasses
+    # every downstream table check (the exact silent-margin hole DK604
+    # exists to close)
+    for node in ast.walk(kinds_mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == "feature_kind":
+            for ret in ast.walk(node):
+                if isinstance(ret, ast.Return) \
+                        and isinstance(ret.value, ast.Name) \
+                        and ret.value.id != "None" \
+                        and ret.value.id not in kinds:
+                    yield Finding(
+                        "DK604", DD_KINDS_MODULE, ret.lineno,
+                        f"`feature_kind` can return `{ret.value.id}` "
+                        f"but it is not in `{DD_KINDS_REGISTRY}` — the "
+                        "kind would ship with no budget-table checks "
+                        "(margin silently inf); register it",
+                        f"{DD_KINDS_REGISTRY}-unregistered:"
+                        f"{ret.value.id}",
+                    )
+
+    def names_of(table: str, want_dict: bool):
+        node = _module_assign(budget_mod, table)
+        got = (_dict_key_names(node) if want_dict
+               else _tuple_names(node)) if node is not None else None
+        if got is None:
+            line = node.lineno if node is not None else 1
+            return None, Finding(
+                "DK604", DD_BUDGET_MODULE, line,
+                f"`{table}` missing or not a static "
+                f"{'dict' if want_dict else 'tuple'} of kind entries",
+                f"{table}:missing",
+            )
+        return got, None
+
+    f32_keys, err = names_of(DD_F32_TABLE, True)
+    if err:
+        yield err
+    ops_keys, err = names_of(DD_OPS_TABLE, True)
+    if err:
+        yield err
+    certified, err = names_of(DD_CERTIFIED_LIST, False)
+    if err:
+        yield err
+    fallback, err = names_of(DD_FALLBACK_LIST, False)
+    if err:
+        yield err
+    if None in (f32_keys, ops_keys, certified, fallback):
+        return
+
+    for kind in kinds:
+        if kind not in f32_keys:
+            yield Finding(
+                "DK604", DD_BUDGET_MODULE, 1,
+                f"feature kind `{kind}` has no `{DD_F32_TABLE}` entry — "
+                "the f32 certified margin silently treats it as "
+                "uncertifiable (inf); add a reviewed similarity-error "
+                "budget (or an explicit inf with a soundness comment)",
+                f"{DD_F32_TABLE}:{kind}",
+            )
+        claimed = (kind in certified) + (kind in fallback)
+        if claimed == 0:
+            yield Finding(
+                "DK604", DD_BUDGET_MODULE, 1,
+                f"feature kind `{kind}` is in neither "
+                f"`{DD_CERTIFIED_LIST}` nor `{DD_FALLBACK_LIST}` — "
+                "every kind needs an explicit certified-vs-fallback "
+                "decision for the device-finalize split",
+                f"partition:{kind}",
+            )
+        elif claimed == 2:
+            yield Finding(
+                "DK604", DD_BUDGET_MODULE, 1,
+                f"feature kind `{kind}` is in BOTH "
+                f"`{DD_CERTIFIED_LIST}` and `{DD_FALLBACK_LIST}` — the "
+                "partition must be exact",
+                f"partition-overlap:{kind}",
+            )
+    for kind in certified:
+        if kind not in ops_keys:
+            yield Finding(
+                "DK604", DD_BUDGET_MODULE, 1,
+                f"certified dd kind `{kind}` has no `{DD_OPS_TABLE}` "
+                "budget — certified_dd_margin would raise on the first "
+                "plan carrying it; add the reviewed op-count budget",
+                f"{DD_OPS_TABLE}:{kind}",
+            )
+    for table, keys in ((DD_F32_TABLE, f32_keys), (DD_OPS_TABLE, ops_keys),
+                        (DD_CERTIFIED_LIST, certified),
+                        (DD_FALLBACK_LIST, fallback)):
+        for kind in keys:
+            if kind not in kinds:
+                yield Finding(
+                    "DK604", DD_BUDGET_MODULE, 1,
+                    f"`{table}` entry `{kind}` is not in the "
+                    f"`{DD_KINDS_REGISTRY}` registry — stale entry or "
+                    "unregistered kind",
+                    f"{table}-stale:{kind}",
+                )
+
+
+def check(modules: Sequence[Module], root=None) -> List[Finding]:
+    findings: List[Finding] = []
+    by_rel = {m.rel: m for m in modules}
+    for rel in DD_CORE_MODULES:
+        mod = by_rel.get(rel)
+        if mod is None:
+            continue
+        findings.extend(_check_core(mod))
+        for func in _module_functions(mod).values():
+            for body in func:
+                findings.extend(_check_literals(mod, body))
+    for rel, prefixes in DD_PROGRAM_FUNCTIONS.items():
+        mod = by_rel.get(rel)
+        if mod is None:
+            continue
+        for func in _dd_functions(mod, prefixes):
+            findings.extend(_check_components(mod, func))
+            findings.extend(_check_literals(mod, func))
+    findings.extend(_check_tables(by_rel))
+    return findings

@@ -95,6 +95,7 @@ from ..core.records import Record
 from ..store.records import record_digest
 from ..telemetry.decisions import PairDecision
 from ..telemetry.env import env_flag, env_str
+from ..utils import numcheck
 
 
 def fallback_pair_logit(props, r1: Record, r2: Record) -> float:
@@ -210,7 +211,7 @@ class FinalizeExecutor:
             device = use_env and env_flag("DUKE_DEVICE_FINALIZE", True)
         self.device = device
         # once-per-workload notice when property kinds force host residue
-        self._kind_fallback_logged = False
+        self._kind_fallback_logged = False  # single-writer: block coordinator (finalize_block runs under the workload lock)
         # confidence memo (device-finalize path only, so =0 pins the
         # legacy path exactly): (sig1, sig2) -> Processor.compare f64
         # result, where a record's ``sig`` is the tuple of its
@@ -223,8 +224,8 @@ class FinalizeExecutor:
         # (ISSUE 12): individual dict get/set are atomic under the GIL,
         # and the over-capacity reset rebinds a fresh dict atomically —
         # a racing worker at worst misses a cached entry and recomputes.
-        self._conf_cache: dict = {}
-        self._sig_cache: dict = {}
+        self._conf_cache: dict = {}  # single-writer: none — deliberately lock-free (GIL-atomic get/set, atomic reset rebind; see block comment)
+        self._sig_cache: dict = {}  # single-writer: none — deliberately lock-free (same contract as _conf_cache)
         self._pool: Optional[ThreadPoolExecutor] = None  # guarded by: self._pool_lock
         self._pool_lock = threading.Lock()
 
@@ -283,10 +284,19 @@ class FinalizeExecutor:
         plan_has_dd = self.device and bool(S.dd_plan_specs(plan))
         dd_reject = dd_event = None
         fallback: List = []
+        nc_margin = None  # DUKE_NUMCHECK=1: shadow-oracle margin budget
         if dd is not None and plan_has_dd:
             dd_reject = S.dd_reject_bound(proc.schema, plan)
             dd_event = S.dd_event_bound(proc.schema, plan)
             fallback = S.dd_fallback_props(proc.schema, plan)
+            if numcheck.enabled():
+                # the bound the certified verdicts charged: dd margin
+                # plus the probability-space comparison slack
+                t_min = threshold
+                if maybe is not None and maybe != 0.0:
+                    t_min = min(t_min, maybe)
+                nc_margin = (S.certified_dd_margin(plan)
+                             + S._dd_threshold_slack(t_min))
         if self.device and not self._kind_fallback_logged:
             # once per workload, not per batch: which properties force
             # the per-pair host-residue path (uncertifiable kinds +
@@ -342,6 +352,28 @@ class FinalizeExecutor:
             decisions: List[PairDecision] = []
             rec_id = record.record_id
             query_sig = None  # built lazily, once per query
+
+            def memo_compare(cand: Record) -> float:
+                """The comparison-signature confidence memo (see the
+                constructor comment): a duplicate group's every copy
+                pair shares one (sig, sig) key, so the group costs ONE
+                compare.  Ordered key — PersonName-style greedy token
+                matching is not provably symmetric.  Shared by the
+                certified-event confidence fetch AND the numcheck
+                shadow oracle, so the sanitizer leg's certified-reject
+                checks stay O(distinct content pairs), not O(group^2)."""
+                nonlocal query_sig
+                if query_sig is None:
+                    query_sig = sig(record)
+                ckey = (query_sig, sig(cand))
+                cache = self._conf_cache
+                p = cache.get(ckey)
+                if p is None:
+                    p = compare(record, cand)
+                    if len(cache) >= _CONF_CACHE_MAX:
+                        cache = self._conf_cache = {}
+                    cache[ckey] = p
+                return p
             for pos, row, device_logit in survivors:
                 rid = row_ids[row]
                 if rid is None or rid == rec_id:
@@ -356,6 +388,8 @@ class FinalizeExecutor:
                     continue
                 candidate = None
                 reason = None  # why this pair takes the host compare
+                dd_total = None  # certified total (numcheck shadow leg)
+                certified_event = False
                 if dd_reject is not None:
                     if dd[2][qi, pos]:
                         # tensors may have truncated the record: the dd
@@ -382,16 +416,34 @@ class FinalizeExecutor:
                                 decisions.append(PairDecision(
                                     rid, device_logit, True, None,
                                     path="device_certified"))
+                            if nc_margin is not None \
+                                    and numcheck.take_sample():
+                                # DUKE_NUMCHECK shadow oracle: the ONE
+                                # verdict class that skips the host
+                                # compare pays one back, sampled (and
+                                # memoized — k identical copy pairs
+                                # cost one compare, not k)
+                                shadow = (candidate if candidate
+                                          is not None else resolver(rid))
+                                if shadow is not None:
+                                    numcheck.observe(
+                                        "reject", rec_id, rid, total,
+                                        memo_compare(shadow),
+                                        threshold, maybe, nc_margin)
                             continue
                         if total < dd_event:
                             # inside the (tiny) ambiguous band around a
                             # boundary: only the exact host compare can
                             # decide
                             reason = "margin"
-                        # else: certified event — the class is certain,
-                        # but the emitted confidence must be the exact
-                        # f64 value, so the pair still takes ONE compare
-                        # (O(links) host work, not residue)
+                        else:
+                            # certified event — the class is certain,
+                            # but the emitted confidence must be the
+                            # exact f64 value, so the pair still takes
+                            # ONE compare (O(links) host work, not
+                            # residue)
+                            dd_total = total
+                            certified_event = True
                 elif self.device and not plan_has_dd:
                     reason = "kind"
                 if candidate is None:
@@ -399,23 +451,15 @@ class FinalizeExecutor:
                     if candidate is None:
                         continue
                 if self.device:
-                    # comparison-signature confidence memo: a duplicate
-                    # group's every copy pair shares one (sig, sig) key,
-                    # so the group costs ONE compare.  Ordered key —
-                    # PersonName-style greedy token matching is not
-                    # provably symmetric.
-                    if query_sig is None:
-                        query_sig = sig(record)
-                    ckey = (query_sig, sig(candidate))
-                    cache = self._conf_cache
-                    prob = cache.get(ckey)
-                    if prob is None:
-                        prob = compare(record, candidate)
-                        if len(cache) >= _CONF_CACHE_MAX:
-                            cache = self._conf_cache = {}
-                        cache[ckey] = prob
+                    prob = memo_compare(candidate)
                 else:
                     prob = compare(record, candidate)
+                if certified_event and nc_margin is not None:
+                    # free shadow check: the compare already ran for
+                    # the bit-exact confidence — the oracle must agree
+                    # an event emits, and the margin bound must hold
+                    numcheck.observe("event", rec_id, rid, dd_total,
+                                     prob, threshold, maybe, nc_margin)
                 rescored += 1
                 if reason == "margin":
                     res_margin += 1

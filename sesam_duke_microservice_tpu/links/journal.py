@@ -139,7 +139,9 @@ class LinkJournal:
         self.path = path
         self._sync = sync if sync in SYNC_POLICIES else sync_policy()
         self._lock = threading.Lock()
-        self._fd = os.open(path, os.O_RDWR | os.O_CREAT | os.O_APPEND, 0o644)
+        # writes (the close() -1 sentinel) serialize under the lock; the
+        # fd VALUE is read lock-free by the pre-publication startup scan
+        self._fd = os.open(path, os.O_RDWR | os.O_CREAT | os.O_APPEND, 0o644)  # guarded by: self._lock [writes]
         self._last_seq = 0  # guarded by: self._lock [writes]
         self._applied_seq = 0  # guarded by: self._lock [writes]
         # batches scanned at open with seq > the applied watermark, in

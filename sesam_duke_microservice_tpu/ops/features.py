@@ -169,6 +169,15 @@ PHONETIC = "phonetic"        # value hash + phonetic code hash
 NUMERIC = "numeric"          # parsed float
 GEO = "geo"                  # parsed lat/lon
 
+# THE kind registry.  dukecheck's numerics gate (DK604) reads this tuple
+# statically and asserts every member has a ``_SIM_ERROR_BOUND`` entry
+# and is partitioned into ``DD_KINDS``/``DD_FALLBACK_KINDS`` in
+# ops.scoring — add a kind here without its budget-table entries and CI
+# fails instead of the new kind silently collapsing the certified
+# margins (an absent entry reads as inf/uncertifiable at runtime).
+ALL_KINDS = (CHARS, CHARS_WEIGHTED, GRAM_SET, TOKEN_SET, HASH, PHONETIC,
+             NUMERIC, GEO)
+
 
 def feature_kind(comparator) -> Optional[str]:
     """Feature kind for a comparator instance, or None if the comparator has

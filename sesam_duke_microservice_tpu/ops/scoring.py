@@ -129,14 +129,19 @@ def certified_f32_margin(plan: "F.SchemaFeatures") -> float:
 # for unknown future kinds too — while the per-property device-finalize
 # split (``engine.finalize``, ISSUE 12) routes ONLY the geo property to
 # the host and keeps certified device verdicts for the rest.
+# Ledger derivations (scripts/dukecheck/budgets, docs/ERROR_BUDGETS.md):
+# the ratio kinds pay one f32 division plus the quadratic map (~8 ulps
+# total before amplification), weighted Levenshtein pays ~256 weight
+# accumulations, numeric a ratio of f32-quantized doubles.  GEO is
+# uncertifiable BY DESIGN (inf — no annotation; see the block comment).
 _SIM_ERROR_BOUND = {
-    F.CHARS: 64.0 * _F32_EPS,
-    F.GRAM_SET: 64.0 * _F32_EPS,
-    F.TOKEN_SET: 64.0 * _F32_EPS,
-    F.HASH: 64.0 * _F32_EPS,
-    F.PHONETIC: 64.0 * _F32_EPS,
-    F.CHARS_WEIGHTED: 2048.0 * _F32_EPS,
-    F.NUMERIC: 256.0 * _F32_EPS,
+    F.CHARS: 64.0 * _F32_EPS,          # dd-budget: _SIM_ERROR_BOUND[CHARS] covers 8 * eps32 headroom 4
+    F.GRAM_SET: 64.0 * _F32_EPS,       # dd-budget: _SIM_ERROR_BOUND[GRAM_SET] covers 8 * eps32 headroom 4
+    F.TOKEN_SET: 64.0 * _F32_EPS,      # dd-budget: _SIM_ERROR_BOUND[TOKEN_SET] covers 8 * eps32 headroom 4
+    F.HASH: 64.0 * _F32_EPS,           # dd-budget: _SIM_ERROR_BOUND[HASH] covers 2 * eps32 headroom 16
+    F.PHONETIC: 64.0 * _F32_EPS,       # dd-budget: _SIM_ERROR_BOUND[PHONETIC] covers 2 * eps32 headroom 16
+    F.CHARS_WEIGHTED: 2048.0 * _F32_EPS,  # dd-budget: _SIM_ERROR_BOUND[CHARS_WEIGHTED] covers 2 * 256 * eps32 headroom 2
+    F.NUMERIC: 256.0 * _F32_EPS,       # dd-budget: _SIM_ERROR_BOUND[NUMERIC] covers 64 * eps32 headroom 2
     F.GEO: float("inf"),
 }
 
@@ -225,6 +230,15 @@ def _dd():
 # fall back to the host per property.
 DD_KINDS = (F.CHARS, F.GRAM_SET, F.TOKEN_SET, F.HASH, F.PHONETIC)
 
+# Kinds that deliberately take the per-property host fallback instead of
+# a certified dd kernel.  DECLARATIVE, and machine-checked: dukecheck's
+# numerics gate (DK604) asserts DD_KINDS and DD_FALLBACK_KINDS partition
+# ``ops.features.ALL_KINDS`` exactly, and that every dd kind carries a
+# ``_DD_SIM_OPS`` budget and every kind a ``_SIM_ERROR_BOUND`` entry —
+# a future comparator kind cannot silently ship without a reviewed
+# margin entry or an explicit fallback decision.
+DD_FALLBACK_KINDS = (F.CHARS_WEIGHTED, F.NUMERIC, F.GEO)
+
 # Jaro-Winkler's branch constants (boost 0.7, the 0.5 map split) are
 # compared against rationals with denominator 3*n1*n2*m; past this char
 # width the rational spacing argument above thins below 1e-7, so wider
@@ -236,13 +250,18 @@ _DD_JW_MAX_CHARS = 64
 # divisions, the 3-term average and the boost; hash/phonetic are
 # constants reproduced from the oracle's own f64 values.  All generous
 # multiples of the per-op DD_EPS.
+# (ledger: ratio kinds pay one dd division + ~2 fold ops + the ~6-op
+# map; JW pays three divisions, the 3-term average, the prefix boost and
+# the map, with every term of magnitude <= 2; hash/phonetic reproduce
+# oracle constants through the map alone.)
 _DD_SIM_OPS = {
-    F.CHARS: 64.0,
-    F.GRAM_SET: 64.0,
-    F.TOKEN_SET: 64.0,
-    F.HASH: 16.0,
-    F.PHONETIC: 16.0,
+    F.CHARS: 64.0,      # dd-budget: _DD_SIM_OPS[CHARS] covers 12 headroom 4
+    F.GRAM_SET: 64.0,   # dd-budget: _DD_SIM_OPS[GRAM_SET] covers 14 headroom 4
+    F.TOKEN_SET: 64.0,  # dd-budget: _DD_SIM_OPS[TOKEN_SET] covers 14 headroom 4
+    F.HASH: 16.0,       # dd-budget: _DD_SIM_OPS[HASH] covers 4 headroom 2
+    F.PHONETIC: 16.0,   # dd-budget: _DD_SIM_OPS[PHONETIC] covers 4 headroom 2
 }
+# dd-budget: _DD_JW_SIM_OPS covers 2 * 22 headroom 4
 _DD_JW_SIM_OPS = 256.0
 
 
@@ -440,7 +459,13 @@ def _dd_levenshtein_sim(c1, l1, c2, l2, equal, *, dist=None):
 # the ~1e-12 dd + f64 evaluation noise of ``j``, far below the ~1e-7
 # rational spacing of non-boundary j values — pairs inside it go to the
 # host residue instead of trusting a branch both sides computed
-# inexactly.
+# inexactly.  Two-sided ledger check: the guard must cover the ~20-op
+# dd evaluation noise of ``j`` with two orders of slack (covers), AND
+# stay an order under the worst rational spacing 1/(q_max * 3 * n^3) at
+# the 64-char JW width cap with boundary-constant denominator q_max=10
+# (0.5 = 1/2, 0.7 = 7/10) — widening it past that would flag pairs the
+# spacing proof already certifies (below).
+# dd-budget: _DD_JW_BRANCH_GUARD covers 100 * 20 * DD_EPS headroom 4 below 1 / (10 * 3 * 64**3) / 8
 _DD_JW_BRANCH_GUARD = 1e-9
 
 

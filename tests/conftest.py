@@ -40,10 +40,23 @@ jax.config.update("jax_platforms", "cpu")
 
 
 def pytest_sessionfinish(session, exitstatus):
-    """DUKE_LOCKCHECK=1 leg: a recorded lock-order inversion fails the
-    whole session even if every individual test passed — the sanitizer
-    validates the committed static hierarchy, not any one test."""
-    from sesam_duke_microservice_tpu.utils import lockcheck
+    """Sanitizer legs: a recorded lock-order inversion (DUKE_LOCKCHECK=1)
+    or certified-numerics violation (DUKE_NUMCHECK=1) fails the whole
+    session even if every individual test passed — the sanitizers
+    validate committed invariants (the static lock hierarchy, the
+    certified margin bounds), not any one test."""
+    from sesam_duke_microservice_tpu.utils import lockcheck, numcheck
+
+    # DUKE_NUMCHECK leg: any certified-vs-oracle disagreement or
+    # margin-bound violation recorded during the run fails it (checked
+    # unconditionally — injection tests reset() their deliberate
+    # violations, so anything left here is real)
+    numfound = numcheck.violations()
+    if numfound:
+        print("\nnumcheck: certified-numerics violations recorded:")
+        for line in numfound:
+            print("  " + line)
+        session.exitstatus = 1
 
     if not lockcheck.enabled():
         return

@@ -567,6 +567,10 @@ class TestDeviceFinalizeSplit:
         the host cost of the certified path is the per-property fallback
         fold plus the event tail, not O(survivors) full compares."""
         monkeypatch.setenv("DUKE_DECISION_RECORD", "0")
+        # the DUKE_NUMCHECK=1 CI leg shadow-compares certified rejects
+        # BY DESIGN — this test pins the production (sanitizer-off)
+        # compare-skipping contract
+        monkeypatch.setenv("DUKE_NUMCHECK", "0")
         schema = hostprop_schema()
         records = _records_with_person(30, seed=17)
         index = DeviceIndex(schema, tunables=MatchTunables())

@@ -459,8 +459,15 @@ def test_baseline_keys_are_line_stable():
 def test_repo_self_scan_matches_baseline():
     """The CI gate, in-process: every finding in the live tree is inline-
     suppressed or baselined, no baseline entry is stale, and the
-    committed lock-hierarchy doc is fresh."""
-    findings = collect_findings(REPO_ROOT)
+    committed lock-hierarchy doc is fresh.  The hlocheck gate is
+    excluded HERE only because it compiles the full program x flag
+    matrix (~a minute of XLA work the lint job pays once);
+    tests/test_numcheck.py runs its dd-core program live and the CI
+    lint job runs the complete gate via ``python -m scripts.dukecheck``."""
+    from scripts.dukecheck import CHECKER_NAMES
+
+    static_checkers = tuple(n for n in CHECKER_NAMES if n != "hlocheck")
+    findings = collect_findings(REPO_ROOT, only=static_checkers)
     baseline = dk_core.load_baseline(REPO_ROOT / BASELINE_RELPATH)
     new, stale = dk_core.apply_baseline(findings, baseline)
     assert not new, "unbaselined findings:\n" + "\n".join(

@@ -1,0 +1,649 @@
+"""Certified-numerics analysis suite tests (ISSUE 13).
+
+Four layers, mirroring the suite itself:
+
+  * the EFT-discipline linter (DK601..DK604) against seeded-violation
+    fixtures AND the live tree (self-scan must be clean);
+  * the error-budget ledger (DK611/DK612/DK613/DK690): interval
+    evaluator semantics, coverage/headroom/ceiling failures, doc
+    staleness, and the repo's own annotations resolving with their
+    declared headroom;
+  * the compiled-HLO gate: parser/detector units on synthetic HLO text
+    (a stripped-commit mutant and an exposed mul->add pair must be
+    caught) plus the live dd-core program surviving compilation;
+  * the runtime sanitizer (DUKE_NUMCHECK): unit semantics, the live
+    engine pipeline running clean under it, and a disagreement
+    injection (a deliberately-broken reject bound) being caught.
+
+Plus THE mutation test the acceptance criteria name: deleting any
+single ``_f32`` commit from ``ops/dd.py`` must be caught by at least
+one static gate.
+"""
+
+import re
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from scripts.dukecheck import CHECKER_NAMES, collect_findings  # noqa: E402
+from scripts.dukecheck import budgets, hlocheck, numerics  # noqa: E402
+from scripts.dukecheck import core as dk_core  # noqa: E402
+from scripts.dukecheck.config import (  # noqa: E402
+    DD_BUDGET_MODULE,
+    DD_CORE_MODULES,
+    DD_KINDS_MODULE,
+)
+
+DD_CORE_REL = DD_CORE_MODULES[0]
+DD_PROGRAM_REL = "sesam_duke_microservice_tpu/ops/scoring.py"
+
+
+def mk_module(tmp_path, rel, source):
+    path = tmp_path / rel.replace("/", "__")
+    path.write_text(source, encoding="utf-8")
+    return dk_core.Module(path, rel)
+
+
+def codes(findings):
+    return sorted(f.code for f in findings)
+
+
+# -- DK601: raw component arithmetic ------------------------------------------
+
+
+class TestDK601:
+    def test_component_arithmetic_flagged(self, tmp_path):
+        mod = mk_module(tmp_path, DD_PROGRAM_REL, (
+            "import jax.numpy as jnp\n"
+            "def _dd_bad(x, y):\n"
+            "    return x[0] + y[0]\n"
+        ))
+        found = numerics.check([mod])
+        assert "DK601" in codes(found)
+
+    def test_helper_calls_clean(self, tmp_path):
+        mod = mk_module(tmp_path, DD_PROGRAM_REL, (
+            "def _dd_good(D, x, y):\n"
+            "    s = D.add(x, y)\n"
+            "    return D.mul(s, s)\n"
+        ))
+        assert codes(numerics.check([mod])) == []
+
+    def test_non_dd_functions_unscanned(self, tmp_path):
+        # only the configured dd-prefixed functions carry the rule
+        mod = mk_module(tmp_path, DD_PROGRAM_REL, (
+            "def plain(x, y):\n"
+            "    return x[0] + y[0]\n"
+        ))
+        assert codes(numerics.check([mod])) == []
+
+
+# -- DK602: commit discipline -------------------------------------------------
+
+
+_CORE_HEADER = (
+    "import jax.numpy as jnp\n"
+    "from jax import lax\n"
+    "def _f32(x):\n"
+    "    return lax.reduce_precision(x, exponent_bits=8, mantissa_bits=23)\n"
+)
+
+
+class TestDK602:
+    def test_uncommitted_binop_flagged(self, tmp_path):
+        mod = mk_module(tmp_path, DD_CORE_REL, _CORE_HEADER + (
+            "def two_sum(a, b):\n"
+            "    s = _f32(a + b)\n"
+            "    e = b - _f32(s - a)\n"   # outer sub uncommitted
+            "    return s, e\n"
+        ))
+        found = numerics.check([mod])
+        assert codes(found) == ["DK602"]
+        assert "b - _f32(s - a)" in found[0].message
+
+    def test_committed_chain_clean(self, tmp_path):
+        mod = mk_module(tmp_path, DD_CORE_REL, _CORE_HEADER + (
+            "def two_sum(a, b):\n"
+            "    s = _f32(a + b)\n"
+            "    e = _f32(b - _f32(s - a))\n"
+            "    return s, e\n"
+        ))
+        assert codes(numerics.check([mod])) == []
+
+    def test_const_args_and_caps_arithmetic_exempt(self, tmp_path):
+        mod = mk_module(tmp_path, DD_CORE_REL, _CORE_HEADER + (
+            "TERMS = 11\n"
+            "def const(x, like=None):\n"
+            "    return jnp.float32(x), jnp.float32(0.0)\n"
+            "def log_series(x):\n"
+            "    s = const(1.0 / (2 * TERMS + 1))\n"   # host f64, exact
+            "    n = TERMS - 1\n"                      # module-constant int
+            "    return s, n\n"
+        ))
+        assert codes(numerics.check([mod])) == []
+
+    def test_host_side_helpers_exempt(self, tmp_path):
+        mod = mk_module(tmp_path, DD_CORE_REL, _CORE_HEADER + (
+            "import numpy as np\n"
+            "def const_pair(x):\n"
+            "    hi = np.float32(x)\n"
+            "    lo = np.float32(x - float(hi))\n"     # host-side, exact
+            "    return hi, lo\n"
+        ))
+        assert codes(numerics.check([mod])) == []
+
+    def test_inline_ignore_respected(self, tmp_path):
+        mod = mk_module(tmp_path, DD_CORE_REL, _CORE_HEADER + (
+            "def f(a, b):\n"
+            "    return a + b  # dukecheck: ignore[DK602] test fixture\n"
+        ))
+        by_rel = {mod.rel: mod}
+        found = dk_core.filter_suppressed(by_rel, numerics.check([mod]))
+        assert codes(found) == []
+
+
+# -- DK603: inexact float literals --------------------------------------------
+
+
+class TestDK603:
+    def test_inexact_literal_to_lift_flagged(self, tmp_path):
+        mod = mk_module(tmp_path, DD_CORE_REL, _CORE_HEADER + (
+            "def from_f32(a):\n"
+            "    a = jnp.asarray(a, jnp.float32)\n"
+            "    return a, jnp.zeros_like(a)\n"
+            "def bad(x):\n"
+            "    return from_f32(0.1)\n"               # silently rounds
+        ))
+        found = numerics.check([mod])
+        assert "DK603" in codes(found)
+        assert "0.1" in [f for f in found if f.code == "DK603"][0].message
+
+    def test_exact_literal_clean(self, tmp_path):
+        mod = mk_module(tmp_path, DD_CORE_REL, _CORE_HEADER + (
+            "def from_f32(a):\n"
+            "    a = jnp.asarray(a, jnp.float32)\n"
+            "    return a, jnp.zeros_like(a)\n"
+            "def good(x):\n"
+            "    return from_f32(0.5)\n"               # f32-exact
+        ))
+        assert "DK603" not in codes(numerics.check([mod]))
+
+    def test_const_constructor_blessed(self, tmp_path):
+        mod = mk_module(tmp_path, DD_PROGRAM_REL, (
+            "def _dd_map(D, x):\n"
+            "    return D.add(x, D.const(0.1, like=x[0]))\n"
+        ))
+        assert "DK603" not in codes(numerics.check([mod]))
+
+    def test_inexact_literal_to_dd_op_flagged(self, tmp_path):
+        mod = mk_module(tmp_path, DD_PROGRAM_REL, (
+            "import jax.numpy as jnp\n"
+            "def _dd_map(D, x, h):\n"
+            "    return D.add(x, (jnp.full_like(h, 0.3), "
+            "jnp.zeros_like(h)))\n"
+        ))
+        assert "DK603" in codes(numerics.check([mod]))
+
+
+# -- DK604: budget-table completeness -----------------------------------------
+
+
+_KINDS_SRC = (
+    "CHARS = 'chars'\n"
+    "HASH = 'hash'\n"
+    "GEO = 'geo'\n"
+    "{extra_def}"
+    "ALL_KINDS = (CHARS, HASH, GEO{extra_ref})\n"
+)
+_BUDGET_SRC = (
+    "from . import features as F\n"
+    "_SIM_ERROR_BOUND = {{F.CHARS: 1e-6, F.HASH: 1e-6, "
+    "F.GEO: float('inf'){f32_extra}}}\n"
+    "_DD_SIM_OPS = {{F.CHARS: 64.0, F.HASH: 16.0{ops_extra}}}\n"
+    "DD_KINDS = (F.CHARS, F.HASH{cert_extra},)\n"
+    "DD_FALLBACK_KINDS = (F.GEO{fb_extra},)\n"
+)
+
+
+class TestDK604:
+    def _mods(self, tmp_path, *, extra=False, budgeted=False):
+        kinds = mk_module(tmp_path, DD_KINDS_MODULE, _KINDS_SRC.format(
+            extra_def="FOO = 'foo'\n" if extra else "",
+            extra_ref=", FOO" if extra else "",
+        ))
+        budget = mk_module(tmp_path, DD_BUDGET_MODULE, _BUDGET_SRC.format(
+            f32_extra=", F.FOO: 1e-6" if budgeted else "",
+            ops_extra=", F.FOO: 32.0" if budgeted else "",
+            cert_extra=", F.FOO" if budgeted else "",
+            fb_extra="",
+        ))
+        return [kinds, budget]
+
+    def test_complete_tables_clean(self, tmp_path):
+        assert codes(numerics.check(self._mods(tmp_path))) == []
+
+    def test_new_kind_without_entries_flagged(self, tmp_path):
+        found = numerics.check(self._mods(tmp_path, extra=True))
+        details = {f.detail for f in found}
+        assert codes(found).count("DK604") >= 2
+        assert "_SIM_ERROR_BOUND:FOO" in details     # no margin entry
+        assert "partition:FOO" in details            # no split decision
+
+    def test_new_kind_with_entries_clean(self, tmp_path):
+        found = numerics.check(
+            self._mods(tmp_path, extra=True, budgeted=True))
+        assert codes(found) == []
+
+    def test_certified_kind_missing_ops_budget(self, tmp_path):
+        mods = self._mods(tmp_path, extra=True, budgeted=True)
+        # drop FOO's _DD_SIM_OPS entry but keep it certified
+        src = mods[1].path.read_text().replace(", F.FOO: 32.0", "")
+        mods[1] = mk_module(tmp_path, DD_BUDGET_MODULE + "x", src)
+        mods[1].rel = DD_BUDGET_MODULE
+        found = numerics.check(mods)
+        assert "_DD_SIM_OPS:FOO" in {f.detail for f in found}
+
+    def test_unregistered_feature_kind_return_flagged(self, tmp_path):
+        """Forgetting the ALL_KINDS registry entry entirely must not
+        bypass the gate: any kind ``feature_kind`` can return has to be
+        registered, or it ships with margin silently inf."""
+        kinds = mk_module(tmp_path, DD_KINDS_MODULE, (
+            "CHARS = 'chars'\n"
+            "SOUNDEX2 = 'soundex2'\n"
+            "ALL_KINDS = (CHARS,)\n"   # SOUNDEX2 forgotten
+            "def feature_kind(comparator):\n"
+            "    if comparator is None:\n"
+            "        return None\n"
+            "    if comparator == 's2':\n"
+            "        return SOUNDEX2\n"
+            "    return CHARS\n"
+        ))
+        budget = mk_module(tmp_path, DD_BUDGET_MODULE, (
+            "from . import features as F\n"
+            "_SIM_ERROR_BOUND = {F.CHARS: 1e-6}\n"
+            "_DD_SIM_OPS = {F.CHARS: 64.0}\n"
+            "DD_KINDS = (F.CHARS,)\n"
+            "DD_FALLBACK_KINDS = ()\n"
+        ))
+        found = numerics.check([kinds, budget])
+        assert "ALL_KINDS-unregistered:SOUNDEX2" in {f.detail
+                                                     for f in found}
+
+    def test_repo_registry_partition_holds(self):
+        """The live tree's tables are complete (the DK604 leg of the
+        empty-baseline acceptance criterion)."""
+        mods = dk_core.load_modules(REPO_ROOT)
+        found = [f for f in numerics.check(mods) if f.code == "DK604"]
+        assert found == []
+
+
+# -- the error-budget ledger --------------------------------------------------
+
+
+class TestLedger:
+    def test_interval_evaluator_outward_rounds(self):
+        iv = budgets.eval_interval("1/3", {})
+        assert iv.lo < 1 / 3 < iv.hi
+        iv = budgets.eval_interval("max(3*u32**2, 12*u32**2)", {})
+        assert iv.hi >= 12 * (2.0 ** -24) ** 2
+
+    def test_unknown_symbol_is_dk613(self, tmp_path):
+        mod = mk_module(tmp_path, DD_CORE_REL, (
+            "# dd-budget: X covers nonsense_symbol\n"
+            "X = 1.0\n"
+        ))
+        _, found = budgets.collect([mod])
+        assert codes(found) == ["DK613"]
+
+    def test_uncovered_constant_is_dk611(self, tmp_path):
+        mod = mk_module(tmp_path, DD_CORE_REL, (
+            "# dd-budget: EPS covers 64 * u32 headroom 2\n"
+            "EPS = 2.0 ** -24\n"   # equals 1*u32: covers nothing
+        ))
+        _, found = budgets.collect([mod])
+        assert codes(found) == ["DK611"]
+
+    def test_headroom_policy_enforced(self, tmp_path):
+        mod = mk_module(tmp_path, DD_CORE_REL, (
+            "# dd-budget: EPS covers 3 * u32 headroom 4\n"
+            "EPS = 2.0 ** -22\n"   # 4*u32: covers, but headroom 1.33 < 4
+        ))
+        _, found = budgets.collect([mod])
+        assert codes(found) == ["DK611"]
+        assert "headroom" in found[0].message
+
+    def test_ceiling_violation_is_dk612(self, tmp_path):
+        mod = mk_module(tmp_path, DD_CORE_REL, (
+            "# dd-budget: GUARD covers 2 * u32 below 8 * u32\n"
+            "GUARD = 2.0 ** -20\n"   # 16*u32 > the 8*u32 ceiling
+        ))
+        _, found = budgets.collect([mod])
+        assert codes(found) == ["DK612"]
+
+    def test_table_entry_targets_resolve(self, tmp_path):
+        # real tables key on F.<KIND> attributes and compose pinned
+        # symbols; the fixture mirrors the shape with literals
+        mod = mk_module(tmp_path, DD_BUDGET_MODULE, (
+            "TBL = {\n"
+            "    KEY: 8 * 2.0 ** -23,"
+            "  # dd-budget: TBL[KEY] covers 2 * eps32\n"
+            "}\n"
+        ))
+        entries, found = budgets.collect([mod])
+        assert found == [] and len(entries) == 1
+        assert entries[0].actual == pytest.approx(4.0)
+
+    def test_unknown_code_symbol_is_dk613(self, tmp_path):
+        mod = mk_module(tmp_path, DD_BUDGET_MODULE, (
+            "TBL = {\n"
+            "    KEY: 8 * E,  # dd-budget: TBL[KEY] covers 2 * eps32\n"
+            "}\n"
+        ))
+        _, found = budgets.collect([mod])
+        assert codes(found) == ["DK613"]  # `E` is not a pinned symbol
+
+    def test_malformed_headroom_is_dk613_not_a_crash(self, tmp_path):
+        mod = mk_module(tmp_path, DD_CORE_REL, (
+            "# dd-budget: X covers u32 headroom 1.2e\n"
+            "X = 1.0\n"
+        ))
+        _, found = budgets.collect([mod])
+        assert codes(found) == ["DK613"]
+        assert "headroom" in found[0].message
+
+    def test_duplicate_target_is_dk613(self, tmp_path):
+        mod = mk_module(tmp_path, DD_CORE_REL, (
+            "# dd-budget: X covers u32\n"
+            "X = 1.0\n"
+            "# dd-budget: X covers u64\n"
+            "Y = 1.0\n"
+        ))
+        _, found = budgets.collect([mod])
+        assert "DK613" in codes(found)
+
+    def test_repo_ledger_resolves_with_headroom(self):
+        mods = dk_core.load_modules(REPO_ROOT)
+        entries, found = budgets.collect(mods)
+        assert found == [], [f.render() for f in found]
+        assert len(entries) >= 14
+        by_name = {e.target: e for e in entries}
+        assert by_name["DD_EPS"].actual >= 1.25
+        assert by_name["_DD_JW_BRANCH_GUARD"].ceiling is not None
+
+    def test_repo_doc_fresh_and_stale_detected(self, tmp_path):
+        mods = dk_core.load_modules(REPO_ROOT)
+        assert [f.render() for f in budgets.check(mods, REPO_ROOT)] == []
+        # a doctored doc must be DK690
+        root = tmp_path / "fake_root"
+        (root / "docs").mkdir(parents=True)
+        doc = REPO_ROOT / budgets.DOC_RELPATH
+        (root / budgets.DOC_RELPATH).write_text(
+            doc.read_text(encoding="utf-8") + "\ndrift\n", encoding="utf-8")
+        found = budgets.check(mods, root)
+        assert codes(found) == ["DK690"]
+
+
+# -- the compiled-HLO gate ----------------------------------------------------
+
+
+_SYNTH_HLO = """\
+HloModule test
+%fused_computation {
+  %p0 = f32[8]{0} parameter(0)
+  %p1 = f32[8]{0} parameter(1)
+  %multiply.1 = f32[8]{0} multiply(f32[8]{0} %p0, f32[8]{0} %p1), metadata={source_file="x/ops/dd.py" source_line=128}
+  %reduce-precision.1 = f32[8]{0} reduce-precision(f32[8]{0} %multiply.1), exponent_bits=8, mantissa_bits=23
+  %add.1 = f32[8]{0} add(f32[8]{0} %reduce-precision.1, f32[8]{0} %p1), metadata={source_file="x/ops/dd.py" source_line=129}
+  ROOT %add.2 = f32[8]{0} add(f32[8]{0} %add.1, f32[8]{0} %p0)
+}
+"""
+
+
+class TestHloCheck:
+    def test_commit_counting(self):
+        assert hlocheck.count_commits(_SYNTH_HLO) == 1
+        stripped = "\n".join(l for l in _SYNTH_HLO.splitlines()
+                             if "reduce-precision" not in l)
+        assert hlocheck.count_commits(stripped) == 0
+
+    def test_committed_mul_add_not_exposed(self):
+        # the multiply feeds the add THROUGH reduce-precision: clean
+        assert hlocheck.exposed_contractions(_SYNTH_HLO) == []
+
+    def test_stripped_commit_mutant_exposes_contraction(self):
+        # compiler-strip simulation: rewrite the add to consume the
+        # multiply directly (what the optimized HLO shows once a
+        # simplifier removes the barrier)
+        mutant = _SYNTH_HLO.replace(
+            "add(f32[8]{0} %reduce-precision.1", "add(f32[8]{0} %multiply.1")
+        exposed = hlocheck.exposed_contractions(mutant)
+        assert len(exposed) == 1 and "multiply" in exposed[0]
+
+    def test_non_dd_mul_add_ignored(self):
+        # same adjacency WITHOUT dd metadata is outside the discipline
+        mutant = _SYNTH_HLO.replace("ops/dd.py", "ops/other.py").replace(
+            "add(f32[8]{0} %reduce-precision.1", "add(f32[8]{0} %multiply.1")
+        assert hlocheck.exposed_contractions(mutant) == []
+
+    def test_live_dd_core_program_survives_compilation(self):
+        """The real ops.dd composite keeps every commit through XLA
+        optimization on this backend (the in-suite leg of the gate; the
+        CI lint job runs the full program x flag matrix)."""
+        fn, args = hlocheck._build_dd_core()
+        lowered = fn.lower(*args)
+        unopt = hlocheck.count_commits_mlir(lowered.as_text())
+        opt_text = lowered.compile().as_text()
+        opt = hlocheck.count_commits(opt_text)
+        assert unopt > 0
+        assert opt >= unopt, (opt, unopt)
+        assert hlocheck.exposed_contractions(opt_text) == []
+
+
+# -- THE mutation test --------------------------------------------------------
+
+
+def _strip_f32_occurrence(source: str, start: int) -> str:
+    """Remove one ``_f32`` commit, keeping its argument (parenthesized,
+    so multi-line wrapped expressions stay syntactically valid)."""
+    open_paren = source.index("(", start)
+    return source[:start] + source[open_paren:]
+
+
+def test_every_commit_deletion_is_caught(tmp_path):
+    """Acceptance criterion: removing any single ``reduce_precision``
+    commit from ops/dd.py fails CI via at least one static gate (DK602
+    here; the runtime hlocheck DK703/DK701 legs back it up for
+    transformations the AST cannot see)."""
+    source = (REPO_ROOT / DD_CORE_REL).read_text(encoding="utf-8")
+    occurrences = [m.start() for m in re.finditer(r"(?<![\w.])_f32\(",
+                                                  source)
+                   if not source[:m.start()].endswith("def ")]
+    assert len(occurrences) >= 20  # the EFT core is committed throughout
+    uncaught = []
+    for start in occurrences:
+        mutated = _strip_f32_occurrence(source, start)
+        mod = mk_module(tmp_path, DD_CORE_REL, mutated)
+        found = [f for f in numerics.check([mod])
+                 if f.code in ("DK601", "DK602", "DK603")]
+        if not found:
+            line = source.count("\n", 0, start) + 1
+            uncaught.append(f"dd.py:{line}")
+    assert not uncaught, (
+        "commit deletions no static gate catches: " + ", ".join(uncaught))
+
+
+# -- the runtime sanitizer ----------------------------------------------------
+
+
+from sesam_duke_microservice_tpu.utils import numcheck  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_numcheck_state():
+    numcheck.reset()
+    yield
+    # injection tests leave deliberate violations; never leak them into
+    # the conftest session gate
+    numcheck.reset()
+
+
+class TestNumcheckUnit:
+    def test_agreeing_reject_records_no_violation(self):
+        import math
+        prob = 1.0 / (1.0 + math.exp(3.0))  # oracle logit == dd total
+        numcheck.observe("reject", "a", "b", total=-3.0, prob=prob,
+                         threshold=0.8, maybe=0.6, margin=1e-9)
+        assert numcheck.violations() == []
+        assert numcheck.report()["checked"] == 1
+
+    def test_reject_disagreement_caught(self):
+        numcheck.observe("reject", "a", "b", total=-3.0, prob=0.95,
+                         threshold=0.8, maybe=0.6, margin=1e-9)
+        v = numcheck.violations()
+        assert len(v) == 1 and "CERTIFIED-REJECT" in v[0]
+
+    def test_event_disagreement_caught(self):
+        numcheck.observe("event", "a", "b", total=4.0, prob=0.30,
+                         threshold=0.8, maybe=0.6, margin=1e-9)
+        v = numcheck.violations()
+        assert len(v) == 1 and "CERTIFIED-EVENT" in v[0]
+
+    def test_margin_bound_violation_caught(self):
+        import math
+        prob = 1.0 / (1.0 + math.exp(3.0))  # oracle logit = -3
+        numcheck.observe("reject", "a", "b", total=-3.5, prob=prob,
+                         threshold=0.8, maybe=None, margin=1e-6)
+        v = numcheck.violations()
+        assert len(v) == 1 and "MARGIN-BOUND" in v[0]
+
+    def test_margin_check_skipped_outside_interior(self):
+        # |logit| > 10: reconstruction is ill-conditioned, class-only
+        numcheck.observe("reject", "a", "b", total=-40.0, prob=1e-9,
+                         threshold=0.8, maybe=None, margin=1e-9)
+        assert numcheck.violations() == []
+
+    def test_violations_latch_in_ring(self):
+        numcheck.observe("reject", "a", "b", total=-3.0, prob=0.95,
+                         threshold=0.8, maybe=None, margin=1e-9)
+        for i in range(2000):  # flood: the violation must survive
+            numcheck.observe("reject", f"x{i}", "y", total=-5.0,
+                             prob=0.01, threshold=0.8, maybe=None,
+                             margin=1e-9)
+        recent = numcheck.report()["recent"]
+        assert any(r["violation"] for r in recent)
+
+    def test_sampling_stride_deterministic(self):
+        taken = sum(numcheck.take_sample(0.25) for _ in range(1000))
+        assert taken == 250
+        assert sum(numcheck.take_sample(0.0) for _ in range(10)) == 0
+
+
+class TestNumcheckEngine:
+    """Live-pipeline legs: the honest engine runs clean under the
+    sanitizer; a broken certification bound is caught."""
+
+    def _run(self, monkeypatch):
+        # the host-prop schema + person corpus is the proven
+        # certified>0 fixture (test_dd's on/off differential)
+        from test_dd import _records_with_person, hostprop_schema
+        from test_finalize import run_device
+
+        monkeypatch.setenv("DUKE_DEVICE_FINALIZE", "1")
+        monkeypatch.setenv("DUKE_NUMCHECK", "1")
+        monkeypatch.delenv("DUKE_NUMCHECK_SAMPLE", raising=False)
+        schema = hostprop_schema()
+        log, proc = run_device(schema, [_records_with_person(40, seed=13)])
+        assert proc.stats.pairs_device_certified > 0
+        return log
+
+    def test_honest_pipeline_clean_and_observed(self, monkeypatch):
+        self._run(monkeypatch)
+        rep = numcheck.report()
+        assert numcheck.violations() == [], numcheck.violations()
+        # certified verdicts existed and were shadow-checked
+        assert rep["checked"] > 0
+
+    def test_broken_reject_bound_injection_caught(self, monkeypatch):
+        """Disagreement injection: force every survivor to 'certify' as
+        a reject — the shadow oracle must catch real events being
+        certified away (this is the sanitizer's reason to exist: a
+        margin-calculus bug ships silently without it)."""
+        from sesam_duke_microservice_tpu.ops import scoring as S
+
+        monkeypatch.setattr(S, "dd_reject_bound",
+                            lambda schema, plan: 1e9)
+        self._run(monkeypatch)
+        v = numcheck.violations()
+        assert v and any("CERTIFIED-REJECT" in line for line in v)
+
+
+# -- DK401 pallas roots (ISSUE 13 satellite) ----------------------------------
+
+
+def test_pallas_kernel_closures_are_jit_roots(tmp_path):
+    """The name-bound ``kernel = functools.partial(_kernel, ...)`` idiom
+    every real pl.pallas_call site uses must resolve to the kernel def —
+    an impure call inside the kernel body is DK401."""
+    from scripts.dukecheck import jitpurity
+
+    mod = mk_module(tmp_path, "sesam_duke_microservice_tpu/ops/pk.py", (
+        "import functools, time\n"
+        "import jax.experimental.pallas as pl\n"
+        "def _tile_kernel(x_ref, o_ref, *, L):\n"
+        "    o_ref[...] = x_ref[...] * time.time()\n"
+        "def run(x, L):\n"
+        "    kernel = functools.partial(_tile_kernel, L=L)\n"
+        "    return pl.pallas_call(kernel, out_shape=x)(x)\n"
+    ))
+    found = jitpurity.check([mod])
+    assert any(f.code == "DK401" and "time" in f.message for f in found)
+
+
+def test_real_pallas_kernels_are_scanned():
+    from scripts.dukecheck import jitpurity
+
+    mods = dk_core.load_modules(REPO_ROOT)
+    pk = next(m for m in mods if m.rel.endswith("ops/pallas_kernels.py"))
+    roots = jitpurity._jit_roots(pk)
+    assert "_myers_tile_kernel" in roots  # not just the bare local name
+
+
+# -- suite-level wiring -------------------------------------------------------
+
+
+def test_only_filter_scopes_checkers():
+    assert "numerics" in CHECKER_NAMES and "hlocheck" in CHECKER_NAMES
+    found = collect_findings(REPO_ROOT, only=("numerics",))
+    assert [f for f in found if not f.code.startswith("DK6")] == []
+
+
+def test_repo_numerics_and_budgets_clean():
+    """The ISSUE 13 acceptance criterion: the numerics + ledger gates
+    pass on the live tree with an EMPTY baseline."""
+    found = collect_findings(REPO_ROOT, only=("numerics", "budgets"))
+    assert found == [], [f.render() for f in found]
+
+
+def test_hlocheck_never_baselinable(tmp_path, capsys):
+    """A DK7xx baseline entry is rejected outright."""
+    import shutil
+
+    from scripts.dukecheck import run as dk_run
+
+    root = tmp_path / "repo"
+    (root / "scripts").mkdir(parents=True)
+    shutil.copytree(REPO_ROOT / "scripts" / "dukecheck",
+                    root / "scripts" / "dukecheck")
+    (root / "sesam_duke_microservice_tpu").mkdir()
+    (root / "sesam_duke_microservice_tpu" / "__init__.py").write_text("")
+    (root / "scripts" / "dukecheck" / "baseline.txt").write_text(
+        "DK701 scripts/dukecheck/hlocheck.py :: commit-loss:x:default"
+        "  # nope\n")
+    rc = dk_run(root, only=("env-knob",))
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "NEVER" in out and "baselinable" in out
