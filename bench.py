@@ -1207,6 +1207,123 @@ def concurrent_bench() -> dict:
     return out
 
 
+FED_BENCH = os.environ.get("BENCH_FED", "1") != "0"
+FED_RECORDS = int(os.environ.get("BENCH_FED_RECORDS", "1536"))
+FED_BATCH = int(os.environ.get("BENCH_FED_BATCH", "128"))
+FED_GROUPS = int(os.environ.get("BENCH_FED_GROUPS", "3"))
+
+FED_XML = """
+<DukeMicroService dataFolder="{folder}">
+  <Deduplication name="bench">
+    <duke>
+      <schema>
+        <threshold>0.8</threshold>
+        <property><name>NAME</name><comparator>levenshtein</comparator><low>0.1</low><high>0.95</high></property>
+        <property><name>EMAIL</name><comparator>exact</comparator><low>0.2</low><high>0.95</high></property>
+      </schema>
+      <data-source class="io.sesam.dukemicroservice.IncrementalDeduplicationDataSource">
+        <param name="dataset-id" value="crm"/>
+        <column name="name" property="NAME"/>
+        <column name="email" property="EMAIL"/>
+      </data-source>
+    </duke>
+  </Deduplication>
+</DukeMicroService>
+"""
+
+
+def federation_bench() -> dict:
+    """Federation tier (ISSUE 14): scatter-ingest throughput over N
+    groups vs one group, merged-feed drain rate, and a timed live range
+    migration with the bit-identity check the chaos differential pins.
+
+    Host-backend groups: the section measures the ROUTER tier (routing,
+    scatter fan-out, feed merge, migration machinery), not device
+    scoring — the corpus is duplicate-heavy so the link feed is
+    non-trivial."""
+    import tempfile
+
+    from sesam_duke_microservice_tpu.core.config import parse_config
+    from sesam_duke_microservice_tpu.federation import Federation
+    from sesam_duke_microservice_tpu.federation.ranges import route_key
+
+    def entities(n):
+        return [{"_id": str(i), "name": f"person number {i % 64}",
+                 "email": f"p{i % 64}@x.no"} for i in range(n)]
+
+    batches = [entities(FED_RECORDS)[i:i + FED_BATCH]
+               for i in range(0, FED_RECORDS, FED_BATCH)]
+
+    def run_arm(n_groups: int):
+        tmp = tempfile.mkdtemp(prefix="fed-bench-")
+        sc = parse_config(FED_XML.format(folder=tmp),
+                          env={"MIN_RELEVANCE": "0.05"})
+        fed = Federation(sc, n_groups=n_groups)
+        t0 = time.monotonic()
+        for batch in batches:
+            fed.router.submit("deduplication", "bench", "crm", batch)
+        ingest_s = time.monotonic() - t0
+        t0 = time.monotonic()
+        rows, token = [], ""
+        while True:
+            page = fed.router.feed_page("deduplication", "bench", token,
+                                        5000)
+            rows.extend(page["rows"])
+            token = page["next_since"]
+            if page["drained"]:
+                break
+        feed_s = time.monotonic() - t0
+        return fed, ingest_s, feed_s, rows
+
+    one, one_ingest, one_feed, one_rows = run_arm(1)
+    one.close()
+    fed, n_ingest, n_feed, n_rows = run_arm(FED_GROUPS)
+
+    def normed(rows):
+        return sorted(
+            json.dumps({k: v for k, v in r.items() if k != "_updated"},
+                       sort_keys=True) for r in rows)
+
+    # timed live migration of one range, with the differential check
+    moved = next(r for r in fed.map.ranges() if r.group == 0)
+    pre = normed(n_rows)
+    t0 = time.monotonic()
+    stats = fed.migrate_range(moved.range_id, 1 % FED_GROUPS)
+    migrate_s = time.monotonic() - t0
+    rows2, token = [], ""
+    while True:
+        page = fed.router.feed_page("deduplication", "bench", token, 5000)
+        rows2.extend(page["rows"])
+        token = page["next_since"]
+        if page["drained"]:
+            break
+    fed.close()
+    return {
+        "metric": "federation_scatter_gather",
+        "records": FED_RECORDS,
+        "groups": FED_GROUPS,
+        "single_group": {
+            "ingest_records_per_sec": round(FED_RECORDS / one_ingest, 1),
+            "feed_rows_per_sec": round(len(one_rows) / max(one_feed, 1e-9),
+                                       1),
+        },
+        "federated": {
+            "ingest_records_per_sec": round(FED_RECORDS / n_ingest, 1),
+            "feed_rows_per_sec": round(len(n_rows) / max(n_feed, 1e-9), 1),
+        },
+        # >1 = the federation ingests faster than one group (groups
+        # score their smaller shards concurrently); <1 = router overhead
+        # dominates at this corpus size
+        "federated_ingest_speedup": round(one_ingest / n_ingest, 2),
+        "migration": {
+            "seconds": round(migrate_s, 3),
+            "moved_records": stats["moved_records"],
+            "moved_links": stats["moved_links"],
+            "feed_bit_identical_across_migration": normed(rows2) == pre,
+        },
+    }
+
+
 def main():
     schema = bench_schema()
     corpus = stresstest_records(CORPUS, seed=1234)
@@ -1239,6 +1356,8 @@ def main():
         result["ivf"] = ivf_bench(schema)
     if DURABILITY and BACKEND == "device":
         result["durability"] = durability_bench(schema)
+    if FED_BENCH and BACKEND == "device":
+        result["federation"] = federation_bench()
     print(json.dumps(result))
     print(
         f"# cpu_baseline={cpu_rate:.0f} pairs/s, device median-of-{len(rates)}"

@@ -96,7 +96,10 @@ def create_link_database(link_database_type: str, data_folder=None,
             return WriteBehindLinkDatabase(db)
         journal = LinkJournal(journal_path)
         wrapped = WriteBehindLinkDatabase(db, journal=journal)
-        with journal_mod.recovery_in_progress():
+        # recovery scoped to this workload's data folder: with N serving
+        # groups in one process (federation), one group's replay flips
+        # only readiness probes watching ITS folder to "recovering"
+        with journal_mod.recovery_in_progress(data_folder):
             wrapped.recover()
         return wrapped
     raise ValueError(f"Got an unknown 'link-database-type' value: '{link_database_type}'")

@@ -82,32 +82,114 @@ def sync_policy() -> str:
 
 
 # -- recovery visibility (consumed by /readyz) --------------------------------
+#
+# Scoped per journal owner (the workload's data folder) rather than one
+# process-global counter: a federation harness runs N serving groups in
+# one process, and one group's startup replay must flip only ITS OWN
+# group's /readyz to "recovering" — not every group's (ISSUE 14
+# satellite).  The anonymous scope ("") is process-wide: it matches
+# every query, preserving the legacy no-argument behavior for callers
+# that have no scope to name.
 
 _RECOVERY_LOCK = threading.Lock()
-_recovering = 0  # guarded by: _RECOVERY_LOCK [writes]
+_recovering: dict = {}  # scope -> entry depth; guarded by: _RECOVERY_LOCK [writes]
 
 
 @contextlib.contextmanager
-def recovery_in_progress():
-    """Marks startup journal replay as active; ``/readyz`` reports
-    ``recovering`` (503) until every entered context exits."""
-    global _recovering
+def recovery_in_progress(scope: str = ""):
+    """Marks startup journal replay as active for ``scope`` (the owning
+    workload's data folder; "" = process-wide); ``/readyz`` reports
+    ``recovering`` (503) until every entered context for a scope it
+    watches exits."""
     with _RECOVERY_LOCK:
-        _recovering += 1
+        _recovering[scope] = _recovering.get(scope, 0) + 1
     try:
         yield
     finally:
         with _RECOVERY_LOCK:
-            _recovering -= 1
+            depth = _recovering.get(scope, 0) - 1
+            if depth <= 0:
+                _recovering.pop(scope, None)
+            else:
+                _recovering[scope] = depth
 
 
-def recovery_active() -> bool:
-    return _recovering > 0
+def recovery_active(scope: Optional[str] = None) -> bool:
+    """Whether a journal replay is running — for ``scope`` (plus the
+    anonymous process-wide scope), or anywhere when ``scope`` is None.
+    Lock-free read: membership checks on the dict are GIL-atomic and the
+    probe path (/readyz) must never contend with a replay."""
+    active = _recovering
+    if scope is None:
+        return bool(active)
+    return scope in active or "" in active
 
 
 def _frame(kind: bytes, seq: int, payload: bytes) -> bytes:
     prefix = _PREFIX.pack(kind, seq, len(payload))
     return prefix + _CRC.pack(zlib.crc32(prefix + payload)) + payload
+
+
+# streaming read granularity: one pread per chunk, carry buffer compacts
+# back to at most one in-progress frame + a chunk
+_READ_CHUNK = 1 << 20
+
+
+class _TornTail(Exception):
+    """Internal: frame walk hit a torn/corrupt tail.  ``good`` is the
+    byte offset of the last intact frame boundary."""
+
+    def __init__(self, reason: str, good: int):
+        super().__init__(reason)
+        self.reason = reason
+        self.good = good
+
+
+def _iter_frames(fd: int, end: int):
+    """Yield ``(kind, seq, payload, end_offset)`` for every intact frame
+    in ``fd[0:end]``, streaming in bounded chunks — O(n) in file bytes
+    with memory bounded by one frame + one read chunk, never the whole
+    file (the old scan's ``buf += chunk`` whole-file accumulation was
+    quadratic in the worst case and unbounded always).  Raises
+    ``_TornTail`` at the first incomplete or CRC-failing frame; a clean
+    EOF just stops."""
+    buf = bytearray()
+    base = 0  # file offset of buf[0]
+    pos = 0   # parse cursor, relative to buf
+    read_off = 0  # next file offset to pread
+
+    def _fill(need: int) -> bool:
+        # ensure buf holds >= need bytes past pos (or EOF); True if it does
+        nonlocal read_off
+        while len(buf) - pos < need and read_off < end:
+            chunk = os.pread(fd, min(_READ_CHUNK, end - read_off), read_off)
+            if not chunk:
+                break  # file shorter than fstat said (concurrent truncate)
+            buf.extend(chunk)
+            read_off += len(chunk)
+        return len(buf) - pos >= need
+
+    while base + pos < end:
+        # compact the consumed prefix so the carry buffer stays bounded
+        if pos >= _READ_CHUNK:
+            del buf[:pos]
+            base += pos
+            pos = 0
+        good = base + pos
+        if not _fill(_HDR_BYTES):
+            raise _TornTail("incomplete frame header", good)
+        kind, seq, length = _PREFIX.unpack_from(buf, pos)
+        (crc,) = _CRC.unpack_from(buf, pos + _PREFIX.size)
+        if kind not in (_KIND_BATCH, _KIND_APPLIED) or length > _MAX_FRAME_BYTES:
+            raise _TornTail(
+                f"corrupt frame header (kind={kind!r}, len={length})", good)
+        if not _fill(_HDR_BYTES + length):
+            raise _TornTail("incomplete frame payload", good)
+        payload = bytes(buf[pos + _HDR_BYTES:pos + _HDR_BYTES + length])
+        if zlib.crc32(bytes(buf[pos:pos + _PREFIX.size]) + payload) != crc:
+            raise _TornTail("frame CRC mismatch", good)
+        pos += _HDR_BYTES + length
+        yield kind, seq, payload, base + pos
 
 
 def _write_all(fd: int, data: bytes) -> None:
@@ -150,70 +232,57 @@ class LinkJournal:
         # lock-free scrape mirrors (plain ints; exact under self._lock)
         self.pending_batches = 0  # guarded by: self._lock [writes]
         self.size_bytes = 0  # guarded by: self._lock [writes]
+        # compaction pins (retained()): >0 while a migration slice walks
+        # the file, so mark_applied/compact cannot truncate mid-walk
+        self._pins = 0  # guarded by: self._lock [writes]
         self._scan()
 
     # -- startup scan ---------------------------------------------------------
 
     def _scan(self) -> None:
-        """Parse every frame; truncate a torn/corrupt tail (counted,
-        logged, never fatal) and collect unapplied batches for replay."""
+        """Parse every frame via the streaming iterator (O(n) bytes,
+        memory bounded by the UNAPPLIED batches — applied batches are
+        pruned as their watermark frames stream past, so a large mostly-
+        applied journal never materializes in RAM); truncate a torn/
+        corrupt tail (counted, logged, never fatal) and collect unapplied
+        batches for replay."""
+        from collections import deque
+
         size = os.fstat(self._fd).st_size
-        buf = b""
-        off = 0
-        while off < size:
-            chunk = os.pread(self._fd, min(1 << 20, size - off), off)
-            if not chunk:
-                break
-            buf += chunk
-            off += len(chunk)
         good = 0
-        pos = 0
-        batches: List[Tuple[int, List]] = []
+        pending: deque = deque()  # (seq, rows), insertion = seq order
         applied = 0
         last = 0
         torn = None
-        while pos < len(buf):
-            if pos + _HDR_BYTES > len(buf):
-                torn = "incomplete frame header"
-                break
-            kind, seq, length = _PREFIX.unpack_from(buf, pos)
-            (crc,) = _CRC.unpack_from(buf, pos + _PREFIX.size)
-            if kind not in (_KIND_BATCH, _KIND_APPLIED) \
-                    or length > _MAX_FRAME_BYTES:
-                torn = f"corrupt frame header (kind={kind!r}, len={length})"
-                break
-            end = pos + _HDR_BYTES + length
-            if end > len(buf):
-                torn = "incomplete frame payload"
-                break
-            payload = buf[pos + _HDR_BYTES:end]
-            if zlib.crc32(buf[pos:pos + _PREFIX.size] + payload) != crc:
-                torn = "frame CRC mismatch"
-                break
-            if kind == _KIND_BATCH:
-                try:
-                    rows = json.loads(payload.decode("utf-8"))
-                except ValueError:
-                    torn = "undecodable batch payload"
-                    break
-                batches.append((seq, rows))
-                last = max(last, seq)
-            else:
-                applied = max(applied, seq)
-            good = end
-            pos = end
+        try:
+            for kind, seq, payload, end in _iter_frames(self._fd, size):
+                if kind == _KIND_BATCH:
+                    try:
+                        rows = json.loads(payload.decode("utf-8"))
+                    except ValueError:
+                        torn = "undecodable batch payload"
+                        break
+                    pending.append((seq, rows))
+                    last = max(last, seq)
+                else:
+                    applied = max(applied, seq)
+                    while pending and pending[0][0] <= applied:
+                        pending.popleft()
+                good = end
+        except _TornTail as tear:
+            torn, good = tear.reason, tear.good
         if torn is not None:
             telemetry.JOURNAL_TORN_TAILS.inc()  # dukecheck: ignore[DK502] startup scan only, never per-batch
             logger.warning(
                 "truncating torn journal tail in %s at byte %d (%s; %d "
                 "byte(s) dropped) — everything before the tear is intact",
-                self.path, good, torn, len(buf) - good,
+                self.path, good, torn, size - good,
             )
             os.ftruncate(self._fd, good)
         with self._lock:
             self._last_seq = max(last, applied)
             self._applied_seq = applied
-            self._unapplied = [(s, rows) for s, rows in batches
+            self._unapplied = [(s, rows) for s, rows in pending
                                if s > applied]
             self.pending_batches = len(self._unapplied)
             self.size_bytes = good
@@ -225,6 +294,57 @@ class LinkJournal:
         with self._lock:
             out, self._unapplied = self._unapplied, []
         return out
+
+    # -- range-migration slice (ISSUE 14) -------------------------------------
+
+    def head_seq(self) -> int:
+        with self._lock:
+            return self._last_seq
+
+    def applied_watermark(self) -> int:
+        with self._lock:
+            return self._applied_seq
+
+    @contextlib.contextmanager
+    def retained(self):
+        """Pin the journal against compaction for the duration — a live
+        range migration streams ``batches_after`` from the file, and a
+        concurrent flusher catching up to the head must not truncate the
+        frames out from under the walk.  Reentrant (pin counted)."""
+        with self._lock:
+            self._pins += 1
+        try:
+            yield self
+        finally:
+            with self._lock:
+                self._pins -= 1
+
+    def batches_after(self, after_seq: int):
+        """Stream ``(seq, encoded rows)`` for every journaled batch frame
+        with seq > ``after_seq``, in append order — the range migration's
+        replay-slice primitive (the caller filters rows to the moving
+        digest range and applies them through the target's idempotent
+        ``assert_links``).  Lock-free walk of the stable append-only
+        prefix (same discipline as the pre-publication startup scan);
+        call under ``retained()`` so compaction cannot truncate the
+        frames mid-walk.  A torn tail ends the slice silently — frames
+        past a tear are untrusted by construction and the startup scan
+        owns counting/truncating them."""
+        fd = self._fd
+        if fd < 0:
+            return
+        size = os.fstat(fd).st_size
+        try:
+            for kind, seq, payload, _end in _iter_frames(fd, size):
+                if kind != _KIND_BATCH or seq <= after_seq:
+                    continue
+                try:
+                    rows = json.loads(payload.decode("utf-8"))
+                except ValueError:
+                    return
+                yield seq, rows
+        except _TornTail:
+            return
 
     # -- append path (ingest thread) ------------------------------------------
 
@@ -275,6 +395,8 @@ class LinkJournal:
 
     def _compact_locked(self) -> None:
         # dukecheck: holds self._lock
+        if self._pins > 0:
+            return  # a migration slice is walking the file; keep frames
         os.ftruncate(self._fd, 0)
         self.size_bytes = 0
         self.pending_batches = 0

@@ -57,6 +57,23 @@ def decode_link(row: Sequence) -> Link:
                 timestamp=timestamp)
 
 
+def rows_checksum(rows: Sequence[Sequence]) -> int:
+    """CRC32 chained over the canonical JSON of encoded link rows — the
+    integrity stamp on shipped link state (the range-migration snapshot,
+    ISSUE 14; same stance as the corpus snapshot's ``__checksum``: the
+    transport may be fine while the payload is not).  Order-sensitive by
+    design: the rows travel in arrival order and must land that way."""
+    import json
+    import zlib
+
+    crc = 0
+    for row in rows:
+        crc = zlib.crc32(
+            json.dumps(list(row), separators=(",", ":"),
+                       ensure_ascii=True).encode("utf-8"), crc)
+    return crc
+
+
 class ReplicaGap(RuntimeError):
     """The replica missed at least one published batch: its feed would
     silently serve a hole, so it must resync (re-bootstrap) instead."""

@@ -23,6 +23,12 @@ def main() -> None:
                         default=env_str("DUKE_TPU_BACKEND", "host"))
     parser.add_argument("--ephemeral", action="store_true",
                         help="keep all state in memory (no data folder writes)")
+    parser.add_argument("--federation", type=int,
+                        default=env_int("DUKE_FED_GROUPS", 0), metavar="N",
+                        help="serve a digest-range partition federation of "
+                             "N serving groups (ISSUE 14) instead of one "
+                             "group — scatter-gather ingest/feeds, live "
+                             "range migration via POST /federation/migrate")
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO)
@@ -38,6 +44,43 @@ def main() -> None:
         enable_persistent_cache()
 
     log = logging.getLogger("duke-tpu-service")
+
+    if args.federation >= 1:
+        # federation tier (ISSUE 14): N independent serving groups in
+        # this process behind the digest-range partition router.  N=1 is
+        # a legitimate degenerate federation (one group, federated data
+        # layout and /federation/* surface) — silently falling back to
+        # the standalone service would read a DIFFERENT data layout and
+        # hide existing federated state behind a 200.  (The
+        # production shape — each group its own HA serving group on its
+        # own hosts — slots an RPC client into the LocalGroup seam;
+        # this entrypoint is the single-box topology.)
+        import signal
+        import threading
+
+        from ..core.config import load_default_config
+        from ..federation import Federation
+        from .federation_plane import serve_federation
+
+        fed = Federation(load_default_config(),
+                         n_groups=args.federation, backend=args.backend)
+        server = serve_federation(fed, port=args.port, host=args.host)
+        log.info("Federation of %d groups serving on %s:%d (backend=%s)",
+                 args.federation, args.host,
+                 server.server_address[1], args.backend)
+        stop = threading.Event()
+
+        def _stop(signum, frame):
+            log.info("signal %d: federation shutdown", signum)
+            stop.set()
+
+        signal.signal(signal.SIGTERM, _stop)
+        signal.signal(signal.SIGINT, _stop)
+        stop.wait()
+        server.shutdown()
+        fed.close()
+        log.info("shutdown complete")
+        return
 
     # multi-host serving (SURVEY.md section 5.8): join the jax.distributed
     # job first; process 0 becomes the HTTP frontend + op dispatcher,

@@ -248,7 +248,24 @@ class DukeApp:
         from ..links import journal as link_journal
 
         checks = {"config_loaded": self.config is not None}
-        checks["recovery_complete"] = not link_journal.recovery_active()
+        # recovery is scoped per workload data folder (ISSUE 14): this
+        # app goes "recovering" only for replays of ITS OWN workloads'
+        # journals (plus anonymous process-wide entries) — another
+        # serving group's replay in the same process no longer flips
+        # every group's /readyz
+        if self.config is not None:
+            folders = [
+                wc.data_folder
+                for wc in (list(self.config.deduplications.values())
+                           + list(self.config.record_linkages.values()))
+                if wc.data_folder
+            ]
+            recovering = (any(link_journal.recovery_active(f)
+                              for f in folders)
+                          if folders else link_journal.recovery_active(""))
+        else:
+            recovering = link_journal.recovery_active()
+        checks["recovery_complete"] = not recovering
         checks["workloads_built"] = bool(
             self.config is not None
             and set(self.deduplications) == set(self.config.deduplications)

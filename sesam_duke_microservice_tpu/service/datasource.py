@@ -49,6 +49,20 @@ class IncrementalDataSource:
         self.dataset_id = config.dataset_id
         self.group_no = config.group_no
 
+    def record_id_for_entity(self, entity: dict) -> str:
+        """The store record id this datasource will synthesize for
+        ``entity`` (``[groupNo__]datasetId__entityId``) — THE one copy of
+        the id rule, shared with ``record_for_entity`` so the federation
+        router's digest-range routing key (federation.ranges.route_key
+        over this id) can never drift from the id the ingest path
+        actually stores."""
+        entity_id = _json_value_to_string(entity.get("_id"))
+        if not entity_id:
+            raise IngestError("Got an entity with no '_id' attribute!")
+        if self.group_no is not None:
+            return f"{self.group_no}__{self.dataset_id}__{entity_id}"
+        return f"{self.dataset_id}__{entity_id}"
+
     def record_for_entity(self, entity: dict) -> Record:
         entity_id = _json_value_to_string(entity.get("_id"))
         if not entity_id:
@@ -70,9 +84,7 @@ class IncrementalDataSource:
 
         if self.group_no is not None:
             record.add_value(GROUP_NO_PROPERTY_NAME, str(self.group_no))
-            record_id = f"{self.group_no}__{self.dataset_id}__{entity_id}"
-        else:
-            record_id = f"{self.dataset_id}__{entity_id}"
+        record_id = self.record_id_for_entity(entity)
 
         record.add_value(ID_PROPERTY_NAME, record_id)
         record.add_value(ORIGINAL_ENTITY_ID_PROPERTY_NAME, entity_id)

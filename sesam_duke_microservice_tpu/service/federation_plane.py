@@ -1,0 +1,417 @@
+"""Federation HTTP frontend (ISSUE 14 tentpole).
+
+The single-group service (``service/app.py``) serves one workload stack;
+this plane serves a ``federation.Federation`` — N serving groups behind
+the digest-range partition router:
+
+  * ``POST /{kind}/{name}/{datasetId}`` — federated ingest: the batch
+    partitions by owner group and fans out.  Frozen ranges (a live
+    migration) answer 429 + Retry-After for the whole batch; a scatter
+    partial failure answers 503 with the degraded-range list in the
+    error body and Retry-After = the max across contacted groups
+    (backpressure propagates through the router).
+  * ``GET /{kind}/{name}?since=<token>`` — the merged federated feed.
+    ``since`` is the OPAQUE composite cursor (``federation.ranges``
+    encode/decode; a bare integer is accepted as the legacy pre-
+    federation cursor).  One bounded page per request: the next token
+    rides ``X-Fed-Next-Since`` and ``X-Fed-Drained: true`` marks the
+    end of the backlog — clients poll, they do not stream.  With a dead
+    group, live ranges' rows still flow; the dead ranges are listed in
+    ``X-Fed-Degraded-Ranges`` with a Retry-After hint, and their
+    cursors in the returned token are untouched, so the client resumes
+    them loss-free once the group returns.
+  * ``POST /federation/migrate`` (``{"range": id, "target": group}``) —
+    live range rebalancing (federation/migrate.py); ``GET
+    /federation/map`` and ``GET /federation/migration`` expose the
+    partition map and migration status.
+  * ``/healthz`` / ``/readyz`` / ``/stats`` / ``/metrics`` — health with
+    per-group detail; ``/readyz`` answers ``recovering`` while ANY
+    group's journal replay runs (scoped: other processes' groups do not
+    leak in) and ``degraded`` when a group is down.
+
+New ``duke_fed_*`` metric families (scrape-time snapshots — the router
+hot path writes plain counters under its own lock, never a registry
+child): ``duke_fed_groups``, ``duke_fed_group_up``,
+``duke_fed_group_seconds_since_contact``, ``duke_fed_degraded_ranges``,
+``duke_fed_migration_phase``, ``duke_fed_migrations_total``,
+``duke_fed_requests_total``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from .. import telemetry
+from ..federation import Federation
+from ..federation.migrate import PHASE_CODES
+from ..federation.ranges import BadCursor, StaleRouterEpoch
+from ..federation.router import (
+    FrozenRange,
+    PartialIngestFailure,
+    UnknownFederatedWorkload,
+)
+from ..telemetry import FamilySnapshot, MetricRegistry
+from .app import _ENTITY_PATH, _FEED_PATH, _feed_page_size, _kind_label
+
+logger = logging.getLogger("federation-plane")
+
+
+def make_federation_collector(fed: Federation):
+    """Scrape-time ``duke_fed_*`` families off the router's and
+    migrator's plain single-writer counters."""
+
+    def collect():
+        router = fed.router
+        health = router.group_health()
+        degraded = router.degraded_range_ids()
+        now = time.monotonic()
+        up_samples = []
+        contact_samples = []
+        for row in health:
+            labels = (("group", str(row["group"])),)
+            up_samples.append(("", labels, 1.0 if row["up"] else 0.0))
+            last = router.last_contact(row["group"])
+            contact_samples.append(
+                ("", labels, round(now - last, 3) if last else -1.0))
+        outcomes = router.outcomes_snapshot()
+        return [
+            FamilySnapshot(
+                "duke_fed_groups", "gauge",
+                "Serving groups in the federation",
+                [("", (), float(len(fed.groups)))]),
+            FamilySnapshot(
+                "duke_fed_group_up", "gauge",
+                "1 while the group's last scatter contact succeeded "
+                "(0 = its ranges are degraded)", up_samples),
+            FamilySnapshot(
+                "duke_fed_group_seconds_since_contact", "gauge",
+                "Seconds since the router last reached the group "
+                "(-1 = never contacted): replication-style lag for the "
+                "scatter plane", contact_samples),
+            FamilySnapshot(
+                "duke_fed_degraded_ranges", "gauge",
+                "Digest ranges currently owned by an unreachable group "
+                "(their queries 503 with Retry-After; the rest serve)",
+                [("", (), float(len(degraded)))]),
+            FamilySnapshot(
+                "duke_fed_migration_phase", "gauge",
+                "Live range-migration phase (0 idle, 1 frozen, 2 "
+                "copied, 3 cutover, 4 drain)",
+                [("", (), float(fed.migrator.phase_code()))]),
+            FamilySnapshot(
+                "duke_fed_migrations_total", "counter",
+                "Range migrations by outcome (completed, resumed after "
+                "a crash, failed)",
+                [("", (("outcome", k),), float(v))
+                 for k, v in sorted(fed.migrator.outcomes.items())]),
+            FamilySnapshot(
+                "duke_fed_requests_total", "counter",
+                "Federated router requests by outcome (ok, degraded = "
+                "scatter partial failure, frozen = 429 on a migrating "
+                "range)",
+                [("", (("outcome", k),), float(v))
+                 for k, v in sorted(outcomes.items())]),
+        ]
+
+    return collect
+
+
+class FederationHandler(BaseHTTPRequestHandler):
+    fed: Federation = None  # set by serve_federation()
+    registry: MetricRegistry = None
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        logger.info("%s %s", self.address_string(), fmt % args)
+
+    # -- plumbing -------------------------------------------------------------
+
+    def _reply(self, status: int, body: bytes,
+               content_type: str = "application/json",
+               extra_headers: Optional[dict] = None) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            logger.info("Ignoring client disconnect on %s", self.path)
+
+    def _reply_json(self, status: int, obj, extra_headers=None) -> None:
+        self._reply(status, json.dumps(obj).encode("utf-8"),
+                    extra_headers=extra_headers)
+
+    def _read_body(self) -> bytes:
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            length = 0
+        return self.rfile.read(length) if length > 0 else b""
+
+    # -- routing --------------------------------------------------------------
+
+    def do_GET(self):
+        try:
+            self._route_get(urlparse(self.path))
+        except Exception:
+            logger.exception("federation plane: error serving %s", self.path)
+            self._reply(500, b"Internal server error", "text/plain")
+
+    def do_POST(self):
+        body = self._read_body()
+        try:
+            self._route_post(urlparse(self.path), body)
+        except Exception:
+            logger.exception("federation plane: error serving %s", self.path)
+            self._reply(500, b"Internal server error", "text/plain")
+
+    def _route_get(self, parsed) -> None:
+        path = parsed.path
+        if path in ("/health", "/healthz"):
+            self._handle_healthz()
+        elif path == "/readyz":
+            self._handle_readyz()
+        elif path == "/stats":
+            self._handle_stats()
+        elif path == "/metrics":
+            body = telemetry.render(self.registry,
+                                    telemetry.GLOBAL).encode("utf-8")
+            self._reply(200, body, telemetry.CONTENT_TYPE)
+        elif path == "/federation/map":
+            self._reply_json(200, self.fed.map.to_json())
+        elif path == "/federation/migration":
+            self._reply_json(200, self.fed.migration_status())
+        elif m := _FEED_PATH.match(path):
+            self._handle_feed(m, parse_qs(parsed.query))
+        else:
+            self._reply(404, b"Not found", "text/plain")
+
+    def _route_post(self, parsed, body: bytes) -> None:
+        path = parsed.path
+        if path == "/federation/migrate":
+            self._handle_migrate(body)
+        elif m := _ENTITY_PATH.match(path):
+            self._handle_ingest(m, body)
+        else:
+            self._reply(404, b"Not found", "text/plain")
+
+    # -- health ---------------------------------------------------------------
+
+    def _recovering_scopes(self):
+        from ..links import journal as link_journal
+
+        return [f for f in self.fed.group_folders()
+                if link_journal.recovery_active(f)]
+
+    def _handle_healthz(self) -> None:
+        degraded = self.fed.router.degraded_range_ids()
+        self._reply_json(200, {
+            "status": "ok" if not degraded else "degraded",
+            "role": "federation-router",
+            "groups": len(self.fed.groups),
+            "epoch": self.fed.map.epoch,
+            "degraded_ranges": degraded,
+        })
+
+    def _handle_readyz(self) -> None:
+        recovering = self._recovering_scopes()
+        degraded = self.fed.router.degraded_range_ids()
+        checks = {
+            "recovery_complete": not recovering,
+            "groups_reachable": not degraded,
+            "migration_idle": not self.fed.migration_status()["active"],
+        }
+        if recovering:
+            status = "recovering"
+        elif degraded:
+            status = "degraded"
+        elif not checks["migration_idle"]:
+            # still 200: the federation serves during a migration (only
+            # the moving range's writes 429) — the status string is the
+            # operator signal, not a readiness failure
+            status = "migrating"
+        else:
+            status = "ready"
+        ready = checks["recovery_complete"] and checks["groups_reachable"]
+        self._reply_json(200 if ready else 503, {
+            "status": status,
+            "checks": checks,
+            "recovering_scopes": recovering,
+            "degraded_ranges": degraded,
+        })
+
+    def _handle_stats(self) -> None:
+        fed = self.fed
+        groups = []
+        for g, health in zip(fed.groups, fed.router.group_health()):
+            row = dict(health)
+            row["workloads"] = []
+            for (kind, name), wl in g.workloads.items():
+                live = getattr(wl.index, "live_records", None)
+                wrow = {
+                    "kind": kind,
+                    "name": name,
+                    "records_indexed": (live if live is not None
+                                        else len(wl.index)),
+                }
+                try:
+                    wrow["links_rows"] = wl.link_database.count()
+                except Exception:
+                    pass
+                row["workloads"].append(wrow)
+            groups.append(row)
+        self._reply_json(200, {
+            "role": "federation-router",
+            "map": fed.map.to_json(),
+            "migration": fed.migration_status(),
+            "requests": fed.router.outcomes_snapshot(),
+            "groups": groups,
+        })
+
+    # -- ingest ---------------------------------------------------------------
+
+    def _handle_ingest(self, m, body: bytes) -> None:
+        kind, name, dataset_id, transform = (
+            m.group(1), m.group(2), m.group(3), bool(m.group(4)))
+        label = _kind_label(kind)
+        if transform:
+            self._reply(400, b"httptransform is not federated; POST it "
+                        b"to a group plane directly", "text/plain")
+            return
+        try:
+            payload = json.loads(body.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            self._reply(400, b"Request body must be a JSON array or "
+                        b"object", "text/plain")
+            return
+        batch = [payload] if isinstance(payload, dict) else payload
+        if (not isinstance(batch, list)
+                or any(not isinstance(e, dict) for e in batch)):
+            self._reply(400, b"Request body must be a JSON array or "
+                        b"object", "text/plain")
+            return
+        from ..service.datasource import IngestError
+
+        try:
+            result = self.fed.router.submit(kind, name, dataset_id, batch)
+        except IngestError as e:
+            # routing needs each entity's record id, so a missing/empty
+            # _id surfaces here rather than inside a group
+            self._reply(400, str(e).encode(), "text/plain")
+        except UnknownFederatedWorkload:
+            self._reply(404, (f"Unknown {label} '{name}' or dataset "
+                              f"'{dataset_id}'!").encode(), "text/plain")
+        except FrozenRange as e:
+            self._reply_json(429, {
+                "error": str(e),
+                "frozen_ranges": e.range_ids,
+                "retry_after": e.retry_after,
+            }, extra_headers={"Retry-After": str(e.retry_after)})
+        except StaleRouterEpoch as e:
+            # refreshed once and still stale: topology is moving faster
+            # than this router; the client retries shortly
+            self._reply_json(503, {"error": str(e)},
+                            extra_headers={"Retry-After": "1"})
+        except PartialIngestFailure as e:
+            self._reply_json(503, {
+                "error": str(e),
+                "degraded_ranges": e.degraded_ranges,
+                "group_errors": e.errors,
+                "retry_after": e.retry_after,
+            }, extra_headers={"Retry-After": str(e.retry_after)})
+        except Exception as e:
+            logger.exception("federated ingest failed")
+            self._reply(500, f"Batch processing failed: {e}".encode(),
+                        "text/plain")
+        else:
+            self._reply_json(200, result)
+
+    # -- federated feed -------------------------------------------------------
+
+    def _handle_feed(self, m, query) -> None:
+        kind, name = m.group(1), m.group(2)
+        label = _kind_label(kind)
+        if not name:
+            self._reply(400, f"The {label}Name cannot be an empty "
+                        f"string!".encode(), "text/plain")
+            return
+        token = (query.get("since") or [""])[0]
+        try:
+            page = self.fed.router.feed_page(kind, name, token,
+                                             _feed_page_size())
+        except BadCursor as e:
+            self._reply(400, f"Invalid since value: {e}".encode(),
+                        "text/plain")
+            return
+        except UnknownFederatedWorkload:
+            self._reply(400, (f"Unknown {label} '{name}'! (All {label}s "
+                              f"must be specified in the "
+                              f"configuration)").encode(), "text/plain")
+            return
+        headers = {
+            "X-Fed-Next-Since": page["next_since"],
+            "X-Fed-Drained": "true" if page["drained"] else "false",
+        }
+        if page["degraded_ranges"]:
+            headers["X-Fed-Degraded-Ranges"] = ",".join(
+                page["degraded_ranges"])
+            headers["Retry-After"] = str(page["retry_after"]
+                                         or 1)
+        body = ("[" + ",\n".join(json.dumps(r) for r in page["rows"])
+                + "]").encode("utf-8")
+        self._reply(200, body, extra_headers=headers)
+
+    # -- admin: migration -----------------------------------------------------
+
+    def _handle_migrate(self, body: bytes) -> None:
+        try:
+            req = json.loads(body.decode("utf-8"))
+            range_id = str(req["range"])
+            target = int(req["target"])
+        except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+            self._reply(400, b'Body must be {"range": "<id>", '
+                        b'"target": <group>}', "text/plain")
+            return
+        try:
+            result = self.fed.migrate_range(range_id, target)
+        except KeyError:
+            self._reply(404, f"Unknown range '{range_id}'".encode(),
+                        "text/plain")
+        except (ValueError, RuntimeError) as e:
+            self._reply(409, str(e).encode(), "text/plain")
+        except Exception as e:
+            logger.exception("migration failed")
+            self._reply(500, f"Migration failed: {e}".encode(),
+                        "text/plain")
+        else:
+            self._reply_json(200, result)
+
+
+def serve_federation(fed: Federation, port: int = 0,
+                     host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    """Bind the federation plane and serve it on a daemon thread;
+    returns the server (caller owns ``shutdown()``)."""
+    registry = MetricRegistry()
+    registry.register_collector(make_federation_collector(fed))
+    handler = type("BoundFederationHandler", (FederationHandler,),
+                   {"fed": fed, "registry": registry})
+    server = ThreadingHTTPServer((host, port), handler)
+    thread = threading.Thread(target=server.serve_forever,
+                              name="federation-plane", daemon=True)
+    thread.start()
+    logger.info("federation plane serving %d group(s) on %s:%d",
+                len(fed.groups), host, server.server_address[1])
+    return server
+
+
+__all__ = ["FederationHandler", "make_federation_collector",
+           "serve_federation", "PHASE_CODES"]

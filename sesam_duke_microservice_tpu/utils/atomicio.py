@@ -1,0 +1,36 @@
+"""Crash-atomic small-file persistence — THE one copy of the dance.
+
+The tmp-write + fsync + ``os.replace`` pattern grew three hand-rolled
+copies (corpus snapshot save, partition map, migration state); the JSON
+flavors now live here so a hardening fix lands once.  Beyond the
+classic sequence this also fsyncs the parent DIRECTORY: ``os.replace``
+is atomic against readers, but the rename itself lives in the directory
+inode — without the directory sync a power cut can resurrect the OLD
+file even though replace() returned.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+
+
+def atomic_write_json(path: str, doc) -> None:
+    """Write ``doc`` as JSON at ``path`` such that every reader (and
+    every restart) sees either the previous complete file or the new
+    complete file — never a torn intermediate."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    # durability of the rename itself (see module docstring); best-effort
+    # where the platform can't open directories
+    with contextlib.suppress(OSError):
+        dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)

@@ -48,7 +48,24 @@ Spec tokens (``p`` in [0,1]; ``@tag`` filters to one dispatch op tag):
                                    ``mid_journal_write`` (writes HALF the
                                    journal frame first — torn-tail
                                    synthesis), ``mid_snapshot_save``
-                                   (tmp written, ``os.replace`` pending)
+                                   (tmp written, ``os.replace`` pending);
+                                   range-migration sites (ISSUE 14,
+                                   federation/migrate.py): ``pre_freeze``
+                                   (state recorded, map not yet frozen),
+                                   ``post_snapshot`` (range snapshot
+                                   written, nothing shipped),
+                                   ``mid_replay`` (snapshot loaded at the
+                                   target, journal slice partially
+                                   replayed), ``pre_cutover`` (target
+                                   complete, map still names the source),
+                                   ``post_cutover`` (map cut over, drain/
+                                   cleanup pending)
+  ``fed_down=<g>``                 federation group ``g`` is unreachable:
+                                   every router call into it raises
+                                   GroupUnavailable — drives the
+                                   scatter-gather degraded-mode contract
+                                   (dead ranges 503 + Retry-After, live
+                                   ranges keep serving)
 
 Every injected fault counts in ``duke_faults_injected_total{kind}``.
 This module is wired into ``parallel/dispatch.py`` (send path + follower
@@ -119,6 +136,8 @@ class FaultPlan:
         self._slow_lock: Optional[Tuple[float, float]] = None
         # crash site name -> 1-based occurrence that kills the process
         self._crash_at: Dict[str, int] = {}
+        # federation groups whose router calls fail (ISSUE 14)
+        self._fed_down: set = set()
         self._flush_lock = threading.Lock()
         self._flush_count = 0  # guarded by: self._flush_lock
         self._lock_count = 0  # guarded by: self._flush_lock
@@ -157,6 +176,8 @@ class FaultPlan:
                     self._slow_lock = (float(parts[0]), float(parts[1]))
                 elif kind == "crash_at":
                     self._crash_at[str(parts[0])] = int(parts[1])
+                elif kind == "fed_down":
+                    self._fed_down.add(int(parts[0]))
                 else:
                     raise ValueError(f"unknown fault kind {kind!r}")
             except (IndexError, ValueError) as e:
@@ -261,6 +282,17 @@ class FaultPlan:
     def check_crash(self, site: str) -> None:
         if self.crash_hit(site):
             self.crash_now(site)
+
+    # -- federation router (ISSUE 14) -----------------------------------------
+
+    def fed_group_down(self, group: int) -> bool:
+        """True iff router calls into federation group ``group`` should
+        fail (spec ``fed_down=<g>``) — the deterministic dead-group fault
+        behind the degraded-mode contract tests."""
+        if group in self._fed_down:
+            _count("fed_down")
+            return True
+        return False
 
     # -- lock paths -----------------------------------------------------------
 

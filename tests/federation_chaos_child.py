@@ -1,0 +1,139 @@
+"""Federation migration kill-differential child (ISSUE 14,
+tests/test_federation_chaos.py).
+
+One federation process the harness can SIGKILL at an exact migration
+site and restart:
+
+  * builds a 2-group federation over ``--data`` (per-group journal
+    recovery, store replay and partition-map load run inside the
+    ``Federation`` constructor exactly as a real start — including the
+    AUTO-RESUME of a migration a crash interrupted);
+  * ingests the deterministic duplicate-heavy corpus through the
+    scatter router, printing ``ACK <i>`` per batch;
+  * with ``--migrate`` moves the first group-0-owned range to group 1
+    (``DUKE_FAULTS=crash_at=<site>:<n>`` in the environment SIGKILLs
+    mid-migration; on the restarted run the constructor finishes the
+    interrupted migration first, and the explicit call then reports
+    ``already_owned``);
+  * ``--dump`` prints ``DUMP <json>``: the federated link rows (each
+    group's link DB filtered by CURRENT range ownership — the same
+    one-place rule the feed merge applies), the drained federated
+    ``?since=`` feed (timestamps dropped: wall clock differs across
+    runs by construction), the moved range's owner, and the migration
+    outcome counters.
+
+The differential: for EVERY kill site, restart + resume must converge
+to link rows and a federated feed bit-identical to an UNMIGRATED
+control — zero lost, zero duplicated links.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_batches(n_batches: int, per_batch: int, identities: int = 4):
+    out = []
+    for b in range(n_batches):
+        rows = []
+        for i in range(per_batch):
+            ident = (b * per_batch + i) % identities
+            name = f"person number {ident}"
+            rows.append({
+                "_id": f"r{b}_{i}",
+                "name": name,
+                "email": f"{name.replace(' ', '.')}@x.no",
+            })
+        out.append(rows)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", required=True)
+    ap.add_argument("--batches", type=int, default=6)
+    ap.add_argument("--per-batch", type=int, default=6)
+    ap.add_argument("--start", type=int, default=0)
+    ap.add_argument("--migrate", action="store_true")
+    ap.add_argument("--dump", action="store_true")
+    args = ap.parse_args()
+
+    from sesam_duke_microservice_tpu.core.config import parse_config
+    from sesam_duke_microservice_tpu.federation import Federation
+    from sesam_duke_microservice_tpu.federation.ranges import route_key
+
+    xml = f"""
+<DukeMicroService dataFolder="{args.data}">
+  <Deduplication name="people">
+    <duke>
+      <schema>
+        <threshold>0.8</threshold>
+        <property><name>NAME</name><comparator>levenshtein</comparator><low>0.1</low><high>0.95</high></property>
+        <property><name>EMAIL</name><comparator>exact</comparator><low>0.2</low><high>0.95</high></property>
+      </schema>
+      <data-source class="io.sesam.dukemicroservice.IncrementalDeduplicationDataSource">
+        <param name="dataset-id" value="crm"/>
+        <column name="name" property="NAME"/>
+        <column name="email" property="EMAIL"/>
+      </data-source>
+    </duke>
+  </Deduplication>
+</DukeMicroService>
+"""
+    sc = parse_config(xml, env={"MIN_RELEVANCE": "0.05"})
+    # the constructor resumes an interrupted migration BEFORE serving
+    fed = Federation(sc, n_groups=2, ranges_per_group=2)
+
+    batches = make_batches(args.batches, args.per_batch)
+    for i in range(args.start, args.batches):
+        fed.router.submit("deduplication", "people", "crm", batches[i])
+        print(f"ACK {i}", flush=True)
+
+    # the moved range: the keyspace's first range, which the pristine
+    # round-robin map assigns to group 0 — deterministic across runs.
+    # After a resumed/completed migration it is already owned by group 1
+    # and migrate() reports already_owned instead of re-moving.
+    moved_id = f"{0:016x}"
+    if args.migrate:
+        result = fed.migrator.migrate(moved_id, 1)
+        print(f"MIGRATED {json.dumps(result)}", flush=True)
+
+    if args.dump:
+        links = []
+        for g in fed.groups:
+            for wl in g.workloads.values():
+                for l in wl.link_database.get_all_links():
+                    if fed.map.owner(route_key(l.id1)).group == g.idx:
+                        links.append([l.id1, l.id2, l.status.value,
+                                      l.kind.value,
+                                      round(l.confidence, 12)])
+        links.sort()
+        feed, token = [], ""
+        while True:
+            page = fed.router.feed_page("deduplication", "people", token,
+                                        5000)
+            feed.extend(page["rows"])
+            token = page["next_since"]
+            if page["drained"]:
+                break
+        for row in feed:
+            row.pop("_updated", None)
+        feed.sort(key=lambda r: r["_id"])
+        print("DUMP " + json.dumps({
+            "links": links,
+            "feed": feed,
+            "owner": fed.map.find(moved_id).group,
+            "frozen": fed.map.find(moved_id).frozen,
+            "migrations": fed.migrator.outcomes,
+        }), flush=True)
+
+    fed.close()
+    print("DONE", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,132 @@
+"""Kill-at-every-migration-site chaos differential (ISSUE 14 acceptance).
+
+For each ``crash_at`` site inside the live range migration, a child
+federation process is SIGKILLed mid-migration and restarted; the
+restarted process resumes the migration (the ``Federation`` constructor
+finishes an interrupted one before serving) and must converge to
+federated link rows and a merged ``?since=`` feed bit-identical
+(timestamps normalized) to an UNMIGRATED control — zero lost, zero
+duplicated links — with the moved range owned by the target and thawed.
+
+Mirrors the PR 10 kill-differential methodology (a real process, a real
+SIGKILL, a real restart); runs inside every tier-1 leg and verbosely in
+the dedicated ``federation-chaos`` CI job.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from sesam_duke_microservice_tpu.utils import faults
+
+CHILD = os.path.join(os.path.dirname(__file__), "federation_chaos_child.py")
+N_BATCHES = 6
+
+
+@pytest.fixture(autouse=True)
+def _no_env_faults():
+    # mask any CI-leg DUKE_FAULTS spec; children get an explicit spec
+    faults.configure("")
+    yield
+    faults.configure(None)
+
+
+def _run_child(data, *, fault="", migrate=False, dump=False, start=0):
+    env = dict(os.environ)
+    env["DUKE_FAULTS"] = fault
+    env["DUKE_JOURNAL"] = "1"
+    env.pop("DUKE_FLUSH_RETRIES", None)
+    cmd = [sys.executable, CHILD, "--data", str(data),
+           "--batches", str(N_BATCHES), "--start", str(start)]
+    if migrate:
+        cmd.append("--migrate")
+    if dump:
+        cmd.append("--dump")
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300,
+                          env=env)
+    acks = [int(line.split()[1]) for line in proc.stdout.splitlines()
+            if line.startswith("ACK ")]
+    dumps = [json.loads(line[5:]) for line in proc.stdout.splitlines()
+             if line.startswith("DUMP ")]
+    return proc, acks, (dumps[0] if dumps else None)
+
+
+@pytest.fixture(scope="module")
+def control_dump(tmp_path_factory):
+    """The unmigrated control: same ingest, no migration ever."""
+    proc, acks, dump = _run_child(tmp_path_factory.mktemp("ctrl") / "f",
+                                  dump=True)
+    assert proc.returncode == 0, proc.stderr
+    assert acks == list(range(N_BATCHES)) and dump["links"], proc.stdout
+    assert dump["owner"] == 0
+    return dump
+
+
+def _assert_differential(dump, control):
+    assert dump["links"] == control["links"]
+    assert dump["feed"] == control["feed"]
+    assert dump["owner"] == 1  # the resumed migration really completed
+    assert dump["frozen"] is False
+    assert dump["migrations"]["resumed"] >= 1
+
+
+MIGRATION_SITES = ["pre_freeze", "post_snapshot", "mid_replay",
+                   "pre_cutover", "post_cutover"]
+
+
+@pytest.mark.parametrize("site", MIGRATION_SITES)
+def test_migration_kill_differential(site, control_dump, tmp_path):
+    """SIGKILL at the site mid-migration; the restarted federation
+    resumes and converges to the unmigrated control's rows and feed."""
+    data = tmp_path / "f"
+    proc, acks, _ = _run_child(data, fault=f"crash_at={site}:1",
+                               migrate=True)
+    assert proc.returncode == -signal.SIGKILL, (
+        f"child survived the {site} kill site: rc={proc.returncode}\n"
+        f"{proc.stdout}\n{proc.stderr}")
+    assert acks == list(range(N_BATCHES))  # died migrating, post-ingest
+
+    # restart: the constructor resumes the interrupted migration before
+    # serving; every batch was acked so the client resends nothing, and
+    # the explicit --migrate reports already_owned
+    proc2, _, dump = _run_child(data, migrate=True, dump=True,
+                                start=N_BATCHES)
+    assert proc2.returncode == 0, proc2.stderr
+    _assert_differential(dump, control_dump)
+
+
+def test_clean_migration_matches_control(control_dump, tmp_path):
+    """No kill: one uninterrupted live migration, same differential."""
+    data = tmp_path / "f"
+    proc, acks, dump = _run_child(data, migrate=True, dump=True)
+    assert proc.returncode == 0, proc.stderr
+    assert acks == list(range(N_BATCHES))
+    assert dump["links"] == control_dump["links"]
+    assert dump["feed"] == control_dump["feed"]
+    assert dump["owner"] == 1 and dump["frozen"] is False
+    assert dump["migrations"]["completed"] == 1
+    assert dump["migrations"]["resumed"] == 0
+
+
+def test_double_kill_still_converges(control_dump, tmp_path):
+    """Two successive kills (one mid-copy, one mid-cutover-resume) —
+    resume is idempotent under repeated interruption."""
+    data = tmp_path / "f"
+    proc, _, _ = _run_child(data, fault="crash_at=post_snapshot:1",
+                            migrate=True)
+    assert proc.returncode == -signal.SIGKILL
+    # the RESUME itself is killed at its cutover boundary this time
+    proc2, _, _ = _run_child(data, fault="crash_at=pre_cutover:1",
+                             migrate=True, start=N_BATCHES)
+    assert proc2.returncode == -signal.SIGKILL, proc2.stdout + proc2.stderr
+    proc3, _, dump = _run_child(data, migrate=True, dump=True,
+                                start=N_BATCHES)
+    assert proc3.returncode == 0, proc3.stderr
+    assert dump["links"] == control_dump["links"]
+    assert dump["feed"] == control_dump["feed"]
+    assert dump["owner"] == 1 and dump["frozen"] is False
+    assert dump["migrations"]["resumed"] >= 1
