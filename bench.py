@@ -103,6 +103,23 @@ DURABILITY = os.environ.get("BENCH_DURABILITY", "1") != "0"
 DURA_RECOVERY_BATCHES = int(
     os.environ.get("BENCH_DURA_RECOVERY_BATCHES", "10000"))
 
+# open-loop tail-latency / SLO harness (ISSUE 15): Poisson arrivals at a
+# sweep of rates against the continuous-microbatching scheduler, reporting
+# p50/p99/p999 measured from the SCHEDULED arrival instant (so queueing
+# delay counts — the closed-loop `concurrent` bench hides it by
+# construction), SLO-violation counts, cold vs AOT-warm time-to-first-200
+# in fresh subprocesses, and the recovery read-unavailability window
+# serial vs overlapped.  BENCH_TAIL=0 skips it.
+TAIL = os.environ.get("BENCH_TAIL", "1") != "0"
+TAIL_RATES = tuple(
+    float(r) for r in os.environ.get("BENCH_TAIL_RATES", "4,12,24").split(","))
+TAIL_SECONDS = float(os.environ.get("BENCH_TAIL_SECONDS", "5"))
+TAIL_SLO_MS = float(os.environ.get("BENCH_TAIL_SLO_MS", "1000"))
+TAIL_BATCH = int(os.environ.get("BENCH_TAIL_BATCH", "8"))
+TAIL_CORPUS = int(os.environ.get("BENCH_TAIL_CORPUS", "4096"))
+TAIL_RECOVERY_BATCHES = int(
+    os.environ.get("BENCH_TAIL_RECOVERY_BATCHES", "4000"))
+
 # warm-resync ingest bench (this round's encode subsystem): re-POST an
 # already-ingested corpus — the reference's full-resync traffic shape —
 # and compare records/s cold (empty feature cache) vs warm (digest hits)
@@ -1324,6 +1341,314 @@ def federation_bench() -> dict:
     }
 
 
+# -- open-loop tail latency / cold start / recovery window (ISSUE 15) --------
+
+_TAIL_COLD_CHILD = r'''
+import json, os, threading, time, urllib.request
+t0 = time.perf_counter()
+from sesam_duke_microservice_tpu.core.config import parse_config
+from sesam_duke_microservice_tpu.service.app import DukeApp, serve
+sc = parse_config(os.environ["TAIL_XML"])
+app = DukeApp(sc, backend="device", persistent=False)
+server = serve(app, port=0, host="127.0.0.1")
+threading.Thread(target=server.serve_forever, daemon=True).start()
+base = "http://127.0.0.1:%d" % server.server_address[1]
+body = json.dumps([
+    {"_id": "r%d" % i, "name": "cold start probe %d" % i, "ssn": str(i)}
+    for i in range(8)
+]).encode()
+req = urllib.request.Request(
+    base + "/deduplication/conc/ds", data=body,
+    headers={"Content-Type": "application/json"}, method="POST")
+with urllib.request.urlopen(req, timeout=600) as r:
+    assert r.status == 200
+elapsed = time.perf_counter() - t0
+if os.environ.get("TAIL_JOIN_WARM") == "1":
+    # the cold arm waits for the miss-filler so the AOT store is fully
+    # populated before the warm arm starts
+    for wl in app.deduplications.values():
+        cache = getattr(wl.index, "scorer_cache", None)
+        t = getattr(cache, "_warm_thread", None)
+        if t is not None:
+            t.join(timeout=600)
+print("TAIL " + json.dumps({"time_to_first_200_s": round(elapsed, 3)}))
+server.shutdown()
+app.close()
+'''
+
+_TAIL_RECOVERY_CHILD = r'''
+import json, os, threading, time, urllib.request
+t0 = time.perf_counter()
+from sesam_duke_microservice_tpu.core.config import parse_config
+from sesam_duke_microservice_tpu.service.app import DukeApp, serve
+sc = parse_config(os.environ["TAIL_XML"], env={"MIN_RELEVANCE": "0.05"})
+# serial mode blocks HERE through the whole replay; overlap returns fast
+app = DukeApp(sc, backend="host", persistent=True)
+server = serve(app, port=0, host="127.0.0.1")
+threading.Thread(target=server.serve_forever, daemon=True).start()
+base = "http://127.0.0.1:%d" % server.server_address[1]
+read_s = None
+while read_s is None:
+    try:
+        with urllib.request.urlopen(
+                base + "/deduplication/people?since=0", timeout=10) as r:
+            if r.status == 200:
+                read_s = time.perf_counter() - t0
+    except Exception:
+        time.sleep(0.005)
+write_s = None
+while write_s is None:
+    try:
+        with urllib.request.urlopen(base + "/readyz", timeout=10) as r:
+            body = json.loads(r.read())
+            if body["checks"].get("write_ready"):
+                write_s = time.perf_counter() - t0
+    except Exception:
+        pass
+    if write_s is None:
+        time.sleep(0.01)
+print("TAIL " + json.dumps({
+    "read_unavailable_s": round(read_s, 3),
+    "write_ready_s": round(write_s, 3),
+}))
+server.shutdown()
+app.close()
+'''
+
+TAIL_RECOVERY_XML = """
+<DukeMicroService dataFolder="{folder}">
+  <Deduplication name="people">
+    <duke>
+      <schema>
+        <threshold>0.8</threshold>
+        <property><name>NAME</name><comparator>levenshtein</comparator><low>0.1</low><high>0.95</high></property>
+      </schema>
+      <data-source class="io.sesam.dukemicroservice.IncrementalDeduplicationDataSource">
+        <param name="dataset-id" value="crm"/>
+        <column name="name" property="NAME"/>
+      </data-source>
+    </duke>
+  </Deduplication>
+</DukeMicroService>
+"""
+
+
+def _tail_entities(i: int) -> list:
+    ents = []
+    for k in range(TAIL_BATCH):
+        uid = f"t{i}k{k}"
+        ents.append({"_id": uid,
+                     "name": f"open loop {uid} w{i * 7919 + k}",
+                     "ssn": str(900000 + i * 31 + k)})
+    return ents
+
+
+def _tail_sweep(sc) -> dict:
+    """Poisson open-loop arrivals against the real ingest scheduler.
+
+    Latency is measured from each request's SCHEDULED arrival instant —
+    not from when a client thread got around to submitting — so queueing
+    delay under a saturated scheduler lands in the percentiles, which is
+    exactly what the closed-loop ``concurrent`` bench cannot see."""
+    import threading
+
+    from sesam_duke_microservice_tpu.engine.scheduler import (
+        IngestScheduler,
+        SchedulerReject,
+    )
+    from sesam_duke_microservice_tpu.engine.workload import build_workload
+
+    wl = build_workload(sc.deduplications["conc"], sc, backend="device",
+                        persistent=False)
+    sched = IngestScheduler(lambda kind, name: wl)
+    out = {}
+    try:
+        for r in _conc_corpus(TAIL_CORPUS):
+            wl.index.index(r)
+        wl.index.commit()
+        wl.submit_batch("ds", _conc_entities(99, 99))  # warm shapes/upload
+        seq = 0
+        for rate in TAIL_RATES:
+            rng = random.Random(4242)
+            arrivals, t = [], 0.0
+            while t < TAIL_SECONDS:
+                t += rng.expovariate(rate)
+                arrivals.append(t)
+            lat, rejected, errors = [], 0, 0
+            lock = threading.Lock()
+            threads = []
+            base = time.perf_counter() + 0.05
+
+            def fire(at, ents):
+                nonlocal rejected, errors
+                t_sched = base + at
+                try:
+                    sched.submit("deduplication", "conc", "ds", ents)
+                    sample = time.perf_counter() - t_sched
+                    with lock:
+                        lat.append(sample)
+                except SchedulerReject:
+                    with lock:
+                        rejected += 1
+                except Exception:
+                    with lock:
+                        errors += 1
+
+            for at in arrivals:
+                seq += 1
+                ents = _tail_entities(seq)
+                delay = base + at - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                th = threading.Thread(target=fire, args=(at, ents))
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join()
+            lat.sort()
+            n = len(lat)
+
+            def pct(p):
+                return (round(lat[min(n - 1, int(n * p))] * 1e3, 2)
+                        if n else None)
+
+            slo = sum(1 for s in lat if s * 1e3 > TAIL_SLO_MS) + rejected
+            span = arrivals[-1] if arrivals else 1.0
+            out[str(rate)] = {
+                "target_rps": rate,
+                "offered": len(arrivals),
+                "completed": n,
+                "rejected_429": rejected,
+                "errors": errors,
+                "p50_ms": pct(0.50),
+                "p99_ms": pct(0.99),
+                "p999_ms": pct(0.999),
+                "slo_ms": TAIL_SLO_MS,
+                "slo_violations": slo,
+                "achieved_rps": round(n / span, 2),
+            }
+        return out
+    finally:
+        sched.shutdown()
+        wl.close(save_snapshot=False)
+
+
+def _tail_child(script: str, extra_env: dict) -> dict:
+    import subprocess
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    env.update(extra_env)
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=900)
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("TAIL ")]
+    if proc.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"tail-latency child failed: rc={proc.returncode}\n"
+            f"{proc.stdout}\n{proc.stderr}")
+    return json.loads(lines[0][len("TAIL "):])
+
+
+def _tail_cold_start(tmpdir: str) -> dict:
+    """Fresh-process time-to-first-200, empty caches vs populated AOT
+    store — the restart contract as a wall-clock number.  Both arms use
+    a restricted ladder (one bucket) so the CPU dev box's cold compile
+    stays minutes-not-hours; the arms differ ONLY in cache state."""
+    aot = os.path.join(tmpdir, "aot")
+    xla = os.path.join(tmpdir, "xla")
+    child_env = {
+        "TAIL_XML": CONC_XML,
+        "DUKE_AOT_DIR": aot,
+        "JAX_COMPILATION_CACHE_DIR": xla,
+        "DUKE_JIT_CACHE_MIN_SECS": "0",
+        "DEVICE_PREWARM": "1",
+        "DEVICE_CHUNK": "512",
+        "DEVICE_QUERY_BUCKETS": "64",
+        "DEVICE_TOP_K": "64",
+        "DEVICE_INITIAL_CAPACITY": "0",
+    }
+    cold = _tail_child(_TAIL_COLD_CHILD, dict(child_env, TAIL_JOIN_WARM="1"))
+    warm = _tail_child(_TAIL_COLD_CHILD, child_env)
+    return {
+        "cold_s": cold["time_to_first_200_s"],
+        "aot_warm_s": warm["time_to_first_200_s"],
+        "speedup": round(cold["time_to_first_200_s"]
+                         / max(1e-9, warm["time_to_first_200_s"]), 2),
+    }
+
+
+def _tail_recovery_window(tmpdir: str) -> dict:
+    """Read-unavailability and write-ready windows on a restart with a
+    journal backlog: DUKE_RECOVERY_OVERLAP=0 (serial control — the app
+    cannot serve anything until replay completes) vs =1 (reads serve the
+    committed prefix immediately; only writes wait)."""
+    import shutil
+
+    from sesam_duke_microservice_tpu.core.config import parse_config
+    from sesam_duke_microservice_tpu.links.journal import LinkJournal
+    from sesam_duke_microservice_tpu.service.app import DukeApp
+
+    seed = os.path.join(tmpdir, "seed")
+    sc = parse_config(TAIL_RECOVERY_XML.format(folder=seed),
+                      env={"MIN_RELEVANCE": "0.05"})
+    app = DukeApp(sc, backend="host", persistent=True)
+    wl = app.deduplications["people"]
+    batch = [{"_id": str(i), "name": f"person number {i // 2}"}
+             for i in range(32)]
+    with wl.lock:
+        wl.process_batch("crm", batch)
+    links = wl.link_database.get_all_links()
+    app.close()
+    if not links:
+        raise RuntimeError("recovery-window seed produced no links")
+    # a backlog of DISTINCT-key link rows (BENCH_TAIL_RECOVERY_BATCHES x
+    # 32 rows/batch): every replayed row is a real insert with index
+    # maintenance, so the serial-control replay window reflects actual
+    # redo work rather than page-cache-hot re-upserts of a few keys
+    # (feed_row tolerates the synthetic endpoints: entity fields null)
+    lk0 = links[0]
+    folder = os.path.join(seed, "deduplication", "people")
+    j = LinkJournal(os.path.join(folder, "linkdatabase.journal"),
+                    sync="none")
+    now = int(time.time() * 1000)
+    for b in range(TAIL_RECOVERY_BATCHES):
+        rows = [[f"x{b}_{k}", f"y{b}_{k}", lk0.status.value,
+                 lk0.kind.value, 0.4242, now + b * 32 + k]
+                for k in range(32)]
+        j.append_batch(rows)
+    j.close()
+
+    arms = {}
+    for overlap, name in (("0", "serial"), ("1", "overlap")):
+        arm_dir = os.path.join(tmpdir, f"arm{overlap}")
+        shutil.copytree(seed, arm_dir)
+        arms[name] = _tail_child(_TAIL_RECOVERY_CHILD, {
+            "TAIL_XML": TAIL_RECOVERY_XML.format(folder=arm_dir),
+            "DUKE_RECOVERY_OVERLAP": overlap,
+        })
+    arms["recovery_batches"] = TAIL_RECOVERY_BATCHES
+    arms["overlap_read_window_smaller"] = (
+        arms["overlap"]["read_unavailable_s"]
+        < arms["serial"]["read_unavailable_s"])
+    return arms
+
+
+def tail_latency_bench() -> dict:
+    """ISSUE 15 acceptance surface: the open-loop sweep, the cold/warm
+    restart differential, and the recovery-window differential."""
+    import tempfile
+
+    from sesam_duke_microservice_tpu.core.config import parse_config
+
+    sc = parse_config(CONC_XML)
+    out = {"rates": _tail_sweep(sc)}
+    with tempfile.TemporaryDirectory(prefix="duke-tail-") as tmpdir:
+        out["cold_start"] = _tail_cold_start(tmpdir)
+        out["recovery_window"] = _tail_recovery_window(tmpdir)
+    return out
+
+
 def main():
     schema = bench_schema()
     corpus = stresstest_records(CORPUS, seed=1234)
@@ -1358,6 +1683,8 @@ def main():
         result["durability"] = durability_bench(schema)
     if FED_BENCH and BACKEND == "device":
         result["federation"] = federation_bench()
+    if TAIL and BACKEND == "device":
+        result["tail_latency"] = tail_latency_bench()
     print(json.dumps(result))
     print(
         f"# cpu_baseline={cpu_rate:.0f} pairs/s, device median-of-{len(rates)}"

@@ -197,6 +197,12 @@ class _AnnScorerCache(_ScorerCache):
     falling back to the flat scan once every cell is probed."""
 
     escalation_stage = "top_c"
+    # AOT store namespace (ISSUE 15): same ladder geometry as the corpus
+    # scorer, different HLO — keys must never collide
+    aot_builder = "ann"
+
+    def _ladder_k(self, cap: int) -> int:
+        return min(self.index.initial_top_c, cap)
 
     def _build(self, top_c: int, group_filtering: bool, from_rows: bool,
                plan=None):
@@ -264,7 +270,7 @@ class _AnnScorerCache(_ScorerCache):
             name: jax.ShapeDtypeStruct((cap,) + arr.shape[1:], arr.dtype)
             for name, arr in emb_tree.items()
         }
-        c = min(self.index.initial_top_c, cap)
+        c = self._ladder_k(cap)
         # private jit instance via the shared builder — see
         # _ScorerCache._lower_one
         scorer = self._build(c, group_filtering, from_rows, plan=plan)
@@ -289,7 +295,7 @@ class _AnnScorerCache(_ScorerCache):
                 }
                 for prop, tensors in pf.items()
             }
-        scorer.lower(
+        return scorer.lower(
             q_emb, qfeats, corpus_tree, cfeats, mb, mb2, mi, qg, qr, ml
         ).compile()
 
@@ -314,6 +320,7 @@ class _AnnScorerCache(_ScorerCache):
         qfeats, from_rows, query_row_j, query_group_j = self._prepare_queries(
             records, group_filtering
         )
+        bucket = int(query_row_j.shape[0])
         if from_rows:
             # gathered on device by the scorer; placeholder keeps the jit
             # signature stable for the cached from_rows variant
@@ -352,10 +359,18 @@ class _AnnScorerCache(_ScorerCache):
                 # flat scan — fall back to the real one (today's path),
                 # preserving the "escalation ends in exhaustive
                 # retrieval" contract
-            return self._scorer(c, group_filtering, from_rows)(
+            flat_args = (
                 q_emb, qfeats, emb_tree, corpus_feats, cvalid, cdeleted,
                 cgroup, query_group_j, query_row_j, jnp.float32(min_logit),
             )
+            # AOT fast path (ISSUE 15) — flat-scan ladder only: the IVF
+            # program's shapes depend on trained cell geometry, which
+            # only exists once data arrived, so it is never stored
+            out = self.aot_call(c, group_filtering, from_rows, bucket,
+                                flat_args)
+            if out is not None:
+                return out
+            return self._scorer(c, group_filtering, from_rows)(*flat_args)
 
         # recall escalation: when every retrieved candidate cleared the
         # pruning bound (or sat inside the int8 ambiguity band at the

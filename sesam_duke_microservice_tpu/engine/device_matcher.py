@@ -38,6 +38,7 @@ only O(log N) times over a corpus's lifetime.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import threading
@@ -1591,6 +1592,40 @@ class _BlockResult:
         return [(row, logit) for _, row, logit in self.survivor_triples(q)]
 
 
+def _fp_value(v, depth: int = 0):
+    """JSON-able fingerprint image of a comparator/spec attribute: the
+    HLO bakes these values in, so the AOT store key must cover them.
+    Objects recurse one level through ``vars()`` (a nested comparator's
+    parameters matter); anything deeper or unrecognized reduces to its
+    type name — a lossy reduction can only cause a spurious key match
+    between configs that differ solely inside such a value, and the
+    scoring-source hash in the store key bounds that exposure."""
+    if isinstance(v, (bool, int, float, str)) or v is None:
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_fp_value(x, depth) for x in v]
+    if isinstance(v, dict):
+        return sorted((str(k), _fp_value(x, depth)) for k, x in v.items())
+    if depth < 2 and hasattr(v, "__dict__"):
+        return [type(v).__name__,
+                sorted((k, _fp_value(x, depth + 1))
+                       for k, x in vars(v).items())]
+    return type(v).__name__
+
+
+def _plan_fingerprint(plan) -> list:
+    """Deterministic image of everything in a feature plan the scorer
+    HLO depends on: per-property widths, bounds, and comparator
+    parameters (the probability map constants are baked into the
+    program; thresholds ride as the runtime ``min_logit`` argument and
+    deliberately do NOT key the cache)."""
+    return [
+        [s.name, s.kind, s.v, s.chars, s.low, s.high,
+         _fp_value(s.comparator)]
+        for s in plan.device_props
+    ]
+
+
 # Daemon threads killed mid-XLA-compile abort the process at interpreter
 # teardown; atexit instead signals the warm loop to stop at the next ladder
 # step and waits briefly for the in-flight compile to finish.
@@ -1624,30 +1659,99 @@ class _ScorerCache:
     # shard_map would need collectives, so sharded queries upload replicated.
     queries_from_rows = True
 
+    # AOT executable-store participation (ISSUE 15): the sharded caches
+    # opt out — their shard_map programs compile against a live mesh and
+    # their prewarm ladder is disabled anyway.
+    supports_aot = True
+    # store-key namespace: the ANN cache's programs share the ladder
+    # geometry but different HLO, so the builders must never collide
+    aot_builder = "corpus"
+
     def __init__(self, index: DeviceIndex):
         self.index = index
         self._scorers: Dict[Tuple[int, bool], object] = {}
         self._warmed = None
         self._warm_thread: Optional[threading.Thread] = None
         self._warm_compiled = 0  # successful AOT compiles (observability)
+        self._aot_loaded = 0     # executables deserialized from the store
+        self._warm_seconds = 0.0  # last AOT-load/ladder pass duration
+        # last warm-thread failure (repr), surfaced in /healthz detail so
+        # a silently-cold replica is diagnosable (ISSUE 15 satellite)
+        self._warm_error: Optional[str] = None
+        # shape-registered executables: (k, group_filtering, from_rows,
+        # capacity, bucket) -> compiled/deserialized executable.
+        # Lock-free by design: values are immutable once stored, writes
+        # (the synchronous load pass, the warm thread) and reads (the
+        # dispatch fast path) are GIL-atomic dict ops, and a stale read
+        # only costs one jit-path fallback.
+        self._aot: Dict[tuple, object] = {}
 
-    # -- compile-ladder pre-warm --------------------------------------------
+    # -- compile-ladder pre-warm / AOT load ---------------------------------
+
+    def _ladder(self, cap: int) -> List[tuple]:
+        """The (capacity, bucket, from_rows) executable ladder for the
+        current shape fingerprint — the current capacity plus
+        (speculatively) the next doubling step, every padding bucket,
+        and both query variants (indexed gather / http-transform
+        upload).  ONE enumeration shared by the AOT loader and the warm
+        compiler, so a loaded ladder and a compiled ladder can never
+        cover different shapes."""
+        out = []
+        for cap_i in (cap, cap * 2):
+            for bucket in _QUERY_BUCKETS:
+                for from_rows in (True, False):
+                    out.append((cap_i, bucket, from_rows))
+        return out
+
+    def _ladder_k(self, cap: int) -> int:
+        """Initial candidate width for a ``cap``-row corpus (the ANN
+        cache overrides with its top-C)."""
+        return min(_INITIAL_TOP_K, cap)
+
+    def _store_key(self, plan, k: int, group_filtering: bool,
+                   from_rows: bool, cap: int, bucket: int) -> dict:
+        """The AOT store key for one ladder entry: everything the
+        compiled HLO depends on that the store does not already cover
+        (utils.jit_cache adds backend, device kind, jax/jaxlib versions,
+        XLA flags, and the scoring-source hash)."""
+        return {
+            "builder": self.aot_builder,
+            "plan": _plan_fingerprint(plan),
+            "chunk": _CHUNK,
+            "value_slots_max": _VALUE_SLOTS_MAX,
+            "k": k,
+            "group_filtering": bool(group_filtering),
+            "from_rows": bool(from_rows),
+            "cap": cap,
+            "bucket": bucket,
+        }
 
     def prewarm_async(self, group_filtering: bool) -> None:
-        """Background-compile the (query-bucket x K) scorer ladder for the
-        current corpus shapes — and speculatively the next capacity-doubling
-        step — so a cold run's early batches don't stall on sequential jit
-        compiles.  ``lower().compile()`` also seeds the persistent XLA
-        compile cache, making restarts compile-free.  Safe to call often:
-        no-ops while the shape fingerprint is unchanged."""
-        if not env_flag("DEVICE_PREWARM", True):
+        """Make the (query-bucket x capacity x K x variant) scorer ladder
+        hot for the current corpus shapes — and speculatively the next
+        capacity-doubling step — so a cold run's early batches don't
+        stall on sequential jit compiles.  Safe to call often: no-ops
+        while the shape fingerprint is unchanged.
+
+        With the AOT store on (``DUKE_AOT``, default), the ladder is
+        first *deserialized* synchronously — the whole point is that the
+        FIRST batch after a restart scores through a stored executable,
+        so the load must not race it — and the background warm thread
+        becomes the miss-filler: it compiles only the entries the store
+        lacked and serializes each one back (plus seeding the persistent
+        XLA compile cache as before)."""
+        from ..utils.jit_cache import aot_enabled, enable_persistent_cache
+
+        aot = aot_enabled() and self.supports_aot
+        prewarm = env_flag("DEVICE_PREWARM", True)
+        if not aot and not prewarm:
             return
         # the warm compiles land in the persistent XLA cache (private jit
         # instances; the live scorer reads the cache on first contact) —
-        # make sure it is actually on, whatever the embedding context
-        from ..utils.jit_cache import enable_persistent_cache
-
-        if enable_persistent_cache() is None:
+        # make sure it is actually on, whatever the embedding context.
+        # With the AOT store on, warming helps even without it (fresh
+        # executables register for the dispatch fast path directly).
+        if enable_persistent_cache() is None and not aot:
             return  # no cache -> warming could never help the live scorer
         cap = max(self.index.corpus.capacity, _CHUNK)
         key = (
@@ -1655,16 +1759,107 @@ class _ScorerCache:
             tuple((s.v, s.chars) for s in self.index.plan.device_props),
             bool(group_filtering),
         )
-        if self._warmed == key:
+        prev = self._warmed
+        if prev == key:
             return
         self._warmed = key
+        if prev is not None and prev[1:] != key[1:]:
+            # the PLAN moved (value-slot/char growth, demotion, filtering
+            # flip): every registered executable was built for the old
+            # tensor shapes, and its (k, gf, from_rows, cap, bucket) akey
+            # would otherwise BLOCK the load pass from refilling that
+            # slot — the stale entry would only die at dispatch as a
+            # call-time reject with no refill path.  Rebind (not mutate):
+            # an in-flight reader of the old dict at worst takes one
+            # rejected call.  Capacity-only changes keep the map — old-cap
+            # entries are unreachable but the current-cap ones stay hot.
+            self._aot = {}
+        missing = None
+        if aot:
+            missing = self._aot_load_ladder(group_filtering, key)
+            if not missing:
+                return  # full ladder deserialized: nothing to compile
+        if not prewarm:
+            return  # background compiles off: misses stay on the jit path
         t = threading.Thread(
-            target=self._prewarm, args=(group_filtering, key), daemon=True,
-            name="scorer-prewarm",
+            target=self._prewarm, args=(group_filtering, key, missing),
+            daemon=True, name="scorer-prewarm",
         )
         self._warm_thread = t
         _register_warm_thread(t)
         t.start()
+
+    def _aot_load_ladder(self, group_filtering: bool, key):
+        """Deserialize every ladder entry the AOT store holds for the
+        current shape fingerprint, registering each for the dispatch
+        fast path; returns the (cap, bucket, from_rows) entries still
+        missing (the warm thread's compile list), or the full ladder
+        when the load pass itself failed."""
+        from ..utils.jit_cache import AotStore
+
+        t0 = time.monotonic()
+        loaded = 0
+        missing: Optional[List[tuple]] = []
+        try:
+            store = AotStore()
+            plan = self._frozen_plan()
+            for cap_i, bucket, from_rows in self._ladder(key[0]):
+                k = self._ladder_k(cap_i)
+                akey = (k, bool(group_filtering), bool(from_rows),
+                        cap_i, bucket)
+                if akey in self._aot:
+                    continue
+                exe = store.load(self._store_key(
+                    plan, k, group_filtering, from_rows, cap_i, bucket))
+                if exe is None:
+                    missing.append((cap_i, bucket, from_rows))
+                else:
+                    self._aot[akey] = exe
+                    loaded += 1
+        except Exception:  # pragma: no cover - store/backend specific
+            logger.exception(
+                "AOT ladder load failed (falling back to compiles)")
+            missing = None
+        self._aot_loaded += loaded
+        self._warm_seconds = time.monotonic() - t0
+        if loaded:
+            logger.info(
+                "AOT executable cache: %d scorer(s) deserialized in "
+                "%.3f s (%d missing)", loaded, self._warm_seconds,
+                len(missing) if missing is not None else -1,
+            )
+        return missing if missing is not None else self._ladder(key[0])
+
+    def aot_call(self, k: int, group_filtering: bool, from_rows: bool,
+                 bucket: int, args: tuple):
+        """Run the scoring program through a registered AOT/pre-built
+        executable when one matches this exact (K, filtering, variant,
+        capacity, bucket) shape; None = caller takes the jit path.  A
+        shape drift (the plan mutated after the executable was built)
+        raises inside the call — the entry is dropped (counted as a
+        reject) and the jit path serves."""
+        if not self._aot:
+            return None
+        akey = (k, bool(group_filtering), bool(from_rows),
+                self.index.corpus.capacity, bucket)
+        fn = self._aot.get(akey)
+        if fn is None:
+            return None
+        try:
+            out = fn(*args)
+        except Exception:
+            from ..utils.jit_cache import record_aot_reject
+
+            record_aot_reject()
+            self._aot.pop(akey, None)
+            logger.warning(
+                "registered AOT executable rejected at call time (plan "
+                "drift since it was built?); jit path serves this shape",
+                exc_info=True,
+            )
+            return None
+        record_cache_hit()
+        return out
 
     def _row_shapes(self):
         """Per-row feature tensor shapes under the current plan, derived by
@@ -1712,7 +1907,7 @@ class _ScorerCache:
         cfeats, (mb, mb2, mi, qg, qr, ml) = self._lower_args(
             row_feats, cap, bucket
         )
-        k = min(_INITIAL_TOP_K, cap)
+        k = self._ladder_k(cap)
         # a PRIVATE jit instance: tracing the live scorer object from this
         # thread while the main thread traces it too corrupts shared pjit
         # state; _build is the single builder both paths share, so the HLO
@@ -1731,7 +1926,7 @@ class _ScorerCache:
                 }
                 for prop, tensors in probe_feats.items()
             }
-        scorer.lower(qfeats, cfeats, mb, mb2, mi, qg, qr, ml).compile()
+        return scorer.lower(qfeats, cfeats, mb, mb2, mi, qg, qr, ml).compile()
 
     def _frozen_plan(self):
         """Immutable copy of the index plan for the warm thread.
@@ -1752,33 +1947,92 @@ class _ScorerCache:
             host_props=list(self.index.plan.host_props),
         )
 
-    def _prewarm(self, group_filtering: bool, key) -> None:
+    def _prewarm(self, group_filtering: bool, key, missing=None) -> None:
+        """Compile the ladder entries ``missing`` (None = the full
+        ladder — the AOT store was off or its load pass failed), and
+        with the store on serialize each fresh executable back so the
+        NEXT process deserializes instead of compiling.  Both query
+        variants ride the ladder: http-transform probes score through
+        from_rows=False (bucket-shaped qfeats) and would otherwise
+        stall on first-contact compiles despite the warm having run."""
+        from ..utils.jit_cache import AotStore, aot_enabled
+
         try:
+            store = (AotStore()
+                     if aot_enabled() and self.supports_aot else None)
             plan = self._frozen_plan()
             row_feats = self._row_shapes()
             probe_feats = self._probe_shapes()
-            cap = key[0]
-            for cap_i in (cap, cap * 2):
-                for bucket in _QUERY_BUCKETS:
-                    if self._warmed != key or _WARM_SHUTDOWN.is_set():
-                        return  # superseded / interpreter exiting
-                    record_compile()
-                    self._lower_one(row_feats, cap_i, bucket,
-                                    group_filtering, plan=plan)
-                    self._warm_compiled += 1
-                    # http-transform probes score through the
-                    # from_rows=False variant (bucket-shaped qfeats);
-                    # without this they stall on first-contact compiles
-                    # despite the warm thread having run
-                    if self._warmed != key or _WARM_SHUTDOWN.is_set():
-                        return
-                    record_compile()
-                    self._lower_one(row_feats, cap_i, bucket,
-                                    group_filtering, from_rows=False,
-                                    probe_feats=probe_feats, plan=plan)
-                    self._warm_compiled += 1
-        except Exception:  # pragma: no cover - warm failures are harmless
-            logger.exception("scorer pre-warm failed (scoring unaffected)")
+            entries = self._ladder(key[0]) if missing is None else missing
+            self._prewarm_entries(entries, key, group_filtering, store,
+                                  plan, row_feats, probe_feats)
+        except Exception as e:  # pragma: no cover - warm failures are rare
+            # counted + latched (ISSUE 15 satellite): a silently-cold
+            # replica — scoring works but every first-contact shape pays
+            # a live compile — must be diagnosable from /healthz
+            telemetry.PREWARM_FAILURES.inc()  # dukecheck: ignore[DK502] rare event: warm-thread failure, never per-block
+            self._warm_error = repr(e)
+            logger.exception(
+                "scorer pre-warm failed (scoring unaffected, but this "
+                "replica stays cold)")
+
+    @staticmethod
+    def _cache_bypass():
+        """Thread-local context disabling jax's persistent compilation
+        cache for one warm compile.  Compiles destined for the AOT store
+        must be FRESH: an XLA compile served from that cache yields an
+        executable that serializes thin (missing jit symbols — see
+        AotStore.save).  The live path keeps its cache (thread-local
+        config); direct registration supersedes the old cache-seeding
+        role."""
+        try:
+            from jax._src.config import enable_compilation_cache
+
+            return enable_compilation_cache(False)
+        except Exception:  # pragma: no cover - private jax API drift
+            return contextlib.nullcontext()  # save()'s validation guards
+
+    def _prewarm_entries(self, entries, key, group_filtering, store,
+                         plan, row_feats, probe_feats) -> None:
+        for cap_i, bucket, from_rows in entries:
+            if self._warmed != key or _WARM_SHUTDOWN.is_set():
+                return  # superseded / interpreter exiting
+            record_compile()
+            ctx = (self._cache_bypass() if store is not None
+                   else contextlib.nullcontext())
+            with ctx:
+                compiled = self._lower_one(
+                    row_feats, cap_i, bucket, group_filtering,
+                    from_rows=from_rows,
+                    probe_feats=None if from_rows else probe_feats,
+                    plan=plan,
+                )
+            self._warm_compiled += 1
+            k = self._ladder_k(cap_i)
+            akey = (k, bool(group_filtering), bool(from_rows),
+                    cap_i, bucket)
+            # serve the fresh executable directly — first contact in
+            # THIS process skips the live jit trace too; setdefault
+            # so a deserialized entry (or a newer warm) is never
+            # replaced mid-use
+            self._aot.setdefault(akey, compiled)
+            if store is not None and not store.save(
+                    self._store_key(plan, k, group_filtering,
+                                    from_rows, cap_i, bucket),
+                    compiled):
+                # this backend cannot serialize executables (or the
+                # store is unwritable): stop bypassing the persistent
+                # XLA compile cache — without the fallback, NOTHING
+                # would seed it (the live path serves from the _aot
+                # registrations) and every restart would re-pay the
+                # full ladder compile, a regression vs the pre-AOT
+                # behavior.  Remaining entries compile cache-enabled,
+                # converging on the legacy restart story.
+                store = None
+                logger.warning(
+                    "AOT executable save unsupported here; remaining "
+                    "warm compiles seed the persistent XLA cache "
+                    "instead")
 
     def _build(self, top_k: int, group_filtering: bool, from_rows: bool,
                plan=None):
@@ -1905,11 +2159,20 @@ class _ScorerCache:
         qfeats, from_rows, query_row_j, query_group_j = self._prepare_queries(
             records, group_filtering
         )
+        bucket = int(query_row_j.shape[0])
         cfeats, cvalid, cdeleted, cgroup = corpus.device_arrays()
         args = (cfeats, cvalid, cdeleted, cgroup, query_group_j,
                 query_row_j, jnp.float32(min_logit))
 
         def call(k):
+            # AOT fast path (ISSUE 15): a deserialized/pre-built
+            # executable registered for this exact shape skips the jit
+            # trace entirely — a restarted process's first batch scores
+            # with ZERO compiles (tests/test_aot_cache.py)
+            out = self.aot_call(k, group_filtering, from_rows, bucket,
+                                (qfeats,) + args)
+            if out is not None:
+                return out
             return self._scorer(k, group_filtering, from_rows)(qfeats, *args)
 
         k = min(_INITIAL_TOP_K, corpus.capacity)

@@ -209,6 +209,11 @@ class _ShardedScorerCache(_ScorerCache):
             group_filtering=group_filtering,
         )
 
+    # no AOT participation either (ISSUE 15): shard_map executables
+    # compile against a live mesh topology; serialize/deserialize is
+    # unvalidated there and the prewarm ladder is disabled anyway
+    supports_aot = False
+
     def prewarm_async(self, group_filtering: bool) -> None:
         # the shard_map programs need mesh-aware lowering shapes; until a
         # sharded prewarm ladder exists, first-contact compiles (cached in
@@ -221,6 +226,7 @@ class _ShardedAnnScorerCache(_AnnScorerCache):
 
     queries_from_rows = False
     supports_dd = False  # see _ShardedScorerCache
+    supports_aot = False  # see _ShardedScorerCache
 
     def _build(self, top_c: int, group_filtering: bool, from_rows: bool,
                plan=None):

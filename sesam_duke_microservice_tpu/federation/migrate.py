@@ -51,6 +51,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import time
 from typing import Dict, List, Optional, Tuple
 
 from ..core.records import DELETED_PROPERTY_NAME, Record
@@ -196,6 +197,14 @@ class RangeMigrator:
                 self._set_phase(state, "frozen")
                 moved = self._copy_range(range_id, source, target)
                 self._set_phase(state, "copied")
+                # rebalanced ranges start hot (ISSUE 15): the copy may
+                # have grown the target's corpus past a capacity
+                # doubling, so warm its scorer ladder (AOT
+                # deserialization + background miss-fill — the same
+                # path a cold start uses) BEFORE the cutover points
+                # traffic at it.  Best-effort with a bounded wait: a
+                # cold target serves correctly, just slower.
+                self._warm_target(target)
                 # kill site: target complete and durable, map still
                 # names the source — restart redoes the copy (idempotent)
                 faults.check_crash("pre_cutover")
@@ -226,6 +235,27 @@ class RangeMigrator:
             self.outcomes["failed"] += 1
             self._set_phase(state, "idle")
             raise
+
+    def _warm_target(self, target: int) -> None:
+        """Warm every target workload's scorer ladder before cutover
+        (no-op for host backends and unchanged shape fingerprints).
+        Bounded: waits for in-flight warm compiles up to
+        ``DUKE_FED_WARM_TIMEOUT`` seconds so a slow compile ladder can
+        delay — but never wedge — the cutover."""
+        from ..telemetry.env import env_float
+
+        deadline = time.monotonic() + env_float("DUKE_FED_WARM_TIMEOUT",
+                                                120.0)
+        caches = []
+        for wl in self.fed.groups[target].workloads.values():
+            cache = getattr(wl.index, "scorer_cache", None)
+            if cache is not None:
+                cache.prewarm_async(wl.config.is_record_linkage)
+                caches.append(cache)
+        for cache in caches:
+            t = getattr(cache, "_warm_thread", None)
+            if t is not None and t.is_alive():
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
 
     # -- copy: snapshot + ship + journal slice --------------------------------
 

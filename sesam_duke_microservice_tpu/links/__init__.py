@@ -98,8 +98,16 @@ def create_link_database(link_database_type: str, data_folder=None,
         wrapped = WriteBehindLinkDatabase(db, journal=journal)
         # recovery scoped to this workload's data folder: with N serving
         # groups in one process (federation), one group's replay flips
-        # only readiness probes watching ITS folder to "recovering"
-        with journal_mod.recovery_in_progress(data_folder):
-            wrapped.recover()
+        # only readiness probes watching ITS folder to "recovering".
+        # DUKE_RECOVERY_OVERLAP (default on, ISSUE 15) replays the
+        # backlog on a background thread so feed/monitoring reads serve
+        # the committed prefix immediately (X-Recovering header) while
+        # writes stay fenced until replay completes; =0 pins the legacy
+        # serial recovery exactly (the whole build blocks here).
+        if env_flag("DUKE_RECOVERY_OVERLAP", True):
+            wrapped.recover_async(scope=data_folder)
+        else:
+            with journal_mod.recovery_in_progress(data_folder):
+                wrapped.recover()
         return wrapped
     raise ValueError(f"Got an unknown 'link-database-type' value: '{link_database_type}'")

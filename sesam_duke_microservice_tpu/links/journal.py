@@ -95,23 +95,37 @@ _RECOVERY_LOCK = threading.Lock()
 _recovering: dict = {}  # scope -> entry depth; guarded by: _RECOVERY_LOCK [writes]
 
 
+def recovery_begin(scope: str = "") -> None:
+    """Mark startup journal replay active for ``scope``.  Split from the
+    context manager so overlapped recovery (ISSUE 15) can enter the
+    scope on the CONSTRUCTING thread — before the factory returns a
+    serving wrapper — and exit it from the background replay thread; a
+    readiness probe can then never observe the gap between the wrapper
+    existing and the replay thread having started."""
+    with _RECOVERY_LOCK:
+        _recovering[scope] = _recovering.get(scope, 0) + 1
+
+
+def recovery_end(scope: str = "") -> None:
+    with _RECOVERY_LOCK:
+        depth = _recovering.get(scope, 0) - 1
+        if depth <= 0:
+            _recovering.pop(scope, None)
+        else:
+            _recovering[scope] = depth
+
+
 @contextlib.contextmanager
 def recovery_in_progress(scope: str = ""):
     """Marks startup journal replay as active for ``scope`` (the owning
     workload's data folder; "" = process-wide); ``/readyz`` reports
-    ``recovering`` (503) until every entered context for a scope it
-    watches exits."""
-    with _RECOVERY_LOCK:
-        _recovering[scope] = _recovering.get(scope, 0) + 1
+    ``recovering`` until every entered context for a scope it watches
+    exits."""
+    recovery_begin(scope)
     try:
         yield
     finally:
-        with _RECOVERY_LOCK:
-            depth = _recovering.get(scope, 0) - 1
-            if depth <= 0:
-                _recovering.pop(scope, None)
-            else:
-                _recovering[scope] = depth
+        recovery_end(scope)
 
 
 def recovery_active(scope: Optional[str] = None) -> bool:

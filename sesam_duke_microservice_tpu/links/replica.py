@@ -156,6 +156,15 @@ class PublishingLinkDatabase(LinkDatabase):
         return getattr(self.inner, "flush_error", None)
 
     @property
+    def recovering(self) -> bool:
+        """See through to the wrapped write-behind database's overlapped
+        startup replay (ISSUE 15): without this, a multi-host leader's
+        HTTP write fence probed the publisher, always read False, and a
+        scoring POST fell through to BLOCK inside the inner fence for
+        the whole replay window instead of answering the fast 503."""
+        return getattr(self.inner, "recovering", False)
+
+    @property
     def journal(self):
         """The wrapped write-behind database's durable journal, or None
         — surfaced so the /metrics journal gauges see through this

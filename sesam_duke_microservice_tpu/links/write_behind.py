@@ -248,6 +248,17 @@ class WriteBehindBuffer:
                 self._cv.wait()
             self._raise_latched()
 
+    def latch(self, error: BaseException) -> None:
+        """Latch ``error`` from outside the flusher (the overlapped
+        recovery thread, ISSUE 15): every later add/commit/drain raises,
+        exactly as a terminal flush failure would — a wrapper whose
+        startup replay failed must never silently serve writes over a
+        store missing acked batches."""
+        with self._cv:
+            if self._error is None:
+                self._error = error
+            self._cv.notify_all()
+
     def close(self) -> None:
         """Drain (best-effort past a latched failure) and stop the
         flusher thread.  Does NOT close whatever ``flush`` writes to —
@@ -289,6 +300,14 @@ class WriteBehindLinkDatabase(LinkDatabase):
             name="link write-behind", seal=self._seal_batch,
             retries=_flush_retries,
         )
+        # recovery-overlap fence (ISSUE 15): set = no startup replay in
+        # flight.  Writes (and the ingest-path reads that FEED writes)
+        # wait on it; feed/monitoring reads deliberately do not — they
+        # serve the replay's committed prefix behind the X-Recovering
+        # staleness header.
+        self._recovered = threading.Event()
+        self._recovered.set()
+        self._recovery_thread: Optional[threading.Thread] = None
 
     def _seal_batch(self, links: List[Link]):
         """Batch-sealing hook (runs inside ``commit()``): journal the
@@ -355,6 +374,84 @@ class WriteBehindLinkDatabase(LinkDatabase):
             )
         return len(batches)
 
+    def recover_async(self, scope: str = "") -> int:
+        """Overlapped startup recovery (ISSUE 15): replay journaled-but-
+        unapplied batches on a background thread while feed/monitoring
+        reads serve the growing committed prefix.  Returns immediately
+        with 0 when there is a backlog (the thread owns the count), or
+        runs the (cheap) recovery inline when there is nothing to
+        replay.
+
+        Safety argument, in one place:
+
+          * **Writes fence** — ``assert_link``/``assert_links``/
+            ``commit`` (and the ingest-path reads below) block until
+            replay completes, so no new batch can interleave with — or
+            be journaled behind, yet applied before — the replayed
+            backlog; arrival order is preserved exactly as serial
+            recovery preserves it.
+          * **Reads see a monotonic prefix** — replay applies whole
+            batches in arrival order inside chunked transactions on its
+            own sqlite connection, so a concurrent feed read observes
+            complete batches only, each page extending the last (no
+            torn batch, no duplicate — the idempotent assert skips
+            identical re-asserts without a timestamp bump).
+          * **Ingest-path reads fence too** — ``get_all_links_for`` /
+            ``get_links_for_ids`` / ``get_all_links`` feed retraction
+            and one-to-one decisions; a prefix read there could miss a
+            link the replay was about to restore, so they wait exactly
+            like writes.  The feed/monitoring reads
+            (``get_changes_since``/``get_changes_page``/``count``)
+            stay overlap-served.
+          * **Failure latches** — a replay error latches the buffer
+            (``WriteBehindBuffer.latch``), so the fence lifting can
+            never silently serve writes over a store missing acked
+            batches.
+
+        The recovery scope is marked on THIS thread before returning,
+        so a readiness probe can never observe the wrapper serving with
+        the replay thread not yet started."""
+        from . import journal as journal_mod
+
+        if self.journal is None or self.journal.pending_batches == 0:
+            return self.recover()
+        self._recovered.clear()
+        journal_mod.recovery_begin(scope)
+        t = threading.Thread(
+            target=self._recover_overlapped, args=(scope,), daemon=True,
+            name="link-recovery",
+        )
+        self._recovery_thread = t
+        t.start()
+        return 0
+
+    def _recover_overlapped(self, scope: str) -> None:
+        from . import journal as journal_mod
+
+        try:
+            self.recover()
+        except BaseException as e:
+            logger.exception(
+                "overlapped journal recovery failed; latching the "
+                "wrapper (writes refused until restart)")
+            self._wb.latch(e)
+        finally:
+            journal_mod.recovery_end(scope)
+            self._recovered.set()
+
+    @property
+    def recovering(self) -> bool:
+        """True while an overlapped startup replay is in flight (the
+        write fence is up; reads serve the committed prefix)."""
+        return not self._recovered.is_set()
+
+    def _await_recovery(self) -> None:
+        # the write fence: bounded by the replay duration (finite), and
+        # a replay failure sets the event after latching, so waiters
+        # surface the latched error instead of hanging
+        if not self._recovered.is_set():
+            self._recovered.wait()
+
     @property
     def flush_error(self) -> Optional[BaseException]:
         """The latched background-flush failure, or None (read lock-free
@@ -368,31 +465,38 @@ class WriteBehindLinkDatabase(LinkDatabase):
     def _queue(self) -> deque:
         return self._wb._queue  # dukecheck: ignore[DK202] test introspection handle; callers must hold _wb._cv to iterate
 
-    # -- writes (buffered, arrival order) ------------------------------------
+    # -- writes (buffered, arrival order; fenced during recovery) ------------
 
     def assert_link(self, link: Link) -> None:
+        self._await_recovery()
         self._wb.add(link)
 
     def assert_links(self, links: List[Link]) -> None:
+        self._await_recovery()
         self._wb.add_many(links)
 
     def commit(self) -> None:
+        self._await_recovery()
         self._wb.commit()
 
     def drain(self) -> None:
         self._wb.drain()
 
-    # -- reads (drain first) -------------------------------------------------
+    # -- reads (drain first; the ingest-path reads fence during recovery
+    # because their results feed writes — see recover_async) -----------------
 
     def get_all_links_for(self, record_id: str) -> List[Link]:
+        self._await_recovery()
         self.drain()
         return self.inner.get_all_links_for(record_id)
 
     def get_links_for_ids(self, record_ids) -> List[Link]:
+        self._await_recovery()
         self.drain()
         return self.inner.get_links_for_ids(record_ids)
 
     def get_all_links(self) -> List[Link]:
+        self._await_recovery()
         self.drain()
         return self.inner.get_all_links()
 
@@ -417,6 +521,11 @@ class WriteBehindLinkDatabase(LinkDatabase):
 
     def close(self) -> None:
         try:
+            # an in-flight overlapped replay finishes first: interrupting
+            # it is crash-safe (the journal keeps the backlog for the
+            # next start) but a graceful shutdown should leave the store
+            # caught up and the journal compacted
+            self._await_recovery()
             self._wb.close()
         finally:
             # journal and inner store close even if the drain blew up —

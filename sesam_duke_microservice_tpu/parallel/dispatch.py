@@ -1175,6 +1175,17 @@ class _FollowerSession:
             replica_db.load_snapshot(self._pending_links.pop(key, []),
                                      asm.meta.get("link_seq", 0))
             self.link_replicas[key] = replica_db
+            # failover starts hot (ISSUE 15): warm the bootstrapped
+            # replica's scorer ladder NOW — AOT deserialization plus
+            # background miss-fill through the same path a cold start
+            # uses — so an eventual promotion (adopt_workload's
+            # processor re-runs the same no-op-when-warm call) serves
+            # its first post-failover batches without first-contact
+            # compile stalls
+            cache = getattr(self.replicas[key].index, "scorer_cache",
+                            None)
+            if cache is not None:
+                cache.prewarm_async(kind == "recordlinkage")
         elif tag == "links":
             # one committed link batch (scoring matches, retractions,
             # one-to-one rewrites — in the leader's arrival order): fold

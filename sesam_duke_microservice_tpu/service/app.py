@@ -50,7 +50,7 @@ from ..engine.scheduler import (
 )
 from ..engine.workload import Workload, build_workload
 from ..telemetry import tracing
-from ..telemetry.env import env_str
+from ..telemetry.env import env_flag, env_str
 from ..telemetry.logctx import new_request_id, request_id_var
 from . import debug as debug_api
 from .homepage import render_homepage
@@ -193,6 +193,12 @@ class DukeApp:
         self._close_lock = threading.Lock()
         self._closed = False  # guarded by: self._close_lock [writes]
         self._close_done = threading.Event()
+        # cold-start observability (ISSUE 15): stamped once by whichever
+        # handler thread serves the first successful scoring batch.
+        # Plain flag (GIL-atomic; a tied race would double-set a
+        # near-identical value, harmless) — service/ is not a metrics
+        # hot module, and the gauge is the measured time-to-first-200.
+        self._first_batch_served = False
         if prebuilt is not None:
             # leader-failover promotion (parallel.dispatch
             # .promote_follower): the workloads already exist — built
@@ -237,35 +243,72 @@ class DukeApp:
                     out[f"{kind}/{name}"] = repr(err)
         return out
 
+    def recovering(self) -> bool:
+        """Whether any of THIS app's workloads is still replaying its
+        link journal.  Scoped per workload data folder (ISSUE 14):
+        another serving group's replay in the same process does not
+        count.  Runs on every HTTP response (the X-Recovering header),
+        so the steady-state path is ONE process-wide bool check — the
+        per-folder scoping work only runs while some replay, somewhere,
+        is actually active."""
+        from ..links import journal as link_journal
+
+        if not link_journal.recovery_active(None):
+            return False  # nothing recovering anywhere: the common case
+        if self.config is None:
+            return True
+        folders = [
+            wc.data_folder
+            for wc in (list(self.config.deduplications.values())
+                       + list(self.config.record_linkages.values()))
+            if wc.data_folder
+        ]
+        if not folders:
+            return link_journal.recovery_active("")
+        return any(link_journal.recovery_active(f) for f in folders)
+
+    def note_first_batch(self) -> None:
+        """Stamp ``duke_cold_start_seconds`` on the first successfully
+        served scoring batch (time-to-first-200, ISSUE 15)."""
+        if not self._first_batch_served:
+            self._first_batch_served = True
+            telemetry.COLD_START_SECONDS.set(
+                time.monotonic() - self.started_monotonic)
+
+    def prewarm_errors(self) -> Dict[str, str]:
+        """Latched scorer pre-warm failures by workload (ISSUE 15
+        satellite): a silently-cold replica — scoring works, but every
+        first-contact shape pays a live compile — used to be findable
+        only in logs; /healthz now names the last error.  Lock-free
+        reads of the caches' error slots."""
+        out: Dict[str, str] = {}
+        for kind, registry in (("deduplication", self.deduplications),
+                               ("recordlinkage", self.record_linkages)):
+            for name, wl in registry.items():
+                cache = getattr(wl.index, "scorer_cache", None)
+                err = getattr(cache, "_warm_error", None)
+                if err is not None:
+                    out[f"{kind}/{name}"] = err
+        return out
+
     def readiness(self) -> Tuple[bool, Dict[str, bool]]:
         """GET /readyz substance: config parsed, every configured workload
         built and swapped in, (non-host backends) the device backend
         initialized with at least one device, no workload's write-behind
         link persistence latched on a flush failure, and no link-journal
         recovery replay still running (ISSUE 10: /readyz answers
-        ``recovering`` until startup replay completes, so orchestrators
-        never route traffic at a link DB that is still being redone)."""
-        from ..links import journal as link_journal
-
+        ``recovering`` until startup replay completes).  With overlapped
+        recovery (ISSUE 15, default) a recovering app still serves reads
+        — ``write_ready`` is the key that flips only after replay
+        completes, and the HTTP layer answers 200 ``recovering`` so
+        orchestrators can route read traffic while writes 503."""
         checks = {"config_loaded": self.config is not None}
         # recovery is scoped per workload data folder (ISSUE 14): this
         # app goes "recovering" only for replays of ITS OWN workloads'
         # journals (plus anonymous process-wide entries) — another
         # serving group's replay in the same process no longer flips
         # every group's /readyz
-        if self.config is not None:
-            folders = [
-                wc.data_folder
-                for wc in (list(self.config.deduplications.values())
-                           + list(self.config.record_linkages.values()))
-                if wc.data_folder
-            ]
-            recovering = (any(link_journal.recovery_active(f)
-                              for f in folders)
-                          if folders else link_journal.recovery_active(""))
-        else:
-            recovering = link_journal.recovery_active()
-        checks["recovery_complete"] = not recovering
+        checks["recovery_complete"] = not self.recovering()
         checks["workloads_built"] = bool(
             self.config is not None
             and set(self.deduplications) == set(self.config.deduplications)
@@ -276,6 +319,11 @@ class DukeApp:
         else:
             checks["device_backend"] = backend_info()[1] > 0
         checks["link_persistence"] = not self.link_flush_errors()
+        # the read/write readiness split (ISSUE 15): during overlapped
+        # recovery reads serve (the whole app is read-ready whenever
+        # everything but the replay checks out) while writes stay fenced
+        checks["write_ready"] = (checks["recovery_complete"]
+                                 and checks["link_persistence"])
         return all(checks.values()), checks
 
     @property
@@ -567,6 +615,12 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.send_header("X-Request-Id", self.request_id)
         self.send_header("X-Trace-Id", self.trace_id)
+        # staleness contract during overlapped recovery (ISSUE 15):
+        # every response — feeds, /stats, /metrics, errors — carries the
+        # header while this app's journal replay runs, so a reader can
+        # tell "prefix of the recovered state" from "caught up"
+        if self.app is not None and self.app.recovering():
+            self.send_header("X-Recovering", "1")
         for k, v in (extra_headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -653,6 +707,11 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
             flush_errors = self.app.link_flush_errors()
             if flush_errors:
                 health["link_flush_errors"] = flush_errors
+            # a silently-cold replica is diagnosable (ISSUE 15
+            # satellite): the last scorer pre-warm failure per workload
+            prewarm_errors = self.app.prewarm_errors()
+            if prewarm_errors:
+                health["prewarm_errors"] = prewarm_errors
             self._reply(200, json.dumps(health).encode("utf-8"),
                         "application/json")
         elif path == "/readyz":
@@ -707,6 +766,7 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
 
     def _handle_readyz(self) -> None:
         ready, checks = self.app.readiness()
+        http_status = 200 if ready else 503
         if ready:
             status = "ready"
         elif not checks.get("recovery_complete", True):
@@ -714,12 +774,23 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
             # orchestrators (and humans) can tell "redoing the link log"
             # from a genuinely broken dependency
             status = "recovering"
+            # overlapped recovery (ISSUE 15, default on): reads already
+            # serve the replay's committed prefix, so when the replay is
+            # the ONLY thing unready, /readyz answers 200 — the
+            # "recovering" 503 window shrinks to the write path (POSTs
+            # 503 per-request until write_ready flips).  The legacy
+            # serial mode keeps the whole-app 503.
+            read_ready = all(v for k, v in checks.items()
+                             if k not in ("recovery_complete",
+                                          "write_ready"))
+            if read_ready and env_flag("DUKE_RECOVERY_OVERLAP", True):
+                http_status = 200
         else:
             status = "unready"
         body = json.dumps(
             {"status": status, "checks": checks}
         ).encode("utf-8")
-        self._reply(200 if ready else 503, body, "application/json")
+        self._reply(http_status, body, "application/json")
 
     def _handle_metrics(self) -> None:
         body = telemetry.render(
@@ -882,6 +953,8 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                 raise _HttpError(400, "Batch elements must be JSON objects")
 
         kind, workload, dataset_id, transform = self._validate_entity_path(m)
+        if not transform:
+            self._check_write_fence(kind, m.group(2), workload)
         sched = self.app.scheduler
         if sched is not None and not transform:
             # continuous microbatching (ISSUE 6): the scheduler coalesces
@@ -945,7 +1018,29 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
             out = rows[0] if single and len(rows) == 1 else rows
             self._reply(200, json.dumps(out).encode("utf-8"))
         else:
+            # time-to-first-200 (ISSUE 15): the cold-start gauge stamps
+            # on the first successfully served scoring batch
+            self.app.note_first_batch()
             self._reply(200, b'{"success": true}')
+
+    def _check_write_fence(self, kind: str, name: str, workload) -> None:
+        """503 a scoring POST while this workload's link journal is
+        still replaying (overlapped recovery, ISSUE 15): the wrapper
+        itself would fence the write anyway — blocking the handler
+        thread for the whole replay — so the HTTP layer answers fast
+        with Retry-After instead.  Reads are unaffected."""
+        db = workload.link_database
+        if getattr(db, "recovering", False):
+            label = _kind_label(kind)
+            # no explicit X-Recovering here: _reply adds it for every
+            # response while the app recovers, and this error only fires
+            # then — a second copy would duplicate the header
+            raise _HttpError(
+                503,
+                f"The {label} '{name}' is replaying its link journal; "
+                "writes resume when recovery completes.",
+                extra_headers={"Retry-After": "1"},
+            )
 
     def _handle_feed(self, m, query) -> None:
         """Stream the incremental link feed in bounded pages.
@@ -1058,6 +1153,10 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                     self.send_header("Transfer-Encoding", "chunked")
                     self.send_header("X-Request-Id", self.request_id)
                     self.send_header("X-Trace-Id", self.trace_id)
+                    # staleness signal: this stream is a monotonic
+                    # PREFIX of the recovered feed while replay runs
+                    if self.app.recovering():
+                        self.send_header("X-Recovering", "1")
                     self.end_headers()
                     self._write_chunk(b"[")
                     started = True
@@ -1136,6 +1235,8 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
             )
         from ..engine.rematch import ring_rematch
 
+        # bulk re-match writes the link DB: same recovery fence as ingest
+        self._check_write_fence(kind, name, workload)
         with workload.lock:
             if workload.closed:
                 raise _BusyError(label)

@@ -201,6 +201,7 @@ def make_app_collector(app):
         journal_byte_samples = []
         queue_samples = []
         warm_samples = []
+        warm_seconds_samples = []
         finalize_samples = []
         finalize_threads = []
         dd_residue_samples = []
@@ -329,6 +330,8 @@ def make_app_collector(app):
             if cache is not None:
                 warm_samples.append(
                     ("", labels, getattr(cache, "_warm_compiled", 0)))
+                warm_seconds_samples.append(
+                    ("", labels, getattr(cache, "_warm_seconds", 0.0)))
 
         # ingest-scheduler families (ISSUE 6): scrape-time snapshots of
         # the scheduler's single-writer tenant-queue counters — the
@@ -468,6 +471,12 @@ def make_app_collector(app):
                 "duke_prewarm_compiles", "gauge",
                 "Successful background AOT scorer compiles",
                 warm_samples))
+            out.append(FamilySnapshot(
+                "duke_prewarm_seconds", "gauge",
+                "Duration of the last AOT ladder load pass for this "
+                "workload's scorer cache (the synchronous deserialize "
+                "that makes a restart's first batch compile-free)",
+                warm_seconds_samples))
         if finalize_samples:
             out.append(FamilySnapshot(
                 "duke_finalize_pairs_total", "counter",

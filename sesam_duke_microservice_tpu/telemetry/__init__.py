@@ -55,6 +55,29 @@ JIT_CACHE_HITS = GLOBAL.counter(
     "Scorer lookups served from the in-process jit program cache",
     locked=False,
 )
+# AOT executable cache (ISSUE 15: utils/jit_cache.AotStore).  Loads run
+# at startup / on shape-fingerprint changes, never per block, so the
+# labeled family is fine; the per-call fast path only bumps
+# JIT_CACHE_HITS above.
+AOT_LOADS = GLOBAL.counter(
+    "duke_aot_loads_total",
+    "Plan-keyed AOT executable-store load attempts by outcome (hit = "
+    "deserialized and serving, miss = no entry for the key, reject = "
+    "entry present but unusable — recompiled and re-saved by the warm "
+    "thread)",
+    ("outcome",),
+)
+PREWARM_FAILURES = GLOBAL.counter(
+    "duke_prewarm_failures_total",
+    "Scorer pre-warm / AOT warm-thread failures: scoring still works but "
+    "the replica is silently cold (first-contact shapes pay live "
+    "compiles).  The last error is surfaced in /healthz detail.",
+)
+COLD_START_SECONDS = GLOBAL.gauge(
+    "duke_cold_start_seconds",
+    "Seconds from service construction to the first successfully served "
+    "scoring batch (time-to-first-200; 0 until a batch lands)",
+)
 
 # -- device corpus growth (engine/device_matcher.py) -------------------------
 # Process-wide (not per-corpus) so value-slot rebuilds — which replace the

@@ -25,6 +25,22 @@ def atomic_write_json(path: str, doc) -> None:
         json.dump(doc, f)
         f.flush()
         os.fsync(f.fileno())
+    _replace_and_sync_dir(tmp, path)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Binary flavor of the same dance (AOT executable-store entries:
+    a torn entry would deserialize-fail every restart until overwritten,
+    turning a crashed save into a permanent cache reject)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    _replace_and_sync_dir(tmp, path)
+
+
+def _replace_and_sync_dir(tmp: str, path: str) -> None:
     os.replace(tmp, path)
     # durability of the rename itself (see module docstring); best-effort
     # where the platform can't open directories

@@ -1,29 +1,60 @@
-"""Persistent XLA compilation cache.
+"""Compiled-program caching: persistent XLA cache + plan-keyed AOT store.
 
 The matcher's jitted programs recompile on corpus-capacity growth and
 candidate-K escalation (O(log N) distinct shapes over a corpus's lifetime,
 engine.device_matcher).  On TPU each compile costs tens of seconds, which
-dominates cold-start and first-contact-with-new-shape latency.  Enabling
-jax's persistent compilation cache amortizes that across process restarts —
-the service counterpart of the reference reopening its Lucene index in
-APPEND mode instead of rebuilding (IncrementalLuceneDatabase.java:233-244),
-applied to compiled programs instead of data.
+dominates cold-start and first-contact-with-new-shape latency.  Two
+layers remove that cost (the service counterpart of the reference
+reopening its Lucene index in APPEND mode instead of rebuilding —
+IncrementalLuceneDatabase.java:233-244 — applied to compiled programs
+instead of data):
+
+  * ``enable_persistent_cache`` points jax's persistent compilation
+    cache at disk, so an XLA *compile* of an already-seen program is a
+    cache read.  The first contact with a shape still pays trace +
+    lower + cache lookup.
+  * ``AotStore`` (ISSUE 15) goes further: whole compiled executables —
+    serialized via ``jax.experimental.serialize_executable`` — persist
+    on disk keyed by (plan fingerprint, shape tuple, backend,
+    jax/jaxlib version, scoring-code hash).  A restart *deserializes*
+    the scorer ladder instead of compiling it: zero traces, zero XLA
+    invocations before the first scoring batch
+    (``tests/test_aot_cache.py`` pins restart-compiles-zero via the
+    ``JIT_COMPILES`` counter).
+
+Invalidation is entirely key-derivation: any change to the feature plan
+(widths, comparators, probabilities), the ladder geometry (chunk, K,
+buckets), the backend/device kind, the jax/jaxlib version, or the
+scoring source itself produces a different key — a stale entry is never
+*wrong*, only unreachable (and the warm thread re-fills the new key).
+Entries that exist but fail to deserialize (foreign runtime, torn file
+predating atomic writes, PJRT drift) count as ``reject`` and fall back
+to the compile path.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
 import os
+import pickle
 from typing import Optional
 
-from ..telemetry import JIT_CACHE_HITS, JIT_COMPILES
-from ..telemetry.env import env_str
+from ..telemetry import AOT_LOADS, JIT_CACHE_HITS, JIT_COMPILES
+from ..telemetry.env import env_flag, env_float, env_str
 
 logger = logging.getLogger("jit-cache")
 
 _DEFAULT = os.path.join(
     os.path.expanduser("~"), ".cache", "sesam_duke_tpu_xla"
 )
+
+# AOT-load outcome children pre-resolved at import (closed label set,
+# same DK501 discipline as the device matcher's bucket children)
+_AOT_HIT = AOT_LOADS.labels(outcome="hit")  # dukecheck: ignore[DK501] init-time pre-resolution
+_AOT_MISS = AOT_LOADS.labels(outcome="miss")  # dukecheck: ignore[DK501] init-time pre-resolution
+_AOT_REJECT = AOT_LOADS.labels(outcome="reject")  # dukecheck: ignore[DK501] init-time pre-resolution
 
 
 def record_compile(n: int = 1) -> None:
@@ -35,8 +66,15 @@ def record_compile(n: int = 1) -> None:
 
 
 def record_cache_hit(n: int = 1) -> None:
-    """Count a scorer lookup served from the in-process program cache."""
+    """Count a scorer lookup served from the in-process program cache
+    (jitted-function reuse or a registered AOT executable)."""
     JIT_CACHE_HITS.inc(n)
+
+
+def record_aot_reject(n: int = 1) -> None:
+    """Count a registered AOT executable rejected at call time (shape
+    drift after it was built) — the caller falls back to the jit path."""
+    _AOT_REJECT.inc(n)
 
 
 def enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:
@@ -44,6 +82,12 @@ def enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:
 
     Safe to call multiple times; a failure (read-only fs, old jax) only
     logs — the cache is an optimization, never a requirement.
+
+    ``DUKE_JIT_CACHE_MIN_SECS`` sets the persistence floor (jax's
+    ``jax_persistent_cache_min_compile_time_secs``).  The historical
+    hard-coded 1.0 s meant CPU-lowered programs — which compile in
+    milliseconds — never persisted, so the cache path was untestable in
+    CI; tests and CPU deployments set it to 0.
     """
     import jax
 
@@ -51,8 +95,168 @@ def enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            env_float("DUKE_JIT_CACHE_MIN_SECS", 1.0),
+        )
         return path
     except Exception as exc:  # pragma: no cover - depends on fs/jax version
         logger.warning("persistent compilation cache disabled: %s", exc)
         return None
+
+
+# -- plan-keyed AOT executable store (ISSUE 15) -------------------------------
+
+
+def aot_enabled() -> bool:
+    """``DUKE_AOT`` gates the executable store (default on); =0 pins the
+    legacy jit-only path exactly (the CI opt-out leg)."""
+    return env_flag("DUKE_AOT", True)
+
+
+def aot_dir() -> str:
+    return env_str("DUKE_AOT_DIR") or os.path.join(_DEFAULT, "aot")
+
+
+_CODE_FP: Optional[str] = None
+
+
+def code_fingerprint() -> str:
+    """Hash of the scoring-relevant sources (the ``ops`` package plus
+    the matcher modules).  Any edit to kernel/scoring/feature code
+    yields new store keys, so an AOT entry can never serve HLO compiled
+    from different source — the invalidation rule README documents.
+    Computed once per process."""
+    global _CODE_FP
+    if _CODE_FP is None:
+        from .. import core, engine, ops
+
+        h = hashlib.sha256()
+        roots = [os.path.dirname(ops.__file__),
+                 os.path.dirname(engine.__file__),
+                 os.path.dirname(core.__file__)]
+        for root in roots:
+            for name in sorted(os.listdir(root)):
+                if not name.endswith(".py"):
+                    continue
+                with open(os.path.join(root, name), "rb") as f:
+                    h.update(name.encode("utf-8"))
+                    h.update(f.read())
+        _CODE_FP = h.hexdigest()[:16]
+    return _CODE_FP
+
+
+def environment_fingerprint() -> dict:
+    """The runtime facets a serialized executable is only valid under:
+    backend platform + device kind (a CPU executable must never load
+    into a TPU process and vice versa), jax/jaxlib versions (PJRT
+    serialization formats drift), and the XLA flags (they change
+    codegen, e.g. the forced host device count)."""
+    import jax
+    import jaxlib
+
+    devices = jax.devices()
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "platform": jax.default_backend(),
+        "device_kind": devices[0].device_kind if devices else "none",
+        "device_count": len(devices),
+        "xla_flags": env_str("XLA_FLAGS", "") or "",
+        "code": code_fingerprint(),
+    }
+
+
+class AotStore:
+    """On-disk store of serialized compiled executables.
+
+    One file per (plan, shape, backend, version) key: the key dict is
+    canonical-JSON-hashed into the filename, and the file holds a pickle
+    of ``(key, payload, in_tree, out_tree)`` where payload/trees come
+    from ``jax.experimental.serialize_executable.serialize``.  Writes
+    are crash-atomic (``utils.atomicio``); concurrent savers of the same
+    key race benignly (identical content, last replace wins).  No lock:
+    load/save are pure file ops keyed by immutable content.
+    """
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = root or aot_dir()
+        self._env = None  # environment fingerprint, resolved lazily
+
+    def _key_doc(self, key: dict) -> dict:
+        if self._env is None:
+            self._env = environment_fingerprint()
+        doc = dict(key)
+        doc["__env__"] = self._env
+        return doc
+
+    def _path(self, key: dict) -> str:
+        blob = json.dumps(self._key_doc(key), sort_keys=True,
+                          separators=(",", ":"), default=str)
+        digest = hashlib.sha256(blob.encode("utf-8")).hexdigest()
+        return os.path.join(self.root, digest + ".aotx")
+
+    def save(self, key: dict, compiled) -> bool:
+        """Serialize ``compiled`` under ``key``; False (logged once per
+        cause) when the backend/executable does not support
+        serialization — saving is an optimization, never a requirement."""
+        from jax.experimental import serialize_executable as se
+
+        from .atomicio import atomic_write_bytes
+
+        try:
+            payload, in_tree, out_tree = se.serialize(compiled)
+            # round-trip validation BEFORE the write: an executable whose
+            # XLA compile was served from jax's persistent compilation
+            # cache serializes THIN (the payload references jit symbols
+            # it does not carry — observed as "Symbols not found" at
+            # deserialize).  Persisting one would reject on every future
+            # restart; refusing the save leaves the entry to a fresh
+            # compile instead (the warm thread compiles cache-bypassed
+            # for exactly this reason).
+            se.deserialize_and_load(payload, in_tree, out_tree)
+            blob = pickle.dumps(
+                (self._key_doc(key), payload, in_tree, out_tree),
+                protocol=pickle.HIGHEST_PROTOCOL,
+            )
+            os.makedirs(self.root, exist_ok=True)
+            atomic_write_bytes(self._path(key), blob)
+            return True
+        except Exception as exc:
+            logger.warning("AOT executable save failed for %s: %s",
+                           key, exc)
+            return False
+
+    def load(self, key: dict):
+        """Deserialize the executable stored under ``key``, or None.
+
+        Outcomes land in ``duke_aot_loads_total``: hit (loaded), miss
+        (no file), reject (file present but key-mismatched or
+        undeserializable — deleted so the warm thread's re-save isn't
+        rejected forever)."""
+        from jax.experimental import serialize_executable as se
+
+        path = self._path(key)
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            _AOT_MISS.inc()
+            return None
+        try:
+            stored_key, payload, in_tree, out_tree = pickle.loads(blob)
+            if stored_key != self._key_doc(key):
+                raise ValueError("stored key mismatch (hash collision?)")
+            loaded = se.deserialize_and_load(payload, in_tree, out_tree)
+        except Exception as exc:
+            _AOT_REJECT.inc()
+            logger.warning(
+                "rejecting AOT executable %s (%s); it will be recompiled "
+                "and re-saved", path, exc)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None
+        _AOT_HIT.inc()
+        return loaded

@@ -22,6 +22,18 @@ os.environ.setdefault("DEVICE_MAX_GRAMS", "24")
 # background compile pre-warm off by default in tests (it competes with the
 # slow CPU-interpret compiles); test_device_matcher re-enables it explicitly
 os.environ.setdefault("DEVICE_PREWARM", "0")
+# AOT executable store (ISSUE 15): point at a session-scoped temp dir so
+# test runs never write the operator's ~/.cache (subprocess-differential
+# tests pin their own DUKE_AOT_DIR); removed at interpreter exit so dev
+# boxes don't accumulate serialized-executable dirs across runs
+import atexit  # noqa: E402
+import shutil  # noqa: E402
+import tempfile  # noqa: E402
+
+if "DUKE_AOT_DIR" not in os.environ:
+    _aot_tmp = tempfile.mkdtemp(prefix="duke-aot-tests-")
+    os.environ["DUKE_AOT_DIR"] = _aot_tmp
+    atexit.register(shutil.rmtree, _aot_tmp, True)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,423 @@
+"""Recovery-overlapped serving (ISSUE 15).
+
+Invariants held here:
+
+  * feed reads during an in-flight journal replay return a MONOTONIC
+    PREFIX of the recovered feed — whole batches only, each page
+    extending the last, no duplicate;
+  * writes (and the ingest-path reads that feed them) fence until the
+    replay completes; ``/readyz`` flips ``write_ready`` only then, while
+    the HTTP layer serves reads at 200 ``recovering`` behind the
+    ``X-Recovering`` staleness header;
+  * the ``crash_at`` chaos differential converges bit-identical with
+    overlap explicitly enabled AND explicitly disabled;
+  * a replay failure latches the wrapper (writes refused, never
+    silently served over a store missing acked batches).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from sesam_duke_microservice_tpu.links import journal as journal_mod
+from sesam_duke_microservice_tpu.links.base import Link, LinkKind, LinkStatus
+from sesam_duke_microservice_tpu.links.journal import (
+    LinkJournal,
+    recovery_in_progress,
+)
+from sesam_duke_microservice_tpu.links.replica import encode_link
+from sesam_duke_microservice_tpu.links.sqlite import SqliteLinkDatabase
+from sesam_duke_microservice_tpu.links.write_behind import (
+    WriteBehindLinkDatabase,
+)
+from sesam_duke_microservice_tpu.service.app import serve
+from sesam_duke_microservice_tpu.utils import faults
+
+from test_crash_recovery import (
+    CHILD,
+    N_BATCHES,
+    _durable_app,
+    _ingest,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_env_faults():
+    faults.configure("")
+    yield
+    faults.configure(None)
+
+
+def L(id1, id2, conf=0.9, ts=None):
+    return Link(id1, id2, LinkStatus.INFERRED, LinkKind.DUPLICATE, conf, ts)
+
+
+def _backlog_journal(path, n, t0=1_000_000):
+    """A journal holding ``n`` acked-but-unapplied single-link batches
+    (sequential timestamps so the recovered feed order is known)."""
+    j = LinkJournal(str(path), sync="none")
+    for i in range(n):
+        j.append_batch([encode_link(L(f"a{i}", f"b{i}", 0.9, t0 + i))])
+    j.close()
+    return str(path)
+
+
+class GatedSqlite(SqliteLinkDatabase):
+    """Inner store whose REPLAY writes step through a semaphore while
+    gating is on — the test releases one permit per replay chunk,
+    making the overlap window deterministic.  Only the recovery thread
+    gates: once the fence lifts, a post-replay write's background flush
+    lands here too and must not steal a replay chunk's permit."""
+
+    def __init__(self, path):
+        super().__init__(path)
+        self.gate = threading.Semaphore(0)
+        self.gating = True
+
+    def assert_links(self, links):
+        if self.gating and threading.current_thread().name == "link-recovery":
+            assert self.gate.acquire(timeout=60)
+        super().assert_links(links)
+
+
+class TestLinksLayerOverlap:
+    def test_monotonic_prefix_reads_and_write_fence(self, tmp_path):
+        n = 600  # 3 replay chunks of 256
+        jpath = _backlog_journal(tmp_path / "links.journal", n)
+        inner = GatedSqlite(str(tmp_path / "links.sqlite"))
+        journal = LinkJournal(jpath)
+        assert journal.pending_batches == n
+        db = WriteBehindLinkDatabase(inner, journal=journal)
+        try:
+            db.recover_async(scope="overlap-test")
+            assert db.recovering is True
+            assert journal_mod.recovery_active("overlap-test") is True
+
+            # writes fence: a committer blocks until replay completes
+            wrote = threading.Event()
+
+            def writer():
+                db.assert_link(L("new1", "new2", 0.5))
+                db.commit()
+                wrote.set()
+
+            wt = threading.Thread(target=writer, daemon=True)
+            wt.start()
+            time.sleep(0.1)
+            assert not wrote.is_set()  # fenced
+
+            # reads serve the growing committed prefix, whole chunks only
+            expected = [(f"a{i}", f"b{i}") for i in range(n)]
+            seen = []
+            released = 0
+            while released < 3:
+                inner.gate.release()
+                released += 1
+                want = min(released * 256, n)
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    rows = db.get_changes_page(0, n + 10)
+                    pairs = [(lk.id1, lk.id2) for lk in rows
+                             if lk.id1.startswith("a")]
+                    if len(pairs) >= want:
+                        break
+                    # never a torn chunk: only whole-chunk sizes appear
+                    assert len(pairs) in (0, 256, 512), pairs
+                    time.sleep(0.01)
+                # monotonic prefix of the recovered feed, no dup/reorder
+                assert pairs == expected[:len(pairs)]
+                assert pairs[:len(seen)] == seen
+                seen = pairs
+
+            inner.gating = False
+            assert wrote.wait(timeout=30)  # fence lifted with the replay
+            assert db.recovering is False
+            assert journal_mod.recovery_active("overlap-test") is False
+            db.drain()
+            rows = db.get_all_links()
+            pairs = {(lk.id1, lk.id2) for lk in rows}
+            assert pairs == set(expected) | {("new1", "new2")}
+            # the post-fence write journaled AFTER the replayed head
+            assert journal.applied_watermark() >= n
+        finally:
+            db.close()
+
+    def test_publisher_wrapper_sees_through_recovering(self, tmp_path):
+        """The HA leader's PublishingLinkDatabase must expose the
+        wrapped write-behind DB's recovering flag — the HTTP write
+        fence probes the OUTERMOST wrapper, and a False there would
+        turn the fast 503 back into a handler thread blocked for the
+        whole replay window."""
+        from sesam_duke_microservice_tpu.links.replica import (
+            PublishingLinkDatabase,
+        )
+
+        n = 300
+        jpath = _backlog_journal(tmp_path / "links.journal", n)
+        inner = GatedSqlite(str(tmp_path / "links.sqlite"))
+        journal = LinkJournal(jpath)
+        db = WriteBehindLinkDatabase(inner, journal=journal)
+        pub = PublishingLinkDatabase(db, lambda seq, rows: None)
+        try:
+            assert pub.recovering is False
+            db.recover_async(scope="pub-fence")
+            assert pub.recovering is True  # sees through to the wrapper
+            inner.gating = False
+            for _ in range(2):
+                inner.gate.release()
+            deadline = time.monotonic() + 30
+            while db.recovering and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert pub.recovering is False
+        finally:
+            db.close()
+
+    def test_no_backlog_recovers_inline(self, tmp_path):
+        inner = SqliteLinkDatabase(str(tmp_path / "links.sqlite"))
+        journal = LinkJournal(str(tmp_path / "links.journal"))
+        db = WriteBehindLinkDatabase(inner, journal=journal)
+        try:
+            db.recover_async(scope="inline")
+            assert db.recovering is False
+            assert db._recovery_thread is None
+            db.assert_link(L("x", "y"))
+            db.commit()
+            db.drain()
+        finally:
+            db.close()
+
+    def test_replay_failure_latches_writes(self, tmp_path):
+        jpath = _backlog_journal(tmp_path / "links.journal", 3)
+
+        class Broken(SqliteLinkDatabase):
+            def assert_links(self, links):
+                raise OSError("disk gone")
+
+        inner = Broken(str(tmp_path / "links.sqlite"))
+        journal = LinkJournal(jpath)
+        db = WriteBehindLinkDatabase(inner, journal=journal)
+        try:
+            db.recover_async(scope="latch")
+            deadline = time.monotonic() + 30
+            while db.recovering and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert db.recovering is False
+            with pytest.raises(RuntimeError, match="flush failed"):
+                db.assert_link(L("x", "y"))
+        finally:
+            db.close()
+
+    def test_ingest_path_reads_fence(self, tmp_path):
+        """get_links_for_ids feeds retraction decisions: a prefix read
+        there could miss a link replay was about to restore, so it
+        fences exactly like a write."""
+        n = 300
+        jpath = _backlog_journal(tmp_path / "links.journal", n)
+        inner = GatedSqlite(str(tmp_path / "links.sqlite"))
+        journal = LinkJournal(jpath)
+        db = WriteBehindLinkDatabase(inner, journal=journal)
+        try:
+            db.recover_async(scope="fence-reads")
+            got = []
+            done = threading.Event()
+
+            def reader():
+                got.extend(db.get_links_for_ids(["a0"]))
+                done.set()
+
+            t = threading.Thread(target=reader, daemon=True)
+            t.start()
+            time.sleep(0.1)
+            assert not done.is_set()  # fenced during replay
+            inner.gating = False
+            for _ in range(3):
+                inner.gate.release()
+            assert done.wait(timeout=30)
+            assert [(lk.id1, lk.id2) for lk in got] == [("a0", "b0")]
+        finally:
+            db.close()
+
+
+class TestHttpSurface:
+    def _gated_app(self, tmp_path, monkeypatch):
+        """A durable app whose startup replay is gated: ingest + close
+        seeds store/link rows, then synthetic re-assert batches are
+        journaled (confidence bumped, fresh timestamps) so the restart
+        has a real backlog of feed-visible work."""
+        # pin overlap mode: under the CI DUKE_RECOVERY_OVERLAP=0 leg the
+        # gated recover would otherwise block the whole app build
+        monkeypatch.setenv("DUKE_RECOVERY_OVERLAP", "1")
+        app1 = _durable_app(tmp_path)
+        _ingest(app1)
+        wl = app1.deduplications["people"]
+        links = wl.link_database.get_all_links()
+        assert links
+        app1.close()
+
+        folder = tmp_path / "deduplication" / "people"
+        j = LinkJournal(str(folder / "linkdatabase.journal"), sync="none")
+        now = int(time.time() * 1000)
+        for i, lk in enumerate(links):
+            bumped = Link(lk.id1, lk.id2, lk.status, lk.kind,
+                          0.4242, now + i)
+            j.append_batch([encode_link(bumped)])
+        j.close()
+
+        gate = threading.Event()
+        orig = WriteBehindLinkDatabase.recover
+
+        def gated(self):
+            assert gate.wait(timeout=120)
+            return orig(self)
+
+        monkeypatch.setattr(WriteBehindLinkDatabase, "recover", gated)
+        app2 = _durable_app(tmp_path)
+        monkeypatch.setattr(WriteBehindLinkDatabase, "recover", orig)
+        return app2, gate, links
+
+    def test_readyz_write_split_and_staleness_header(
+            self, tmp_path, monkeypatch):
+        app, gate, links = self._gated_app(tmp_path, monkeypatch)
+        server = serve(app, port=0, host="127.0.0.1")
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            assert app.recovering() is True
+
+            # /readyz: 200 "recovering" — reads are routable; writes not
+            with urllib.request.urlopen(base + "/readyz", timeout=30) as r:
+                assert r.status == 200
+                assert r.headers.get("X-Recovering") == "1"
+                body = json.loads(r.read())
+            assert body["status"] == "recovering"
+            assert body["checks"]["write_ready"] is False
+            assert body["checks"]["recovery_complete"] is False
+
+            # writes: fast 503 with Retry-After, not a hung handler
+            req = urllib.request.Request(
+                base + "/deduplication/people/crm", method="POST",
+                data=json.dumps(
+                    [{"_id": "z9", "name": "zeta person"}]).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=30)
+            assert exc.value.code == 503
+            assert exc.value.headers.get("Retry-After") == "1"
+            assert exc.value.headers.get("X-Recovering") == "1"
+            assert "replaying its link journal" in exc.value.read().decode()
+
+            # reads: feed serves the pre-replay prefix behind the header
+            with urllib.request.urlopen(
+                    base + "/deduplication/people?since=0", timeout=30) as r:
+                assert r.status == 200
+                assert r.headers.get("X-Recovering") == "1"
+                feed_before = json.loads(r.read())
+            assert {row["_id"] for row in feed_before}  # old links serve
+            assert all(row["confidence"] != 0.4242 for row in feed_before)
+
+            # /stats and /metrics carry the staleness header too
+            for path in ("/stats", "/metrics"):
+                with urllib.request.urlopen(base + path, timeout=30) as r:
+                    assert r.status == 200
+                    assert r.headers.get("X-Recovering") == "1"
+
+            gate.set()
+            deadline = time.monotonic() + 60
+            while app.recovering() and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert app.recovering() is False
+
+            with urllib.request.urlopen(base + "/readyz", timeout=30) as r:
+                assert r.status == 200
+                assert r.headers.get("X-Recovering") is None
+                body = json.loads(r.read())
+            assert body["status"] == "ready"
+            assert body["checks"]["write_ready"] is True
+
+            # the replayed batches are now feed-visible (bumped conf)...
+            with urllib.request.urlopen(
+                    base + "/deduplication/people?since=0", timeout=30) as r:
+                feed_after = json.loads(r.read())
+            assert any(row["confidence"] == 0.4242 for row in feed_after)
+            # ...and writes 200
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert r.status == 200
+        finally:
+            server.shutdown()
+            app.close()
+
+    def test_serial_mode_keeps_whole_app_503(self, tmp_path, monkeypatch):
+        """DUKE_RECOVERY_OVERLAP=0 pins the legacy contract: /readyz is
+        503 for the entire recovery window."""
+        monkeypatch.setenv("DUKE_RECOVERY_OVERLAP", "0")
+        app = _durable_app(tmp_path)
+        server = serve(app, port=0, host="127.0.0.1")
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            with recovery_in_progress():
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    urllib.request.urlopen(base + "/readyz", timeout=30)
+                assert exc.value.code == 503
+                assert json.loads(exc.value.read())["status"] == "recovering"
+        finally:
+            server.shutdown()
+            app.close()
+
+
+# -- chaos differential, overlap on AND off ----------------------------------
+
+
+def _run_child_env(data, *, overlap, fault="", start=0, dump=False,
+                   close=False):
+    env = dict(os.environ)
+    env["DUKE_FAULTS"] = fault
+    env["DUKE_JOURNAL"] = "1"
+    env["DUKE_RECOVERY_OVERLAP"] = overlap
+    env.pop("DUKE_FLUSH_RETRIES", None)
+    cmd = [sys.executable, CHILD, "--data", str(data),
+           "--backend", "host", "--start", str(start),
+           "--batches", str(N_BATCHES), "--linger", "0.0"]
+    if dump:
+        cmd.append("--dump")
+    if close:
+        cmd.append("--close")
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=180,
+                          env=env)
+    acks = [int(line.split()[1]) for line in proc.stdout.splitlines()
+            if line.startswith("ACK ")]
+    dumps = [json.loads(line[5:]) for line in proc.stdout.splitlines()
+             if line.startswith("DUMP ")]
+    return proc, acks, (dumps[0] if dumps else None)
+
+
+@pytest.mark.parametrize("overlap", ["1", "0"])
+def test_crash_differential_converges_with_overlap(tmp_path, overlap):
+    """The ISSUE 10 kill differential at the journaled-but-unapplied
+    site, with DUKE_RECOVERY_OVERLAP explicitly pinned on/off: the
+    restarted child resends the unacked suffix (its writes fence behind
+    the in-flight replay in the overlap arm) and must converge to link
+    rows + feed identical to an uncrashed control."""
+    ctrl, _, control = _run_child_env(tmp_path / "ctrl", overlap=overlap,
+                                      dump=True, close=True)
+    assert ctrl.returncode == 0, ctrl.stderr
+    data = tmp_path / "w"
+    proc, acks, _ = _run_child_env(data, overlap=overlap,
+                                   fault="crash_at=pre_flush:4")
+    assert proc.returncode == -signal.SIGKILL
+    resume = (max(acks) + 1) if acks else 0
+    proc2, _, dump = _run_child_env(data, overlap=overlap, start=resume,
+                                    dump=True, close=True)
+    assert proc2.returncode == 0, proc2.stderr
+    assert dump["links"] == control["links"]
+    assert dump["feed"] == control["feed"]
+    assert dump["journal_pending"] == 0
+    assert dump["replayed"] >= 1

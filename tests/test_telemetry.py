@@ -293,7 +293,7 @@ def test_health_probes(live_app):
     assert ready["checks"] == {
         "config_loaded": True, "recovery_complete": True,
         "workloads_built": True, "device_backend": True,
-        "link_persistence": True,
+        "link_persistence": True, "write_ready": True,
     }
 
 
