@@ -17,6 +17,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 from __future__ import annotations
 
 import json
+import math
 import os
 import random
 import sys
@@ -1228,6 +1229,15 @@ FED_BENCH = os.environ.get("BENCH_FED", "1") != "0"
 FED_RECORDS = int(os.environ.get("BENCH_FED_RECORDS", "1536"))
 FED_BATCH = int(os.environ.get("BENCH_FED_BATCH", "128"))
 FED_GROUPS = int(os.environ.get("BENCH_FED_GROUPS", "3"))
+# observability overhead bench (ISSUE 16): federated scatter-ingest with
+# every batch under a sampled root trace (the always-on instrumentation
+# ceiling: fed.partition/fanout/group/merge spans plus remote span
+# capture + graft per group) vs no active trace (span sites cost one
+# contextvar read).  The SLO trackers and per-range stats run in BOTH
+# arms — they are unconditional.  Budget: <2% ingest slowdown.
+# BENCH_OBS=0 skips it.
+OBS_BENCH = os.environ.get("BENCH_OBS", "1") != "0"
+OBS_RUNS = int(os.environ.get("BENCH_OBS_RUNS", "2"))
 
 FED_XML = """
 <DukeMicroService dataFolder="{folder}">
@@ -1338,6 +1348,76 @@ def federation_bench() -> dict:
             "moved_links": stats["moved_links"],
             "feed_bit_identical_across_migration": normed(rows2) == pre,
         },
+    }
+
+
+def observability_bench() -> dict:
+    """Tracing-overhead differential (ISSUE 16): the same federated
+    scatter-ingest run twice — every batch under a sampled root span
+    (TRACE_SAMPLE_RATE=1.0 equivalent: the full fed.partition/fanout/
+    group/merge span tree records, including remote span capture and
+    graft per group) vs with no active trace, where every span site is
+    a single contextvar read.  The always-on SLO latency trackers,
+    per-range outcome stats and queue-depth accounting run identically
+    in both arms, so the differential isolates the *span* path — the
+    only part sampling can turn off.  Best-of-OBS_RUNS per arm."""
+    import tempfile
+
+    from sesam_duke_microservice_tpu.core.config import parse_config
+    from sesam_duke_microservice_tpu.federation import Federation
+    from sesam_duke_microservice_tpu.telemetry import tracing
+
+    def entities(n):
+        return [{"_id": str(i), "name": f"person number {i % 64}",
+                 "email": f"p{i % 64}@x.no"} for i in range(n)]
+
+    batches = [entities(FED_RECORDS)[i:i + FED_BATCH]
+               for i in range(0, FED_RECORDS, FED_BATCH)]
+
+    def one_run(traced: bool) -> float:
+        tmp = tempfile.mkdtemp(prefix="obs-bench-")
+        sc = parse_config(FED_XML.format(folder=tmp),
+                          env={"MIN_RELEVANCE": "0.05"})
+        fed = Federation(sc, n_groups=FED_GROUPS)
+        # a private recorder: the bench must not flood the process
+        # flight recorder another section may inspect
+        rec = tracing.FlightRecorder(8, 64) if traced else None
+        t0 = time.monotonic()
+        if traced:
+            for batch in batches:
+                with tracing.start_trace("bench.ingest", sampled=True,
+                                         recorder=rec):
+                    fed.router.submit("deduplication", "bench", "crm",
+                                      batch)
+        else:
+            for batch in batches:
+                fed.router.submit("deduplication", "bench", "crm", batch)
+        ingest_s = time.monotonic() - t0
+        fed.close()
+        return ingest_s
+
+    one_run(traced=False)  # untimed warm-up: imports, comparator caches
+    runs = max(1, OBS_RUNS)
+    # interleave the arms so drift (allocator growth, page cache) hits
+    # both equally — the differential is the whole point
+    off_s = on_s = math.inf
+    for _ in range(runs):
+        off_s = min(off_s, one_run(traced=False))
+        on_s = min(on_s, one_run(traced=True))
+    off_rate = FED_RECORDS / off_s
+    on_rate = FED_RECORDS / on_s
+    overhead_pct = round((off_rate - on_rate) / off_rate * 100.0, 2)
+    return {
+        "metric": "tracing_overhead_pct",
+        "value": overhead_pct,
+        # the ISSUE 16 acceptance budget: always-on tracing costs the
+        # federated ingest path <2% throughput
+        "within_budget": overhead_pct < 2.0,
+        "records_per_sec_tracing_on": round(on_rate, 1),
+        "records_per_sec_tracing_off": round(off_rate, 1),
+        "groups": FED_GROUPS,
+        "records": FED_RECORDS,
+        "runs_per_arm": max(1, OBS_RUNS),
     }
 
 
@@ -1683,6 +1763,8 @@ def main():
         result["durability"] = durability_bench(schema)
     if FED_BENCH and BACKEND == "device":
         result["federation"] = federation_bench()
+    if OBS_BENCH and BACKEND == "device":
+        result["observability"] = observability_bench()
     if TAIL and BACKEND == "device":
         result["tail_latency"] = tail_latency_bench()
     print(json.dumps(result))

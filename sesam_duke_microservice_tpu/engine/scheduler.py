@@ -52,7 +52,7 @@ import time
 from collections import deque
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
-from ..telemetry import tracing
+from ..telemetry import slo, tracing
 from ..telemetry.decisions import _MonitorHist
 from ..telemetry.env import env_flag, env_float, env_int
 
@@ -534,6 +534,16 @@ class IngestScheduler:
                         note(hold)
                 finally:
                     wl.lock.release()
+                # always-on SLO signal (ISSUE 16): per-request ingest
+                # latency from SCHEDULER ARRIVAL to microbatch completion
+                # — the queueing delay included — folded under ONE leaf
+                # tracker lock per microbatch, taken with no other lock
+                # held; the feed-lag meter marks the rows this batch may
+                # have minted (plain attribute write)
+                done = time.monotonic()
+                slo.tracker("ingest", q.kind, q.name).record_batch(
+                    [done - req.enqueued for req in batch], done)
+                slo.feed_meter(q.kind, q.name).note_write()
                 q.microbatches += 1
                 q.merged_requests += len(batch)
                 q.dispatched_records += total

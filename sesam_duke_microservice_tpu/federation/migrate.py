@@ -52,11 +52,14 @@ import json
 import logging
 import os
 import time
-from typing import Dict, List, Optional, Tuple
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
 
 from ..core.records import DELETED_PROPERTY_NAME, Record
 from ..links.replica import decode_link, encode_link, rows_checksum
 from ..store.records import serialize_record
+from ..telemetry import tracing
+from ..telemetry.env import env_int
 from ..utils import faults
 from .ranges import route_key
 
@@ -71,6 +74,15 @@ PHASE_CODES = {"idle": 0, "frozen": 1, "copied": 2, "cutover": 3,
 # journal-slice replay applies in bounded chunks so the mid_replay kill
 # site sits between real durable steps, not after an all-or-nothing apply
 _REPLAY_CHUNK_ROWS = 256
+
+# retained phase timelines for GET /debug/migrations (ISSUE 16):
+# bounded ring, in-memory only — restart starts an empty ring and the
+# resumed migration writes a fresh timeline with resumed=True
+DEFAULT_TIMELINE_RING = 64
+
+
+def _timeline_ring() -> int:
+    return max(1, env_int("DUKE_MIGRATION_RING", DEFAULT_TIMELINE_RING))
 
 
 def _record_rows_checksum(rows: List[list]) -> int:
@@ -106,6 +118,31 @@ class RangeMigrator:
         # outcome counters for duke_fed_migrations_total (single writer:
         # migrations are serialized by Federation._admin_lock)
         self.outcomes = {"completed": 0, "resumed": 0, "failed": 0}
+        # phase-timeline ring for /debug/migrations: appended by the one
+        # serialized migration driver, read lock-free by the plane
+        # (list() copy — the _status whole-value stance)
+        self.timelines: Deque[dict] = deque(maxlen=_timeline_ring())
+
+    # -- phase timeline (ISSUE 16) --------------------------------------------
+
+    def timelines_snapshot(self) -> List[dict]:
+        """Newest-first copies of the retained migration timelines."""
+        return [dict(t, phases=list(t["phases"]))
+                for t in reversed(list(self.timelines))]
+
+    @staticmethod
+    def _log_phase(timeline: dict, phase: str, start_unix: float,
+                   duration_ns: int, **attrs) -> None:
+        """One completed phase: a retained timeline row plus (when the
+        driver runs under a trace) a ``migrate.<phase>`` span laid out
+        from its accumulated duration (the add_phase_spans precedent)."""
+        row = {"phase": phase, "start_unix": round(start_unix, 6),
+               "duration_ms": round(duration_ns / 1e6, 3)}
+        row.update(attrs)
+        timeline["phases"].append(row)
+        end_ns = time.monotonic_ns()
+        tracing.add_span(f"migrate.{phase}", end_ns - duration_ns, end_ns,
+                         dict(attrs) or None)
 
     # -- status ---------------------------------------------------------------
 
@@ -173,14 +210,23 @@ class RangeMigrator:
         logger.warning(
             "resuming interrupted migration of range %s: group %d -> %d",
             state["range"], state["source"], state["target"])
-        return self._drive(state)
+        return self._drive(state, resumed=True)
 
     # -- the state machine ----------------------------------------------------
 
-    def _drive(self, state: dict) -> dict:
+    def _drive(self, state: dict, resumed: bool = False) -> dict:
         range_id = state["range"]
         source, target = int(state["source"]), int(state["target"])
         pmap = self.fed.map
+        # the retained timeline rides the ring from the start so a
+        # migration that dies in flight still shows its completed phases
+        timeline = {
+            "range": range_id, "source": source, "target": target,
+            "resumed": resumed, "started_unix": round(time.time(), 6),
+            "trace_id": tracing.current_trace_id(),
+            "outcome": "in-flight", "phases": [],
+        }
+        self.timelines.append(timeline)
         try:
             r = pmap.find(range_id)
             if r.group == target and not r.frozen:
@@ -192,10 +238,13 @@ class RangeMigrator:
             else:
                 # freeze (idempotent on resume) and fence the source so
                 # stale routers bounce off the old owner
+                t0, m0 = time.time(), time.monotonic_ns()
                 epoch = pmap.freeze(range_id)
                 self.fed.groups[source].fence(epoch)
                 self._set_phase(state, "frozen")
-                moved = self._copy_range(range_id, source, target)
+                self._log_phase(timeline, "freeze", t0,
+                                time.monotonic_ns() - m0, epoch=epoch)
+                moved = self._copy_range(range_id, source, target, timeline)
                 self._set_phase(state, "copied")
                 # rebalanced ranges start hot (ISSUE 15): the copy may
                 # have grown the target's corpus past a capacity
@@ -208,16 +257,23 @@ class RangeMigrator:
                 # kill site: target complete and durable, map still
                 # names the source — restart redoes the copy (idempotent)
                 faults.check_crash("pre_cutover")
+                t0, m0 = time.time(), time.monotonic_ns()
                 epoch = pmap.assign(range_id, target)
                 self.fed.groups[source].fence(epoch)
                 self.fed.groups[target].fence(epoch)
                 self._set_phase(state, "cutover")
+                self._log_phase(timeline, "cutover", t0,
+                                time.monotonic_ns() - m0, epoch=epoch)
                 # kill site: ownership flipped, drain pending
                 faults.check_crash("post_cutover")
+            t0, m0 = time.time(), time.monotonic_ns()
             self._drain_source(range_id, source)
             self._set_phase(state, "drain")
+            self._log_phase(timeline, "drain", t0,
+                            time.monotonic_ns() - m0)
             self._clear_state()
             self.outcomes["completed"] += 1
+            timeline["outcome"] = "completed"
             self._set_phase(state, "done")
             logger.info(
                 "range %s migrated: group %d -> %d (%d record(s), %d "
@@ -233,6 +289,7 @@ class RangeMigrator:
             # MUST complete (resume) — the frozen range keeps rejecting
             # writes until it does, which is the safe failure mode
             self.outcomes["failed"] += 1
+            timeline["outcome"] = "failed"
             self._set_phase(state, "idle")
             raise
 
@@ -259,14 +316,21 @@ class RangeMigrator:
 
     # -- copy: snapshot + ship + journal slice --------------------------------
 
-    def _copy_range(self, range_id: str, source: int,
-                    target: int) -> Dict[str, int]:
+    def _copy_range(self, range_id: str, source: int, target: int,
+                    timeline: Optional[dict] = None) -> Dict[str, int]:
         r = self.fed.map.find(range_id)
         span = (r.lo, r.hi)
         totals = {"records": 0, "links": 0, "slices": 0}
         src_group = self.fed.groups[source]
         tgt_group = self.fed.groups[target]
+        # per-workload snapshot/replay intervals interleave, so the
+        # timeline rows carry ACCUMULATED durations (the add_phase_spans
+        # stance) with row/byte attributes summed across workloads
+        copy_start = time.time()
+        snapshot_ns = replay_ns = 0
+        mirrors = record_bytes = 0
         for wl_key in src_group.workloads:
+            t = time.monotonic_ns()
             snapshot = self._snapshot_workload(src_group, wl_key, span)
             # kill site: snapshot captured, nothing shipped
             faults.check_crash("post_snapshot")
@@ -275,9 +339,15 @@ class RangeMigrator:
                 self._load_snapshot(tgt_group, wl_key, snapshot)
                 totals["records"] += len(snapshot["records"])
                 totals["links"] += len(snapshot["links"])
+                mirrors += len(snapshot["mirrors"])
+                record_bytes += sum(len(data)
+                                    for _rid, data in snapshot["records"])
+                snapshot_ns += time.monotonic_ns() - t
+                t = time.monotonic_ns()
                 totals["slices"] += self._replay_slice(
                     journal, snapshot["watermark"], span, src_group,
                     tgt_group, wl_key)
+                replay_ns += time.monotonic_ns() - t
             finally:
                 if snapshot["pin"] is not None:
                     snapshot["pin"].__exit__(None, None, None)
@@ -285,6 +355,14 @@ class RangeMigrator:
             # cutover never points readers at a store that is still
             # catching up on the shipped rows
             tgt_group.workloads[wl_key].link_database.drain()
+        if timeline is not None:
+            self._log_phase(timeline, "snapshot", copy_start, snapshot_ns,
+                            records=totals["records"],
+                            links=totals["links"], mirrors=mirrors,
+                            record_bytes=record_bytes)
+            self._log_phase(timeline, "replay",
+                            copy_start + snapshot_ns / 1e9, replay_ns,
+                            slices=totals["slices"])
         return totals
 
     def _snapshot_workload(self, src_group, wl_key: Tuple[str, str],

@@ -31,7 +31,10 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..engine.workload import Workload
 from ..links.replica import feed_row
+from ..telemetry import slo, tracing
+from ..telemetry.decisions import _MonitorHist
 from ..telemetry.env import env_float, env_int
+from ..telemetry.registry import DEFAULT_LATENCY_BUCKETS
 from ..utils import faults
 from ..utils.backoff import full_jitter_delay
 from .ranges import (
@@ -161,60 +164,85 @@ class LocalGroup:
     # -- ingest ---------------------------------------------------------------
 
     def ingest(self, kind: str, name: str, dataset_id: str,
-               entities: List[dict], *, epoch: int) -> None:
-        self._check_reachable()
-        self._check_epoch(epoch)
-        wl = self.workload(kind, name)
-        if dataset_id not in wl.datasources:
-            raise UnknownFederatedWorkload(f"{kind}/{name}/{dataset_id}")
-        if wl.submit_batch(dataset_id, entities) is None:
-            raise GroupUnavailable(
-                f"group {self.idx} workload {kind}/{name} was replaced "
-                "mid-batch")
-        # fence RE-CHECK after the write: the pre-write check is
-        # check-then-act — a freeze can land between it and the batch
-        # taking the workload lock, and a write completing after the
-        # migration's locked snapshot walk would be acked yet invisible
-        # (its range's rows filtered at the old owner forever).  Raising
-        # HERE withholds the ack instead: the client resends, the
-        # refreshed router routes to the live owner, and the idempotent
-        # assert absorbs any rows the snapshot DID capture.  Sound
-        # because the freeze fences BEFORE its snapshot takes the
-        # workload lock: if this read still sees the old fence, the
-        # write completed before any snapshot could have started.
-        self._check_epoch(epoch)
+               entities: List[dict], *, epoch: int,
+               trace_ctx: Optional[dict] = None) -> bytes:
+        """Apply one routed sub-batch; returns the group-side remote
+        span tree as wire bytes (``b""`` untraced — the exact shape an
+        RPC response would carry back, per the dispatch.py precedent)."""
+        # the capture opens a DETACHED trace continuing the router's ids
+        # in this scatter thread (threads inherit no contextvars), so the
+        # engine spans the scheduler attaches land in the same tree;
+        # with trace_ctx None every span inside stays a no-op.
+        with tracing.capture_remote(
+                "group.ingest", trace_ctx,
+                {"group": self.idx, "entities": len(entities)}) as cap:
+            t0 = time.monotonic()
+            self._check_reachable()
+            self._check_epoch(epoch)
+            wl = self.workload(kind, name)
+            if dataset_id not in wl.datasources:
+                raise UnknownFederatedWorkload(f"{kind}/{name}/{dataset_id}")
+            if wl.submit_batch(dataset_id, entities) is None:
+                raise GroupUnavailable(
+                    f"group {self.idx} workload {kind}/{name} was replaced "
+                    "mid-batch")
+            # fence RE-CHECK after the write: the pre-write check is
+            # check-then-act — a freeze can land between it and the batch
+            # taking the workload lock, and a write completing after the
+            # migration's locked snapshot walk would be acked yet invisible
+            # (its range's rows filtered at the old owner forever).  Raising
+            # HERE withholds the ack instead: the client resends, the
+            # refreshed router routes to the live owner, and the idempotent
+            # assert absorbs any rows the snapshot DID capture.  Sound
+            # because the freeze fences BEFORE its snapshot takes the
+            # workload lock: if this read still sees the old fence, the
+            # write completed before any snapshot could have started.
+            self._check_epoch(epoch)
+            # always-on ingest SLO (ISSUE 16): group ingest bypasses the
+            # service scheduler, so the group boundary is its
+            # scheduler-arrival equivalent — lock-wait (the queueing
+            # here) included.  Leaf tracker lock, no other lock held.
+            done = time.monotonic()
+            slo.tracker("ingest", kind, name).record(done - t0, done)
+            slo.feed_meter(kind, name).note_write()
+        return cap.wire()
 
     # -- feed walk ------------------------------------------------------------
 
-    def links_walk(self, kind: str, name: str, since: int,
-                   limit: int) -> Tuple[List[tuple], bool]:
+    def links_walk(self, kind: str, name: str, since: int, limit: int,
+                   trace_ctx: Optional[dict] = None
+                   ) -> Tuple[List[tuple], bool, bytes]:
         """One bounded page of this group's link stream past ``since``:
-        ``([(id1, timestamp, feed_row), ...], drained)``.  Rows carry
-        their owner endpoint id so the ROUTER applies the ownership
-        filter (the group does not hold the map).  Takes the workload
-        lock with the read timeout — contention surfaces as GroupBusy
-        with the workload's own Retry-After hint, never a hang."""
-        self._check_reachable()
-        wl = self.workload(kind, name)
-        if not wl.lock.acquire(timeout=self.READ_LOCK_TIMEOUT_S):
-            raise GroupBusy(
-                f"group {self.idx} workload lock busy",
-                retry_after=wl.busy_retry_after())
-        try:
-            if wl.closed:
-                raise GroupUnavailable(
-                    f"group {self.idx} workload {kind}/{name} closed")
-            links = wl.link_database.get_changes_page(since, limit)
-            prefetch = getattr(getattr(wl.index, "records", None),
-                               "prefetch", None)
-            if prefetch is not None and links:
-                prefetch({l.id1 for l in links} | {l.id2 for l in links})
-            rows = [(l.id1, l.timestamp,
-                     feed_row(l, wl.index.find_record_by_id))
-                    for l in links]
-        finally:
-            wl.lock.release()
-        return rows, len(links) < limit
+        ``([(id1, timestamp, feed_row), ...], drained, span_wire)``.
+        Rows carry their owner endpoint id so the ROUTER applies the
+        ownership filter (the group does not hold the map).  Takes the
+        workload lock with the read timeout — contention surfaces as
+        GroupBusy with the workload's own Retry-After hint, never a
+        hang."""
+        with tracing.capture_remote(
+                "group.links_walk", trace_ctx,
+                {"group": self.idx, "since": since}) as cap:
+            self._check_reachable()
+            wl = self.workload(kind, name)
+            if not wl.lock.acquire(timeout=self.READ_LOCK_TIMEOUT_S):
+                raise GroupBusy(
+                    f"group {self.idx} workload lock busy",
+                    retry_after=wl.busy_retry_after())
+            try:
+                if wl.closed:
+                    raise GroupUnavailable(
+                        f"group {self.idx} workload {kind}/{name} closed")
+                links = wl.link_database.get_changes_page(since, limit)
+                prefetch = getattr(getattr(wl.index, "records", None),
+                                   "prefetch", None)
+                if prefetch is not None and links:
+                    prefetch({l.id1 for l in links} | {l.id2 for l in links})
+                rows = [(l.id1, l.timestamp,
+                         feed_row(l, wl.index.find_record_by_id))
+                        for l in links]
+            finally:
+                wl.lock.release()
+        return rows, len(links) < limit, cap.wire()
 
     def close(self) -> None:
         self.closed = True
@@ -242,6 +270,12 @@ class FederationRouter:
         self._last_ok: Dict[int, float] = {}  # guarded by: self._health_lock [writes]
         # request outcomes for the duke_fed_requests_total snapshot
         self.outcomes = {"ok": 0, "degraded": 0, "frozen": 0}  # guarded by: self._health_lock [writes]
+        # per-range scatter accounting (ISSUE 16: the hot-range signal)
+        # — written AFTER the scatter returns, so like _health_lock this
+        # leaf lock is never held across a group call
+        self._range_lock = threading.Lock()
+        # range_id -> [ {outcome: count}, _MonitorHist ]
+        self._range_stats: Dict[str, list] = {}  # guarded by: self._range_lock [writes]
 
     # -- health bookkeeping ---------------------------------------------------
 
@@ -286,6 +320,28 @@ class FederationRouter:
             }
             for g in self.groups
         ]
+
+    def _note_range(self, range_ids: List[str], outcome: str,
+                    elapsed_s: float) -> None:
+        with self._range_lock:
+            for rid in range_ids:
+                st = self._range_stats.get(rid)
+                if st is None:
+                    st = self._range_stats[rid] = [
+                        {}, _MonitorHist(DEFAULT_LATENCY_BUCKETS)]
+                st[0][outcome] = st[0].get(outcome, 0) + 1
+                st[1].observe(elapsed_s)
+
+    def range_stats_snapshot(self) -> Dict[str, tuple]:
+        """Per-range scatter stats for the fed collector:
+        ``{range_id: ({outcome: count}, (bucket_counts, sum, count))}``
+        — plain copies, detached from the lock."""
+        with self._range_lock:
+            return {
+                rid: (dict(st[0]),
+                      (list(st[1].counts), st[1].total, st[1].count))
+                for rid, st in self._range_stats.items()
+            }
 
     def degraded_range_ids(self) -> List[str]:
         """Ranges owned by groups whose LAST scatter contact failed —
@@ -368,6 +424,7 @@ class FederationRouter:
         ranges = pmap.ranges()
         per_group: Dict[int, List[dict]] = {}
         frozen: List[str] = []
+        touched: Dict[int, List[str]] = {}
         for entity in entities:
             rid = datasource.record_id_for_entity(entity)
             key = route_key(rid)
@@ -377,7 +434,64 @@ class FederationRouter:
                     frozen.append(owner.range_id)
                 continue
             per_group.setdefault(owner.group, []).append(entity)
-        return per_group, frozen
+            group_touched = touched.setdefault(owner.group, [])
+            if owner.range_id not in group_touched:
+                group_touched.append(owner.range_id)
+        return per_group, frozen, touched
+
+    @staticmethod
+    def _group_outcome(ok: bool, err, attempts: int) -> str:
+        """The fed.group span / per-range outcome vocabulary."""
+        if ok:
+            return "retried" if attempts > 1 else "ok"
+        if isinstance(err, StaleRouterEpoch):
+            return "stale-epoch"
+        return "degraded"
+
+    def _ingest_job(self, gidx: int, kind: str, name: str, dataset_id: str,
+                    sub: List[dict], epoch: int, ctx: Optional[dict],
+                    cell: list) -> Callable:
+        """One scatter job that times itself into ``cell`` =
+        ``[start_ns, end_ns, attempts, wire]`` — plain list writes from
+        the scatter thread, read by the router thread only after the
+        scatter joins (or defaulted on timeout)."""
+        group = self.groups[gidx]
+
+        def call():
+            cell[2] += 1
+            return group.ingest(kind, name, dataset_id, sub, epoch=epoch,
+                                trace_ctx=ctx)
+
+        def job():
+            cell[0] = time.monotonic_ns()
+            try:
+                cell[3] = self._call_group(group, call) or b""
+                return True
+            finally:
+                cell[1] = time.monotonic_ns()
+
+        return job
+
+    def _trace_scatter(self, results: Dict[int, tuple], meta: Dict[int, list],
+                       ranges_by_group: Dict[int, List[str]]) -> None:
+        """Per-group ``fed.group`` spans + remote-tree grafts, emitted in
+        the ROUTER thread (the scatter threads have no trace context),
+        and the per-range request/latency accounting.  No-cost without
+        an active trace except the range bookkeeping."""
+        now_ns = time.monotonic_ns()
+        for gidx, (ok, value) in results.items():
+            start_ns, end_ns, attempts, wire = meta[gidx]
+            start_ns = start_ns or now_ns
+            end_ns = end_ns or now_ns  # timed out: thread still running
+            outcome = self._group_outcome(ok, value, attempts)
+            owned = ranges_by_group.get(gidx, [])
+            tracing.add_span("fed.group", start_ns, end_ns, {
+                "group": gidx, "ranges": owned, "outcome": outcome,
+                "attempts": attempts})
+            if ok:
+                tracing.graft_remote(wire)
+            self._note_range(owned, outcome,
+                             max(0.0, (end_ns - start_ns) / 1e9))
 
     def submit(self, kind: str, name: str, dataset_id: str,
                entities: List[dict]) -> dict:
@@ -388,19 +502,26 @@ class FederationRouter:
         for attempt in ("route", "re-route"):
             pmap = self._map_provider()
             epoch = pmap.epoch
-            per_group, frozen = self._route_entities(
-                kind, name, dataset_id, entities, pmap)
+            with tracing.span("fed.partition", {"attempt": attempt,
+                                                "entities": len(entities)}):
+                per_group, frozen, touched = self._route_entities(
+                    kind, name, dataset_id, entities, pmap)
             if frozen:
                 self._count_outcome("frozen")
                 raise FrozenRange(
                     frozen, retry_after=DEFAULT_FED_RETRY_AFTER_S)
-            jobs = {
-                gidx: (lambda g=self.groups[gidx], sub=sub:
-                       self._call_group(g, g.ingest, kind, name,
-                                        dataset_id, sub, epoch=epoch))
-                for gidx, sub in per_group.items()
-            }
-            results = self._scatter(jobs)
+            # [start_ns, end_ns, attempts, wire] per scatter job
+            meta = {gidx: [0, 0, 0, b""] for gidx in per_group}
+            with tracing.span("fed.fanout", {"groups": len(per_group),
+                                             "attempt": attempt}):
+                ctx = tracing.propagation_context()
+                jobs = {
+                    gidx: self._ingest_job(gidx, kind, name, dataset_id,
+                                           sub, epoch, ctx, meta[gidx])
+                    for gidx, sub in per_group.items()
+                }
+                results = self._scatter(jobs)
+                self._trace_scatter(results, meta, touched)
             if any(not ok and isinstance(err, StaleRouterEpoch)
                    for ok, err in results.values()) and attempt == "route":
                 # our map raced a freeze/cutover: refresh and re-route
@@ -411,37 +532,38 @@ class FederationRouter:
                                "re-routing")
                 continue
             break
-        failures = {g: err for g, (ok, err) in results.items() if not ok}
-        # a stale-epoch refusal is FENCING, not group ill-health: the
-        # group is alive and did its job — never mark it failed (its
-        # ranges must not surface as degraded) and surface the stale
-        # signal itself so the plane answers the retry-shortly 503
-        # instead of a bogus group-unavailable
-        stale = [e for e in failures.values()
-                 if isinstance(e, StaleRouterEpoch)]
-        genuine = {g: e for g, e in failures.items()
-                   if not isinstance(e, StaleRouterEpoch)}
-        for gidx in per_group:
-            self._mark(gidx, genuine.get(gidx))
-        if not failures:
-            self._count_outcome("ok")
-            return {"success": True, "groups": len(per_group)}
-        self._count_outcome("degraded")
-        if not genuine:
-            # every failure was fencing: topology moved twice during
-            # this submit — nothing landed for those sub-batches, the
-            # client retries against the settled map
-            raise stale[0]
-        pmap = self._map_provider()
-        degraded: List[str] = []
-        for gidx in genuine:
-            degraded.extend(r.range_id for r in pmap.group_ranges(gidx))
-        retry_after = max(
-            [getattr(e, "retry_after", DEFAULT_FED_RETRY_AFTER_S)
-             for e in genuine.values()] + [DEFAULT_FED_RETRY_AFTER_S])
-        raise PartialIngestFailure(
-            sorted(set(degraded)), retry_after,
-            {g: repr(e) for g, e in genuine.items()})
+        with tracing.span("fed.merge", {"groups": len(per_group)}):
+            failures = {g: err for g, (ok, err) in results.items() if not ok}
+            # a stale-epoch refusal is FENCING, not group ill-health: the
+            # group is alive and did its job — never mark it failed (its
+            # ranges must not surface as degraded) and surface the stale
+            # signal itself so the plane answers the retry-shortly 503
+            # instead of a bogus group-unavailable
+            stale = [e for e in failures.values()
+                     if isinstance(e, StaleRouterEpoch)]
+            genuine = {g: e for g, e in failures.items()
+                       if not isinstance(e, StaleRouterEpoch)}
+            for gidx in per_group:
+                self._mark(gidx, genuine.get(gidx))
+            if not failures:
+                self._count_outcome("ok")
+                return {"success": True, "groups": len(per_group)}
+            self._count_outcome("degraded")
+            if not genuine:
+                # every failure was fencing: topology moved twice during
+                # this submit — nothing landed for those sub-batches, the
+                # client retries against the settled map
+                raise stale[0]
+            pmap = self._map_provider()
+            degraded: List[str] = []
+            for gidx in genuine:
+                degraded.extend(r.range_id for r in pmap.group_ranges(gidx))
+            retry_after = max(
+                [getattr(e, "retry_after", DEFAULT_FED_RETRY_AFTER_S)
+                 for e in genuine.values()] + [DEFAULT_FED_RETRY_AFTER_S])
+            raise PartialIngestFailure(
+                sorted(set(degraded)), retry_after,
+                {g: repr(e) for g, e in genuine.items()})
 
     # -- federated feed -------------------------------------------------------
 
@@ -472,84 +594,114 @@ class FederationRouter:
         for r in ranges:
             by_group.setdefault(r.group, []).append(r)
 
-        def walk(gidx: int, owned: List[Range]):
+        def walk(gidx: int, owned: List[Range], ctx: Optional[dict],
+                 cell: list):
             group = self.groups[gidx]
             cursor_floor = min(pos_for(r.range_id) for r in owned)
             emitted: List[tuple] = []
             pos = cursor_floor
             drained = False
-            while len(emitted) < limit:
-                rows, drained = self._call_group(
-                    group, group.links_walk, kind, name, pos, limit)
-                for id1, ts, row in rows:
-                    pos = ts
-                    key = route_key(id1)
-                    owner = next(r for r in ranges if r.contains(key))
-                    if owner.group != gidx:
-                        continue  # stale copy at the range's old owner
-                    if ts <= pos_for(owner.range_id):
-                        continue  # consumed before the range moved here
-                    emitted.append((ts, owner.range_id, row))
-                if drained:
-                    break
+            cell[0] = time.monotonic_ns()
+            try:
+                while len(emitted) < limit:
+                    rows, drained, wire = self._call_group(
+                        group, group.links_walk, kind, name, pos, limit,
+                        ctx)
+                    cell[2] += 1
+                    if wire:
+                        cell[3].append(wire)
+                    for id1, ts, row in rows:
+                        pos = ts
+                        key = route_key(id1)
+                        owner = next(r for r in ranges if r.contains(key))
+                        if owner.group != gidx:
+                            continue  # stale copy at the range's old owner
+                        if ts <= pos_for(owner.range_id):
+                            continue  # consumed before the range moved here
+                        emitted.append((ts, owner.range_id, row))
+                    if drained:
+                        break
+            finally:
+                cell[1] = time.monotonic_ns()
             return emitted, pos, drained
 
-        jobs = {
-            gidx: (lambda g=gidx, owned=owned: walk(g, owned))
-            for gidx, owned in by_group.items()
-        }
-        results = self._scatter(jobs)
-        merged: List[tuple] = []
-        new_positions: Dict[str, int] = {
-            r.range_id: pos_for(r.range_id) for r in ranges}
-        degraded: List[str] = []
-        retry_hints: List[int] = []
-        all_drained = True
-        for gidx, (ok, value) in results.items():
-            owned = by_group[gidx]
-            if not ok:
-                self._mark(gidx, value)
-                degraded.extend(r.range_id for r in owned)
-                retry_hints.append(
-                    getattr(value, "retry_after",
-                            DEFAULT_FED_RETRY_AFTER_S))
-                all_drained = False
-                continue
-            self._mark(gidx, None)
-            emitted, walked_to, drained = value
-            merged.extend(emitted)
-            all_drained = all_drained and drained
-            # the group's stream is one timestamp-ordered walk: having
-            # processed it to ``walked_to``, EVERY range it owns is
-            # consumed to there
-            for r in owned:
-                new_positions[r.range_id] = max(
-                    new_positions[r.range_id], walked_to)
-        merged.sort(key=lambda t: (t[0], t[2].get("_id", "")))
-        if len(merged) > limit:
-            # bound the MERGED page too (each group walked up to
-            # ``limit`` on its own, so the concatenation can reach
-            # n_groups × limit): keep a timestamp-tie-extended prefix —
-            # the same tie rule as ``get_changes_page``, since per-range
-            # cursors are strictly-greater-than and a cut mid-tie would
-            # skip the tied remainder on resume — and rebuild the
-            # cursors from the KEPT rows only (the walked positions
-            # would skip every trimmed row)
-            cut = limit
-            boundary = merged[limit - 1][0]
-            while cut < len(merged) and merged[cut][0] == boundary:
-                cut += 1
-            merged = merged[:cut]
-            all_drained = False
-            new_positions = {
+        # [start_ns, end_ns, pages, wires] per scatter job
+        meta = {gidx: [0, 0, 0, []] for gidx in by_group}
+        with tracing.span("fed.fanout", {"groups": len(by_group),
+                                         "op": "feed"}):
+            ctx = tracing.propagation_context()
+            jobs = {
+                gidx: (lambda g=gidx, owned=owned:
+                       walk(g, owned, ctx, meta[g]))
+                for gidx, owned in by_group.items()
+            }
+            results = self._scatter(jobs)
+            now_ns = time.monotonic_ns()
+            for gidx, (ok, value) in results.items():
+                start_ns, end_ns, pages, wires = meta[gidx]
+                tracing.add_span("fed.group", start_ns or now_ns,
+                                 end_ns or now_ns, {
+                                     "group": gidx,
+                                     "ranges": [r.range_id
+                                                for r in by_group[gidx]],
+                                     "outcome": self._group_outcome(
+                                         ok, value, 1),
+                                     "pages": pages, "op": "feed"})
+                for wire in wires:  # pages that landed before a failure
+                    tracing.graft_remote(wire)
+        with tracing.span("fed.merge", {"groups": len(by_group)}):
+            merged: List[tuple] = []
+            new_positions: Dict[str, int] = {
                 r.range_id: pos_for(r.range_id) for r in ranges}
-            for ts, range_id, _row in merged:
-                new_positions[range_id] = max(new_positions[range_id], ts)
-        self._count_outcome("degraded" if degraded else "ok")
-        return {
-            "rows": [row for _, _, row in merged],
-            "next_since": encode_cursor(pmap.version, new_positions),
-            "drained": all_drained,
-            "degraded_ranges": sorted(set(degraded)),
-            "retry_after": max(retry_hints) if retry_hints else None,
-        }
+            degraded: List[str] = []
+            retry_hints: List[int] = []
+            all_drained = True
+            for gidx, (ok, value) in results.items():
+                owned = by_group[gidx]
+                if not ok:
+                    self._mark(gidx, value)
+                    degraded.extend(r.range_id for r in owned)
+                    retry_hints.append(
+                        getattr(value, "retry_after",
+                                DEFAULT_FED_RETRY_AFTER_S))
+                    all_drained = False
+                    continue
+                self._mark(gidx, None)
+                emitted, walked_to, drained = value
+                merged.extend(emitted)
+                all_drained = all_drained and drained
+                # the group's stream is one timestamp-ordered walk:
+                # having processed it to ``walked_to``, EVERY range it
+                # owns is consumed to there
+                for r in owned:
+                    new_positions[r.range_id] = max(
+                        new_positions[r.range_id], walked_to)
+            merged.sort(key=lambda t: (t[0], t[2].get("_id", "")))
+            if len(merged) > limit:
+                # bound the MERGED page too (each group walked up to
+                # ``limit`` on its own, so the concatenation can reach
+                # n_groups × limit): keep a timestamp-tie-extended prefix
+                # — the same tie rule as ``get_changes_page``, since
+                # per-range cursors are strictly-greater-than and a cut
+                # mid-tie would skip the tied remainder on resume — and
+                # rebuild the cursors from the KEPT rows only (the walked
+                # positions would skip every trimmed row)
+                cut = limit
+                boundary = merged[limit - 1][0]
+                while cut < len(merged) and merged[cut][0] == boundary:
+                    cut += 1
+                merged = merged[:cut]
+                all_drained = False
+                new_positions = {
+                    r.range_id: pos_for(r.range_id) for r in ranges}
+                for ts, range_id, _row in merged:
+                    new_positions[range_id] = max(
+                        new_positions[range_id], ts)
+            self._count_outcome("degraded" if degraded else "ok")
+            return {
+                "rows": [row for _, _, row in merged],
+                "next_since": encode_cursor(pmap.version, new_positions),
+                "drained": all_drained,
+                "degraded_ranges": sorted(set(degraded)),
+                "retry_after": max(retry_hints) if retry_hints else None,
+            }

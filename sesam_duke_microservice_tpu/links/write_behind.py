@@ -358,12 +358,19 @@ class WriteBehindLinkDatabase(LinkDatabase):
         # every batch at or below it — a 10k-batch backlog replays in a
         # few dozen transactions instead of 10k commits
         chunk_size = 256
+        # progress gauges (ISSUE 16): while /readyz still says
+        # `recovering`, remaining counts down per chunk so an operator
+        # can tell "almost done" from "wedged".  inc/dec (not set) so
+        # concurrent per-workload overlapped recoveries sum correctly.
+        telemetry.RECOVERY_REPLAY_REMAINING.inc(len(batches))  # dukecheck: ignore[DK502] startup recovery only, never per-batch
         for start in range(0, len(batches), chunk_size):
             chunk = batches[start:start + chunk_size]
             self.inner.assert_links(
                 [decode_link(r) for _, rows in chunk for r in rows])
             self.inner.commit()
             self.journal.mark_applied(chunk[-1][0])
+            telemetry.RECOVERY_REPLAY_APPLIED.inc(len(chunk))  # dukecheck: ignore[DK502] startup recovery only, once per 256-batch chunk
+            telemetry.RECOVERY_REPLAY_REMAINING.dec(len(chunk))  # dukecheck: ignore[DK502] startup recovery only, once per 256-batch chunk
         self.journal.compact()
         if batches:
             telemetry.RECOVERY_REPLAYED.inc(len(batches))  # dukecheck: ignore[DK502] startup recovery only, never per-batch

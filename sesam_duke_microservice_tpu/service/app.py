@@ -49,7 +49,7 @@ from ..engine.scheduler import (
     scheduler_enabled,
 )
 from ..engine.workload import Workload, build_workload
-from ..telemetry import tracing
+from ..telemetry import slo, tracing
 from ..telemetry.env import env_flag, env_str
 from ..telemetry.logctx import new_request_id, request_id_var
 from . import debug as debug_api
@@ -1074,6 +1074,7 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
 
         page_size = _feed_page_size()
         cursor = since
+        t0 = time.monotonic()
         started = False   # headers sent (can't switch to an error reply after)
         first_row = True
         lock_attempts = 0
@@ -1168,6 +1169,11 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                     self._write_chunk(payload.encode("utf-8"))
                 if len(rows) < page_size:
                     break
+            # always-on feed SLO signal (ISSUE 16): backlog walk wall
+            # time against DUKE_SLO_FEED_MS; reaching the short page
+            # means the feed is caught up, so the lag meter stops aging
+            slo.tracker("feed", kind, name).record(time.monotonic() - t0)
+            slo.feed_meter(kind, name).note_drain()
             if started:
                 self._write_chunk(b"]")
                 self.wfile.write(b"0\r\n\r\n")
@@ -1193,6 +1199,7 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
         """Pre-streaming feed path for HTTP/1.0 clients: one buffered
         array with Content-Length (holds the lock for the full fetch,
         like the reference)."""
+        t0 = time.monotonic()
         while True:
             workload = self._workloads(kind).get(name)
             if workload is None:
@@ -1210,6 +1217,8 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                 break
             finally:
                 workload.lock.release()
+        slo.tracker("feed", kind, name).record(time.monotonic() - t0)
+        slo.feed_meter(kind, name).note_drain()
         body = "[" + ",\n".join(json.dumps(r) for r in rows) + "]"
         self._reply(200, body.encode("utf-8"))
 

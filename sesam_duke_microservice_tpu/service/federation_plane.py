@@ -29,12 +29,24 @@ the digest-range partition router:
     group's journal replay runs (scoped: other processes' groups do not
     leak in) and ``degraded`` when a group is down.
 
-New ``duke_fed_*`` metric families (scrape-time snapshots — the router
-hot path writes plain counters under its own lock, never a registry
-child): ``duke_fed_groups``, ``duke_fed_group_up``,
+``duke_fed_*`` metric families (scrape-time snapshots — the router hot
+path writes plain counters under its own lock, never a registry child):
+``duke_fed_groups``, ``duke_fed_group_up``,
 ``duke_fed_group_seconds_since_contact``, ``duke_fed_degraded_ranges``,
 ``duke_fed_migration_phase``, ``duke_fed_migrations_total``,
-``duke_fed_requests_total``.
+``duke_fed_requests_total``, and per-range scatter series
+``duke_fed_range_requests_total`` / ``duke_fed_range_latency_seconds``.
+
+Observability plane (ISSUE 16): every request opens a W3C-propagating
+root span (inbound ``traceparent`` honored, ``X-Request-Id`` /
+``X-Trace-Id`` reply headers), ``/debug/traces`` + ``/debug/requests``
+serve the plane's flight recorder — a retained federated ingest shows
+the plane root, the router's partition/fan-out/merge spans AND each
+group's re-anchored engine subtree as one causal tree —
+``/debug/migrations`` returns the migrator's retained phase-timeline
+ring, and ``/metrics`` additionally renders the fleet rollup: every
+group's registry merged through ``telemetry.rollup.GroupRollup``
+(counters/histograms summed, gauges relabeled ``group=``).
 """
 
 from __future__ import annotations
@@ -56,8 +68,19 @@ from ..federation.router import (
     PartialIngestFailure,
     UnknownFederatedWorkload,
 )
-from ..telemetry import FamilySnapshot, MetricRegistry
-from .app import _ENTITY_PATH, _FEED_PATH, _feed_page_size, _kind_label
+from ..telemetry import FamilySnapshot, MetricRegistry, slo, tracing
+from ..telemetry.logctx import new_request_id, request_id_var
+from ..telemetry.registry import DEFAULT_LATENCY_BUCKETS, histogram_snapshot
+from ..telemetry.rollup import GroupRollup
+from . import debug as debug_api
+from .app import (
+    _DEBUG_TRACE_PATH,
+    _ENTITY_PATH,
+    _FEED_PATH,
+    _feed_page_size,
+    _kind_label,
+)
+from .metrics import make_group_collector
 
 logger = logging.getLogger("federation-plane")
 
@@ -80,6 +103,17 @@ def make_federation_collector(fed: Federation):
             contact_samples.append(
                 ("", labels, round(now - last, 3) if last else -1.0))
         outcomes = router.outcomes_snapshot()
+        range_req_samples = []
+        range_lat_samples = []
+        for rid, (by_outcome, hist) in sorted(
+                router.range_stats_snapshot().items()):
+            for outcome, n in sorted(by_outcome.items()):
+                range_req_samples.append(
+                    ("", (("range", rid), ("outcome", outcome)), float(n)))
+            counts, total, count = hist
+            range_lat_samples.extend(histogram_snapshot(
+                DEFAULT_LATENCY_BUCKETS, counts, total, count,
+                (("range", rid),)))
         return [
             FamilySnapshot(
                 "duke_fed_groups", "gauge",
@@ -117,20 +151,87 @@ def make_federation_collector(fed: Federation):
                 "range)",
                 [("", (("outcome", k),), float(v))
                  for k, v in sorted(outcomes.items())]),
+            FamilySnapshot(
+                "duke_fed_range_requests_total", "counter",
+                "Scatter calls that touched the range, by per-group "
+                "outcome (ok, retried = ok after transient retries, "
+                "degraded = group unreachable, stale-epoch = fenced by "
+                "a concurrent cutover)", range_req_samples),
+            FamilySnapshot(
+                "duke_fed_range_latency_seconds", "histogram",
+                "Per-range scatter-call latency (group call including "
+                "router-side retries)", range_lat_samples),
         ]
 
     return collect
 
 
+_FED_STATIC_ROUTES = frozenset((
+    "/health", "/healthz", "/readyz", "/stats", "/metrics",
+    "/federation/map", "/federation/migration", "/federation/migrate",
+    "/debug/traces", "/debug/requests", "/debug/migrations",
+))
+
+
+def _fed_route_template(path: str) -> str:
+    """Low-cardinality route label for span names (same collapse rules
+    as the group plane's ``_route_template``)."""
+    if path in _FED_STATIC_ROUTES:
+        return path
+    if _DEBUG_TRACE_PATH.match(path):
+        return "/debug/traces/:id"
+    if m := _ENTITY_PATH.match(path):
+        suffix = "/httptransform" if m.group(4) else ""
+        return f"/{m.group(1)}:name/:datasetId{suffix}"
+    if m := _FEED_PATH.match(path):
+        return f"/{m.group(1)}:name"
+    return "<unmatched>"
+
+
 class FederationHandler(BaseHTTPRequestHandler):
     fed: Federation = None  # set by serve_federation()
     registry: MetricRegistry = None
+    rollup: GroupRollup = None
     protocol_version = "HTTP/1.1"
+
+    # class-level defaults keep _reply safe for direct/test callers that
+    # bypass _handle_request
+    request_id: str = "-"
+    trace_id: str = "-"
 
     def log_message(self, fmt, *args):
         logger.info("%s %s", self.address_string(), fmt % args)
 
     # -- plumbing -------------------------------------------------------------
+
+    def _handle_request(self, method: str, route_fn) -> None:
+        """Root-span wrapper (ISSUE 16): every plane request opens a
+        trace that honors an inbound W3C ``traceparent`` — the router's
+        partition/fan-out/merge spans and each group's re-anchored
+        subtree parent under it, so ``/debug/traces`` shows one causal
+        tree per federated request.  ``POST /federation/migrate`` forces
+        retention (``sampled=True``): migrations are rare, operator-
+        initiated, and their phase timeline must survive sampling."""
+        parsed = urlparse(self.path)
+        route = _fed_route_template(parsed.path)
+        self.request_id = new_request_id()
+        request_id_var.set(self.request_id)
+        with tracing.start_trace(
+            f"{method} {route}",
+            traceparent=self.headers.get("traceparent"),
+            sampled=True if route == "/federation/migrate" else None,
+            attributes={
+                "http.method": method,
+                "http.route": route,
+                "http.target": parsed.path,
+                "request_id": self.request_id,
+            },
+        ) as root:
+            self.trace_id = root.trace_id
+            try:
+                route_fn(parsed)
+            finally:
+                request_id_var.set("-")
 
     def _reply(self, status: int, body: bytes,
                content_type: str = "application/json",
@@ -138,6 +239,8 @@ class FederationHandler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        self.send_header("X-Request-Id", self.request_id)
+        self.send_header("X-Trace-Id", self.trace_id)
         for k, v in (extra_headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -161,7 +264,7 @@ class FederationHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         try:
-            self._route_get(urlparse(self.path))
+            self._handle_request("GET", self._route_get)
         except Exception:
             logger.exception("federation plane: error serving %s", self.path)
             self._reply(500, b"Internal server error", "text/plain")
@@ -169,7 +272,8 @@ class FederationHandler(BaseHTTPRequestHandler):
     def do_POST(self):
         body = self._read_body()
         try:
-            self._route_post(urlparse(self.path), body)
+            self._handle_request(
+                "POST", lambda parsed: self._route_post(parsed, body))
         except Exception:
             logger.exception("federation plane: error serving %s", self.path)
             self._reply(500, b"Internal server error", "text/plain")
@@ -183,13 +287,26 @@ class FederationHandler(BaseHTTPRequestHandler):
         elif path == "/stats":
             self._handle_stats()
         elif path == "/metrics":
-            body = telemetry.render(self.registry,
-                                    telemetry.GLOBAL).encode("utf-8")
+            # plane families + process-wide GLOBAL + the fleet rollup
+            # (each group's registry collected sequentially, merged
+            # sum/relabel — see telemetry/rollup.py)
+            body = telemetry.render(self.registry, telemetry.GLOBAL,
+                                    self.rollup).encode("utf-8")
             self._reply(200, body, telemetry.CONTENT_TYPE)
         elif path == "/federation/map":
             self._reply_json(200, self.fed.map.to_json())
         elif path == "/federation/migration":
             self._reply_json(200, self.fed.migration_status())
+        elif path == "/debug/traces":
+            self._reply(*debug_api.handle_traces())
+        elif m := _DEBUG_TRACE_PATH.match(path):
+            fmt = (parse_qs(parsed.query).get("format") or ["json"])[0]
+            self._reply(*debug_api.handle_trace(m.group(1), fmt))
+        elif path == "/debug/requests":
+            self._reply(*debug_api.handle_requests())
+        elif path == "/debug/migrations":
+            self._reply_json(200, {
+                "migrations": self.fed.migrator.timelines_snapshot()})
         elif m := _FEED_PATH.match(path):
             self._handle_feed(m, parse_qs(parsed.query))
         else:
@@ -345,6 +462,7 @@ class FederationHandler(BaseHTTPRequestHandler):
                         f"string!".encode(), "text/plain")
             return
         token = (query.get("since") or [""])[0]
+        t0 = time.monotonic()
         try:
             page = self.fed.router.feed_page(kind, name, token,
                                              _feed_page_size())
@@ -357,6 +475,12 @@ class FederationHandler(BaseHTTPRequestHandler):
                               f"must be specified in the "
                               f"configuration)").encode(), "text/plain")
             return
+        # always-on feed SLO signal + lag meter (ISSUE 16): page latency
+        # against DUKE_SLO_FEED_MS; a fully-drained page marks the feed
+        # caught up, so duke_feed_lag_seconds stops aging
+        slo.tracker("feed", kind, name).record(time.monotonic() - t0)
+        if page["drained"]:
+            slo.feed_meter(kind, name).note_drain()
         headers = {
             "X-Fed-Next-Since": page["next_since"],
             "X-Fed-Drained": "true" if page["drained"] else "false",
@@ -402,8 +526,18 @@ def serve_federation(fed: Federation, port: int = 0,
     returns the server (caller owns ``shutdown()``)."""
     registry = MetricRegistry()
     registry.register_collector(make_federation_collector(fed))
+    # fleet rollup (ISSUE 16): one registry per group, each carrying a
+    # lock-free workload-walking collector; GroupRollup snapshots them
+    # sequentially at scrape, so no group lock is ever held across
+    # another group's collection
+    group_regs = []
+    for g in fed.groups:
+        reg = MetricRegistry()
+        reg.register_collector(make_group_collector(g))
+        group_regs.append((str(g.idx), reg))
+    rollup = GroupRollup(group_regs)
     handler = type("BoundFederationHandler", (FederationHandler,),
-                   {"fed": fed, "registry": registry})
+                   {"fed": fed, "registry": registry, "rollup": rollup})
     server = ThreadingHTTPServer((host, port), handler)
     thread = threading.Thread(target=server.serve_forever,
                               name="federation-plane", daemon=True)

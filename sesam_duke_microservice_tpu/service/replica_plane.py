@@ -39,7 +39,15 @@ from urllib.parse import parse_qs, urlparse
 
 from .. import telemetry
 from ..links.replica import links_feed_page
-from .app import _FEED_PATH, _feed_page_size, _kind_label, write_chunk
+from ..telemetry import tracing
+from . import debug as debug_api
+from .app import (
+    _DEBUG_TRACE_PATH,
+    _FEED_PATH,
+    _feed_page_size,
+    _kind_label,
+    write_chunk,
+)
 
 logger = logging.getLogger("replica-plane")
 
@@ -88,7 +96,17 @@ class ReplicaReadHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         try:
-            self._route(urlparse(self.path))
+            parsed = urlparse(self.path)
+            # root span per request (ISSUE 16): same W3C propagation the
+            # leader and federation planes do, so /debug/requests and
+            # /debug/traces work on a read replica too
+            with tracing.start_trace(
+                f"GET {parsed.path}",
+                traceparent=self.headers.get("traceparent"),
+                attributes={"http.method": "GET",
+                            "http.target": parsed.path},
+            ):
+                self._route(parsed)
         except Exception:
             logger.exception("replica plane: error serving %s", self.path)
             self._reply(500, b"Internal server error", "text/plain")
@@ -116,11 +134,19 @@ class ReplicaReadHandler(BaseHTTPRequestHandler):
             self._reply(200, body, telemetry.CONTENT_TYPE)
         elif path == "/stats":
             self._handle_stats()
+        elif path == "/debug/traces":
+            self._reply(*debug_api.handle_traces())
+        elif m := _DEBUG_TRACE_PATH.match(path):
+            fmt = (parse_qs(parsed.query).get("format") or ["json"])[0]
+            self._reply(*debug_api.handle_trace(m.group(1), fmt))
+        elif path == "/debug/requests":
+            self._reply(*debug_api.handle_requests())
         elif m := _FEED_PATH.match(path):
             self._handle_feed(m, parse_qs(parsed.query))
         else:
             self._reply(404, b"Not found (replica read plane serves "
-                        b"feeds, /stats, /metrics and health probes)",
+                        b"feeds, /stats, /metrics, /debug/traces, "
+                        b"/debug/requests and health probes)",
                         "text/plain")
 
     def _handle_stats(self) -> None:

@@ -200,6 +200,20 @@ RECOVERY_REPLAYED = GLOBAL.counter(
     "Journaled link batches replayed into the durable link store by "
     "startup recovery (batches a crash stranded between ack and flush)",
 )
+# recovery progress (ISSUE 16): while /readyz says `recovering`, these
+# distinguish "almost done" from "wedged" — remaining counts down chunk
+# by chunk as the replay loop applies, applied counts up monotonically.
+RECOVERY_REPLAY_REMAINING = GLOBAL.gauge(
+    "duke_recovery_replay_remaining_batches",
+    "Journaled link batches still awaiting replay by the running "
+    "startup recovery (0 when recovery is idle or done)",
+)
+RECOVERY_REPLAY_APPLIED = GLOBAL.counter(
+    "duke_recovery_replay_applied_total",
+    "Journaled link batches applied by startup recovery replay loops "
+    "since process start (advances chunk by chunk while /readyz still "
+    "says recovering)",
+)
 SNAPSHOT_FALLBACKS = GLOBAL.counter(
     "duke_snapshot_fallbacks_total",
     "Corpus snapshots rejected into a full store replay, by reason "
@@ -215,3 +229,12 @@ MESH_DEVICES = GLOBAL.gauge(
     "duke_mesh_devices",
     "Devices in the serving mesh (0 until a sharded backend builds one)",
 )
+
+# -- runtime SLO signals (ISSUE 16: telemetry/slo.py) ------------------------
+# Imported last: slo only needs .env/.registry, and registering its
+# scrape-time collector here keeps every process that renders GLOBAL —
+# leader app, replica plane, federation plane — serving the burn-rate,
+# latency-objective and feed-lag families with no per-surface wiring.
+from . import slo  # noqa: E402,F401
+
+GLOBAL.register_collector(slo.collect)

@@ -128,6 +128,9 @@ def main() -> int:
             "owner": fed.map.find(moved_id).group,
             "frozen": fed.map.find(moved_id).frozen,
             "migrations": fed.migrator.outcomes,
+            # phase-timeline ring (ISSUE 16): newest-first, so [0] is
+            # the run's own (possibly resumed) migration
+            "timelines": fed.migrator.timelines_snapshot(),
         }), flush=True)
 
     fed.close()

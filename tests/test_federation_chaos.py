@@ -74,6 +74,27 @@ def _assert_differential(dump, control):
     assert dump["migrations"]["resumed"] >= 1
 
 
+def _assert_timeline(dump, *, resumed, full=True):
+    """Migration timeline completeness (ISSUE 16): the newest retained
+    /debug/migrations entry shows the run's own driver pass — every
+    phase in order with non-negative durations.  A crash that landed
+    after the persisted cutover leaves only the drain to redo."""
+    tl = dump["timelines"][0]
+    assert tl["resumed"] is resumed
+    assert tl["outcome"] == "completed"
+    phases = [p["phase"] for p in tl["phases"]]
+    if full:
+        assert phases == ["freeze", "snapshot", "replay", "cutover",
+                          "drain"]
+        snap = tl["phases"][1]
+        assert snap["records"] >= 1 and snap["record_bytes"] > 0
+        assert tl["phases"][0]["epoch"] < tl["phases"][3]["epoch"]
+    else:
+        assert phases == ["drain"]
+    for p in tl["phases"]:
+        assert p["duration_ms"] >= 0 and p["start_unix"] > 0
+
+
 MIGRATION_SITES = ["pre_freeze", "post_snapshot", "mid_replay",
                    "pre_cutover", "post_cutover"]
 
@@ -97,6 +118,11 @@ def test_migration_kill_differential(site, control_dump, tmp_path):
                                 start=N_BATCHES)
     assert proc2.returncode == 0, proc2.stderr
     _assert_differential(dump, control_dump)
+    # the restarted process's ring holds exactly the constructor's
+    # resume pass (the explicit re-migrate reports already_owned and
+    # never enters the driver); post_cutover resumes are drain-only
+    assert len(dump["timelines"]) == 1
+    _assert_timeline(dump, resumed=True, full=(site != "post_cutover"))
 
 
 def test_clean_migration_matches_control(control_dump, tmp_path):
@@ -110,6 +136,8 @@ def test_clean_migration_matches_control(control_dump, tmp_path):
     assert dump["owner"] == 1 and dump["frozen"] is False
     assert dump["migrations"]["completed"] == 1
     assert dump["migrations"]["resumed"] == 0
+    assert len(dump["timelines"]) == 1
+    _assert_timeline(dump, resumed=False)
 
 
 def test_double_kill_still_converges(control_dump, tmp_path):

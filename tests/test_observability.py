@@ -1,0 +1,568 @@
+"""Federation-wide observability plane (ISSUE 16).
+
+Covers the three tentpole layers end to end:
+
+  * cross-group trace propagation — the router's partition/fan-out/merge
+    spans, per-group outcome spans (ok / degraded / stale-epoch), and
+    the group-side ``group.ingest`` subtree captured across the
+    ``LocalGroup`` seam and re-anchored into ONE causal tree, verified
+    both in-process and through the plane's ``/debug/traces``;
+  * fleet metrics rollup — the plane's ``/metrics`` proven EQUAL to the
+    per-group sums for counters and histogram buckets and label-disjoint
+    for relabeled gauges (the differential the acceptance pins);
+  * runtime SLO signals — burn-rate window math on the violation ring,
+    feed-lag metering, and the always-on families in the exposition.
+
+Satellites riding along: /debug routes on the replica plane, the
+migration phase-timeline ring on ``/debug/migrations`` (kill-site
+completeness lives in tests/test_federation_chaos.py), and the recovery
+replay progress gauges.
+"""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from sesam_duke_microservice_tpu import telemetry
+from sesam_duke_microservice_tpu.federation.ranges import PartitionMap
+from sesam_duke_microservice_tpu.federation.router import (
+    FederationRouter,
+    PartialIngestFailure,
+)
+from sesam_duke_microservice_tpu.telemetry import slo, tracing
+from sesam_duke_microservice_tpu.telemetry.registry import (
+    DEFAULT_LATENCY_BUCKETS,
+    FamilySnapshot,
+)
+from sesam_duke_microservice_tpu.telemetry.rollup import (
+    GroupRollup,
+    merge_groups,
+)
+from sesam_duke_microservice_tpu.utils import faults
+
+from test_federation import FED_XML, duplicate_batch, make_fed  # noqa: F401
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    faults.configure("")
+    slo._reset_for_tests()
+    yield
+    faults.configure(None)
+    slo._reset_for_tests()
+    # the plane tests retain traces (including a fixed upstream trace
+    # id) in the process flight recorder; later suites assert on its
+    # contents, so leave it as empty as we found it
+    tracing.RECORDER.clear()
+
+
+# -- layer 3: SLO burn-rate math ----------------------------------------------
+
+
+class TestSloTracker:
+    def test_violation_counting_and_histogram(self):
+        t = slo.SloTracker(objective_s=0.1, target=0.99)
+        now = 1_000_000.0
+        t.record_batch([0.05, 0.2, 0.3, 0.01], now)
+        (counts, total, count), v_total, windows = t.scrape(now)
+        assert count == 4 and v_total == 2
+        assert total == pytest.approx(0.56)
+        assert sum(counts) == 4
+        assert windows["5m"] == (4, 2, pytest.approx((2 / 4) / 0.01))
+        assert windows["1h"] == (4, 2, pytest.approx((2 / 4) / 0.01))
+
+    def test_windows_age_out_independently(self):
+        """A violation 400 s old burns the 1h window but not the 5m one
+        — the multi-window discipline that keeps slow burns visible."""
+        t = slo.SloTracker(objective_s=0.1, target=0.99)
+        now = 1_000_000.0
+        t.record_batch([0.5], now - 400)   # outside 5m, inside 1h
+        t.record_batch([0.01], now)        # fresh, within objective
+        _, v_total, windows = t.scrape(now)
+        assert v_total == 2 - 1            # one violation ever
+        assert windows["5m"][1] == 0
+        assert windows["1h"][1] == 1
+        assert windows["5m"][2] == 0.0
+        assert windows["1h"][2] > 0.0
+
+    def test_burn_rate_one_spends_exactly_the_budget(self):
+        """100 requests, 1 violation, target 0.99 → burn rate 1.0."""
+        t = slo.SloTracker(objective_s=0.1, target=0.99)
+        now = 1_000_000.0
+        t.record_batch([0.01] * 99 + [0.5], now)
+        _, _, windows = t.scrape(now)
+        assert windows["5m"][2] == pytest.approx(1.0)
+
+    def test_tracker_registry_and_objective_env(self, monkeypatch):
+        monkeypatch.setenv("DUKE_SLO_FEED_MS", "250")
+        slo._reset_for_tests()
+        t = slo.tracker("feed", "deduplication", "people")
+        assert t.objective_s == pytest.approx(0.25)
+        assert slo.tracker("feed", "deduplication", "people") is t
+
+    def test_families_always_render_on_global(self):
+        slo.tracker("ingest", "deduplication", "people").record(0.001)
+        slo.feed_meter("deduplication", "people").note_write(100.0)
+        text = telemetry.render(telemetry.GLOBAL)
+        for fam in ("duke_slo_ingest_latency_seconds",
+                    "duke_slo_feed_latency_seconds",
+                    "duke_slo_violations_total", "duke_slo_burn_rate",
+                    "duke_slo_objective_seconds", "duke_feed_lag_seconds",
+                    "duke_recovery_replay_remaining_batches",
+                    "duke_recovery_replay_applied_total"):
+            assert fam in text, fam
+        assert 'window="5m"' in text and 'window="1h"' in text
+
+
+class TestFeedLagMeter:
+    def test_lag_ages_from_oldest_pending_write(self):
+        m = slo.FeedLagMeter()
+        assert m.lag_seconds() == 0.0
+        m.note_write(100.0)
+        m.note_write(150.0)  # oldest pending stays at 100
+        assert m.lag_seconds(160.0) == pytest.approx(60.0)
+
+    def test_drain_resets_to_caught_up(self):
+        m = slo.FeedLagMeter()
+        m.note_write(100.0)
+        m.note_drain()
+        assert m.lag_seconds(1000.0) == 0.0
+        m.note_write(200.0)
+        assert m.lag_seconds(205.0) == pytest.approx(5.0)
+
+
+# -- layer 2: rollup merge semantics ------------------------------------------
+
+
+class TestMergeGroups:
+    def test_counters_sum_gauges_relabel(self):
+        labels = (("kind", "deduplication"), ("workload", "people"))
+        per_group = [
+            ("0", [FamilySnapshot("duke_x_total", "counter", "h",
+                                  [("", labels, 3.0)]),
+                   FamilySnapshot("duke_g", "gauge", "h",
+                                  [("", labels, 7.0)])]),
+            ("1", [FamilySnapshot("duke_x_total", "counter", "h",
+                                  [("", labels, 5.0)]),
+                   FamilySnapshot("duke_g", "gauge", "h",
+                                  [("", labels, 9.0)])]),
+        ]
+        merged = {f.name: f for f in merge_groups(per_group)}
+        assert merged["duke_x_total"].samples == [("", labels, 8.0)]
+        gauge = sorted(merged["duke_g"].samples)
+        assert gauge == [
+            ("", labels + (("group", "0"),), 7.0),
+            ("", labels + (("group", "1"),), 9.0),
+        ]
+
+    def test_histogram_buckets_sum_bucketwise(self):
+        def hist(n):
+            return FamilySnapshot("duke_h_seconds", "histogram", "h", [
+                ("_bucket", (("le", "0.1"),), float(n)),
+                ("_bucket", (("le", "+Inf"),), float(n + 1)),
+                ("_sum", (), 0.5 * n),
+                ("_count", (), float(n + 1)),
+            ])
+        merged = merge_groups([("0", [hist(2)]), ("1", [hist(4)])])
+        samples = dict(((s[0], s[1]), s[2]) for s in merged[0].samples)
+        assert samples[("_bucket", (("le", "0.1"),))] == 6.0
+        assert samples[("_bucket", (("le", "+Inf"),))] == 8.0
+        assert samples[("_sum", ())] == pytest.approx(3.0)
+        assert samples[("_count", ())] == 8.0
+
+
+# -- layer 1: trace propagation across the LocalGroup seam --------------------
+
+
+def _spans_by_name(record):
+    out = {}
+    for s in record.spans:
+        out.setdefault(s.name, []).append(s)
+    return out
+
+
+class TestFederatedTracePropagation:
+    def test_one_causal_tree_for_a_federated_ingest(self, tmp_path):
+        fed = make_fed(tmp_path, n_groups=2)
+        rec = tracing.FlightRecorder(8, 64)
+        try:
+            with tracing.start_trace("fed ingest", sampled=True,
+                                     recorder=rec) as root:
+                tid = root.trace_id
+                result = fed.router.submit("deduplication", "people",
+                                           "crm", duplicate_batch(24))
+            assert result["success"] is True
+            record = rec.get(tid)
+            assert record is not None
+            by_name = _spans_by_name(record)
+            for name in ("fed.partition", "fed.fanout", "fed.merge"):
+                assert name in by_name, name
+            fanout = by_name["fed.fanout"][0]
+            group_spans = by_name["fed.group"]
+            assert {s.attributes["group"] for s in group_spans} == {0, 1}
+            assert all(s.attributes["outcome"] == "ok"
+                       for s in group_spans)
+            assert all(len(s.attributes["ranges"]) >= 1
+                       for s in group_spans)
+            # the group-side subtree crossed the seam: re-anchored
+            # remote spans, same trace id, parented under the fan-out
+            remote = by_name["group.ingest"]
+            assert {s.attributes["group"] for s in remote} == {0, 1}
+            for s in remote:
+                assert s.trace_id == tid
+                assert s.attributes["remote"] is True
+                assert s.parent_id == fanout.span_id
+        finally:
+            fed.close()
+
+    def test_degraded_group_span_outcome(self, tmp_path):
+        fed = make_fed(tmp_path, n_groups=2)
+        rec = tracing.FlightRecorder(8, 64)
+        try:
+            fed.router.submit("deduplication", "people", "crm",
+                              duplicate_batch(12))
+            faults.configure("fed_down=1")
+            with tracing.start_trace("fed ingest degraded", sampled=True,
+                                     recorder=rec) as root:
+                tid = root.trace_id
+                with pytest.raises(PartialIngestFailure):
+                    fed.router.submit("deduplication", "people", "crm",
+                                      duplicate_batch(24, start=100))
+            by_name = _spans_by_name(rec.get(tid))
+            outcomes = {s.attributes["group"]: s.attributes["outcome"]
+                        for s in by_name["fed.group"]}
+            assert outcomes[1] == "degraded"
+            assert outcomes[0] == "ok"
+            # only the live group's subtree came back across the seam
+            assert {s.attributes["group"]
+                    for s in by_name.get("group.ingest", [])} == {0}
+        finally:
+            faults.configure("")
+            fed.close()
+
+    def test_stale_epoch_span_outcome(self, tmp_path):
+        from sesam_duke_microservice_tpu.federation.ranges import (
+            StaleRouterEpoch,
+        )
+
+        fed = make_fed(tmp_path, n_groups=2)
+        rec = tracing.FlightRecorder(8, 64)
+        try:
+            stale_map = PartitionMap.load(fed.map.path)
+            stale_router = FederationRouter(lambda: stale_map, fed.groups)
+            for g in fed.groups:
+                g.fence(stale_map.epoch + 5)  # topology moved on
+            with tracing.start_trace("fed ingest stale", sampled=True,
+                                     recorder=rec) as root:
+                tid = root.trace_id
+                with pytest.raises(StaleRouterEpoch):
+                    stale_router.submit("deduplication", "people", "crm",
+                                        duplicate_batch(8))
+            by_name = _spans_by_name(rec.get(tid))
+            assert any(s.attributes["outcome"] == "stale-epoch"
+                       for s in by_name["fed.group"])
+        finally:
+            fed.close()
+
+    def test_untraced_hot_path_is_span_free(self, tmp_path):
+        """No active trace → no spans recorded anywhere (the sampling
+        overhead stance: the unsampled path never builds span objects)."""
+        fed = make_fed(tmp_path, n_groups=2)
+        rec = tracing.FlightRecorder(8, 64)
+        try:
+            fed.router.submit("deduplication", "people", "crm",
+                              duplicate_batch(12))
+            assert rec.summaries() == []
+            assert tracing.propagation_context() is None
+        finally:
+            fed.close()
+
+
+# -- the plane: /metrics differential + debug surface -------------------------
+
+
+_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+                        r"(?:\{(.*)\})?\s+(\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+
+def parse_exposition(text):
+    """{(name_with_suffix, sorted-label-tuple): value} for every sample
+    line in a Prometheus exposition body."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        name, labels, value = m.groups()
+        lbls = tuple(sorted(_LABEL_RE.findall(labels or "")))
+        out[(name, lbls)] = float(value)
+    return out
+
+
+class TestFederationPlaneObservability:
+    @pytest.fixture()
+    def plane(self, tmp_path):
+        from sesam_duke_microservice_tpu.service.federation_plane import (
+            serve_federation,
+        )
+
+        fed = make_fed(tmp_path, n_groups=2)
+        server = serve_federation(fed)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        yield fed, base
+        server.shutdown()
+        fed.close()
+
+    @staticmethod
+    def _get(url):
+        return urllib.request.urlopen(url, timeout=60)
+
+    @staticmethod
+    def _post(url, obj):
+        req = urllib.request.Request(
+            url, data=json.dumps(obj).encode("utf-8"), method="POST",
+            headers={"Content-Type": "application/json"})
+        return urllib.request.urlopen(req, timeout=60)
+
+    def test_fleet_rollup_equals_per_group_sums(self, plane):
+        """The acceptance differential: for counters and histogram
+        buckets the fleet exposition equals the key-wise SUM of the
+        groups' own collector outputs; gauges appear once per group
+        under disjoint ``group=`` label sets, never summed."""
+        from sesam_duke_microservice_tpu.service.metrics import (
+            make_group_collector,
+        )
+
+        fed, base = plane
+        with self._post(base + "/deduplication/people/crm",
+                        duplicate_batch(24)) as r:
+            assert r.status == 200
+        # settle the write-behind link flushers so the two scrapes (the
+        # direct collector call and the HTTP one) see the same state
+        for g in fed.groups:
+            for wl in g.workloads.values():
+                wl.link_database.drain()
+
+        expected_sums = {}
+        expected_gauges = {}
+        for g in fed.groups:
+            for fam in make_group_collector(g)():
+                for suffix, labels, value in fam.samples:
+                    if fam.mtype == "gauge":
+                        key = (fam.name + suffix, tuple(sorted(
+                            labels + (("group", str(g.idx)),))))
+                        expected_gauges[key] = float(value)
+                    else:
+                        key = (fam.name + suffix, tuple(sorted(labels)))
+                        expected_sums[key] = (
+                            expected_sums.get(key, 0.0) + float(value))
+
+        with self._get(base + "/metrics") as r:
+            scraped = parse_exposition(r.read().decode("utf-8"))
+
+        assert expected_sums, "group collectors produced no counters"
+        for key, value in expected_sums.items():
+            assert key in scraped, key
+            assert scraped[key] == pytest.approx(value), key
+        # the summed ingest counter really covers the whole batch
+        total = sum(v for (n, ls), v in expected_sums.items()
+                    if n == "duke_engine_records_processed_total")
+        assert total == 24
+        for key, value in expected_gauges.items():
+            assert key in scraped, key
+            assert scraped[key] == pytest.approx(value), key
+        # relabeled gauges: every per-workload gauge sample carries a
+        # group label, and the per-group label sets are disjoint
+        depth_keys = [ls for (n, ls) in scraped
+                      if n == "duke_ingest_queue_depth"]
+        assert depth_keys
+        assert all(any(k == "group" for k, _v in ls) for ls in depth_keys)
+        assert len(depth_keys) == len(set(depth_keys)) == len(fed.groups)
+        # the per-range scatter families joined the fed collector
+        assert any(n == "duke_fed_range_requests_total"
+                   and ("outcome", "ok") in ls for (n, ls) in scraped)
+        assert any(n == "duke_fed_range_latency_seconds_count"
+                   for (n, ls) in scraped)
+
+    def test_retained_federated_trace_on_debug_traces(self, plane,
+                                                      monkeypatch):
+        """Acceptance: one retained trace tree spans plane root → router
+        fan-out → group ingest for a real federated POST, read back off
+        the plane's own /debug/traces."""
+        monkeypatch.setenv("TRACE_SAMPLE_RATE", "1.0")
+        fed, base = plane
+        with self._post(base + "/deduplication/people/crm",
+                        duplicate_batch(24)) as r:
+            assert r.status == 200
+            tid = r.headers["X-Trace-Id"]
+            assert r.headers["X-Request-Id"]
+        assert re.fullmatch(r"[0-9a-f]{32}", tid)
+        with self._get(base + "/debug/traces") as r:
+            summaries = json.loads(r.read())["traces"]
+        assert any(s["trace_id"] == tid for s in summaries)
+        with self._get(base + f"/debug/traces/{tid}") as r:
+            tree = json.loads(r.read())
+        assert tree["name"] == "POST /deduplication:name/:datasetId"
+        names = [s["name"] for s in tree["spans"]]
+        for required in ("fed.partition", "fed.fanout", "fed.group",
+                         "fed.merge", "group.ingest"):
+            assert required in names, required
+        fanout = next(s for s in tree["spans"]
+                      if s["name"] == "fed.fanout")
+        remote = [s for s in tree["spans"] if s["name"] == "group.ingest"]
+        assert {s["attributes"]["group"] for s in remote} == {0, 1}
+        for s in remote:
+            assert s["attributes"]["remote"] is True
+            assert s["parent_id"] == fanout["span_id"]
+        with self._get(base + "/debug/requests") as r:
+            digests = json.loads(r.read())["requests"]
+        assert any(d["trace_id"] == tid and d["retained"]
+                   for d in digests)
+
+    def test_traceparent_header_continues_the_callers_trace(self, plane):
+        fed, base = plane
+        upstream = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        req = urllib.request.Request(
+            base + "/deduplication/people/crm",
+            data=json.dumps(duplicate_batch(8)).encode("utf-8"),
+            method="POST",
+            headers={"Content-Type": "application/json",
+                     "traceparent": upstream})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.status == 200
+            assert r.headers["X-Trace-Id"] == "ab" * 16
+        # sampled flag inherited from the header → tree retained, and
+        # the remote group spans carry the SAME inherited trace id
+        with self._get(base + "/debug/traces/" + "ab" * 16) as r:
+            tree = json.loads(r.read())
+        assert any(s["name"] == "group.ingest" for s in tree["spans"])
+
+    def test_migration_timeline_ring_and_trace(self, plane):
+        fed, base = plane
+        with self._post(base + "/deduplication/people/crm",
+                        duplicate_batch(24)) as r:
+            assert r.status == 200
+        mp = json.loads(self._get(base + "/federation/map").read())
+        moved = next(x for x in mp["ranges"] if x["group"] == 0)
+        with self._post(base + "/federation/migrate",
+                        {"range": moved["id"], "target": 1}) as r:
+            assert r.status == 200
+        with self._get(base + "/debug/migrations") as r:
+            timelines = json.loads(r.read())["migrations"]
+        assert len(timelines) == 1
+        tl = timelines[0]
+        assert tl["range"] == moved["id"]
+        assert tl["outcome"] == "completed" and tl["resumed"] is False
+        assert [p["phase"] for p in tl["phases"]] == [
+            "freeze", "snapshot", "replay", "cutover", "drain"]
+        snap = tl["phases"][1]
+        assert snap["records"] > 0 and snap["record_bytes"] > 0
+        # the migrate route forces retention (sampled=True): the phase
+        # spans are readable under the timeline's own trace id
+        assert tl["trace_id"]
+        with self._get(base + f"/debug/traces/{tl['trace_id']}") as r:
+            names = [s["name"] for s in json.loads(r.read())["spans"]]
+        for phase in ("freeze", "snapshot", "replay", "cutover", "drain"):
+            assert f"migrate.{phase}" in names, phase
+
+    def test_feed_slo_and_lag_on_plane_metrics(self, plane):
+        fed, base = plane
+        with self._post(base + "/deduplication/people/crm",
+                        duplicate_batch(24)) as r:
+            assert r.status == 200
+        with self._get(base + "/deduplication/people?since=") as r:
+            assert r.headers["X-Fed-Drained"] == "true"
+        with self._get(base + "/metrics") as r:
+            scraped = parse_exposition(r.read().decode("utf-8"))
+        feed_count = scraped.get((
+            "duke_slo_feed_latency_seconds_count",
+            (("kind", "deduplication"), ("workload", "people"))))
+        assert feed_count is not None and feed_count >= 1
+        # group ingest bypasses the service scheduler, so the group
+        # boundary records the ingest SLO signal — one observation per
+        # routed sub-batch (2 groups hit here)
+        ingest_count = scraped.get((
+            "duke_slo_ingest_latency_seconds_count",
+            (("kind", "deduplication"), ("workload", "people"))))
+        assert ingest_count is not None and ingest_count >= 2
+        # drained feed → caught up → zero lag
+        lag = scraped.get((
+            "duke_feed_lag_seconds",
+            (("kind", "deduplication"), ("workload", "people"))))
+        assert lag == 0.0
+
+
+# -- replica plane debug routes -----------------------------------------------
+
+
+class _StubSession:
+    replicas = {}
+    link_replicas = {}
+    epoch = 1
+    follower_idx = 0
+    stale_rejected = 0
+
+
+class TestReplicaPlaneDebugRoutes:
+    @pytest.fixture()
+    def replica_base(self):
+        from sesam_duke_microservice_tpu.service.replica_plane import (
+            serve_replica_plane,
+        )
+
+        server = serve_replica_plane(_StubSession(), port=0,
+                                     host="127.0.0.1")
+        yield f"http://127.0.0.1:{server.server_address[1]}"
+        server.shutdown()
+
+    def test_debug_routes_mounted(self, replica_base):
+        with urllib.request.urlopen(replica_base + "/debug/traces",
+                                    timeout=60) as r:
+            assert r.status == 200
+            assert "traces" in json.loads(r.read())
+        with urllib.request.urlopen(replica_base + "/debug/requests",
+                                    timeout=60) as r:
+            assert r.status == 200
+            digests = json.loads(r.read())["requests"]
+        # the replica root span digests its own requests
+        assert any(d["name"] == "GET /debug/traces" for d in digests)
+
+    def test_404_advertises_debug_routes(self, replica_base):
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(replica_base + "/nope", timeout=60)
+        assert exc.value.code == 404
+        assert b"/debug/traces" in exc.value.read()
+
+
+# -- rollup shim renders through telemetry.render -----------------------------
+
+
+def test_group_rollup_is_render_compatible():
+    reg0, reg1 = telemetry.MetricRegistry(), telemetry.MetricRegistry()
+    reg0.counter("duke_t_total", "h").inc(2)
+    reg1.counter("duke_t_total", "h").inc(3)
+    reg0.gauge("duke_t_gauge", "h").set(1)
+    reg1.gauge("duke_t_gauge", "h").set(4)
+    text = telemetry.render(GroupRollup([("0", reg0), ("1", reg1)]))
+    scraped = parse_exposition(text)
+    assert scraped[("duke_t_total", ())] == 5.0
+    assert scraped[("duke_t_gauge", (("group", "0"),))] == 1.0
+    assert scraped[("duke_t_gauge", (("group", "1"),))] == 4.0
+
+
+def test_slo_histogram_ladder_matches_shared_buckets():
+    """The SLO histograms ride the shared ladder, so fleet merging of
+    their buckets is lossless by construction."""
+    t = slo.SloTracker(0.1, 0.99)
+    t.record_batch([b * 0.99 for b in DEFAULT_LATENCY_BUCKETS], 0.0)
+    (counts, _total, count), _, _ = t.scrape(0.0)
+    assert count == len(DEFAULT_LATENCY_BUCKETS)
+    assert len(counts) == len(DEFAULT_LATENCY_BUCKETS) + 1
+    assert counts[-1] == 0  # nothing past the +Inf boundary's last bound

@@ -435,9 +435,17 @@ def test_debug_requests_ring_always_on(server_url, monkeypatch):
     status, headers, _ = _request(server_url + "/stats")
     assert status == 200
     tid = headers["X-Trace-Id"]
-    status, _, out = _request(server_url + "/debug/requests")
-    rows = json.loads(out)["requests"]
-    mine = [r for r in rows if r["trace_id"] == tid]
+    # the digest lands at root-span exit, AFTER the response is on the
+    # wire — a fresh connection can race the handler thread's last few
+    # instructions, so poll briefly
+    mine = []
+    for _ in range(50):
+        status, _, out = _request(server_url + "/debug/requests")
+        rows = json.loads(out)["requests"]
+        mine = [r for r in rows if r["trace_id"] == tid]
+        if mine:
+            break
+        time.sleep(0.02)
     assert mine and mine[0]["retained"] is False
     assert mine[0]["name"] == "GET /stats"
     # but the unretained request still answered 404 on the tree endpoint
