@@ -1238,6 +1238,15 @@ FED_GROUPS = int(os.environ.get("BENCH_FED_GROUPS", "3"))
 # BENCH_OBS=0 skips it.
 OBS_BENCH = os.environ.get("BENCH_OBS", "1") != "0"
 OBS_RUNS = int(os.environ.get("BENCH_OBS_RUNS", "2"))
+# capacity-attribution overhead bench (ISSUE 17): the same federated
+# scatter-ingest with the cost ledger + sub-range heat map on (default)
+# vs off (costs.configure(False), DUKE_FED_HEAT=0) — the attribution
+# hot-path additions are one locked add per BATCH and one unlocked
+# histogram increment per record, budgeted at <2% ingest slowdown —
+# plus a skewed-keyspace scenario (80% of traffic in 5% of one range)
+# asserting the suggested split point lands in the hot band.
+# BENCH_CAPACITY=0 skips it.
+CAP_BENCH = os.environ.get("BENCH_CAPACITY", "1") != "0"
 
 FED_XML = """
 <DukeMicroService dataFolder="{folder}">
@@ -1418,6 +1427,125 @@ def observability_bench() -> dict:
         "groups": FED_GROUPS,
         "records": FED_RECORDS,
         "runs_per_arm": max(1, OBS_RUNS),
+    }
+
+
+def capacity_bench() -> dict:
+    """Attribution-overhead differential + skewed-keyspace split check
+    (ISSUE 17).  Arm ON is the default service config (cost ledger
+    crediting every batch, heat map bucketing every routed record); arm
+    OFF disables both, so the differential isolates exactly what the
+    attribution layer adds to the ingest path.  Interleaved best-of, as
+    in observability_bench.  The skew scenario rejection-samples record
+    ids whose route keys put 80% of traffic in the first 5% of one
+    range's keyspan, then checks the suggested split point bisects the
+    OBSERVED load (lands inside the hot band) instead of the naive
+    midpoint."""
+    import tempfile
+
+    from sesam_duke_microservice_tpu.core.config import parse_config
+    from sesam_duke_microservice_tpu.federation import Federation
+    from sesam_duke_microservice_tpu.federation.ranges import route_key
+    from sesam_duke_microservice_tpu.telemetry import costs, heat
+
+    def entities(n):
+        return [{"_id": str(i), "name": f"person number {i % 64}",
+                 "email": f"p{i % 64}@x.no"} for i in range(n)]
+
+    batches = [entities(FED_RECORDS)[i:i + FED_BATCH]
+               for i in range(0, FED_RECORDS, FED_BATCH)]
+
+    def one_run(attributed: bool) -> float:
+        tmp = tempfile.mkdtemp(prefix="cap-bench-")
+        sc = parse_config(FED_XML.format(folder=tmp),
+                          env={"MIN_RELEVANCE": "0.05"})
+        costs.configure(attributed)
+        old_heat = os.environ.get("DUKE_FED_HEAT")
+        if not attributed:
+            os.environ["DUKE_FED_HEAT"] = "0"
+        try:
+            fed = Federation(sc, n_groups=FED_GROUPS)
+        finally:
+            if not attributed:
+                if old_heat is None:
+                    os.environ.pop("DUKE_FED_HEAT", None)
+                else:
+                    os.environ["DUKE_FED_HEAT"] = old_heat
+        t0 = time.monotonic()
+        for batch in batches:
+            fed.router.submit("deduplication", "bench", "crm", batch)
+        ingest_s = time.monotonic() - t0
+        fed.close()
+        costs.configure(True)
+        return ingest_s
+
+    one_run(attributed=True)  # untimed warm-up
+    runs = max(1, OBS_RUNS)
+    off_s = on_s = math.inf
+    for _ in range(runs):
+        off_s = min(off_s, one_run(attributed=False))
+        on_s = min(on_s, one_run(attributed=True))
+    off_rate = FED_RECORDS / off_s
+    on_rate = FED_RECORDS / on_s
+    overhead_pct = round((off_rate - on_rate) / off_rate * 100.0, 2)
+
+    # -- skewed keyspace: 80% of traffic into 5% of one range ---------------
+    tmp = tempfile.mkdtemp(prefix="cap-skew-")
+    sc = parse_config(FED_XML.format(folder=tmp),
+                      env={"MIN_RELEVANCE": "0.05"})
+    fed = Federation(sc, n_groups=FED_GROUPS)
+    try:
+        ds = fed.groups[0].workload(
+            "deduplication", "bench").datasources["crm"]
+        target = fed.map.owner(route_key(ds.record_id_for_entity(
+            {"_id": "probe"})))
+        span = target.hi - target.lo
+        hot_hi = target.lo + span // 20  # first 5% of the keyspan
+
+        def sample(n, lo, hi):
+            out, i = [], 0
+            while len(out) < n:
+                cand = f"skew{i}"
+                i += 1
+                key = route_key(ds.record_id_for_entity({"_id": cand}))
+                if lo <= key < hi:
+                    out.append(cand)
+            return out
+
+        hot = sample(400, target.lo, hot_hi)
+        cold = sample(100, target.lo, target.hi)
+        batch = [{"_id": rid, "name": f"person number {j % 64}",
+                  "email": f"p{j % 64}@x.no"}
+                 for j, rid in enumerate(hot + cold)]
+        fed.router.submit("deduplication", "bench", "crm", batch)
+        row = next(r for r in heat.loadmap(fed.router.heat)["ranges"]
+                   if r["range"] == target.range_id)
+        split = int(row["suggested_split"], 16)
+        # a load-bisecting split sits in (or one bucket past) the hot
+        # band; the naive midpoint would be ~10x further right
+        in_hot_band = target.lo < split <= hot_hi + span // heat.N_BUCKETS
+        skew = {
+            "range": target.range_id,
+            "records": len(batch),
+            "hot_band_hi": f"{hot_hi:016x}",
+            "suggested_split": row["suggested_split"],
+            "split_in_hot_band": in_hot_band,
+        }
+    finally:
+        fed.close()
+
+    return {
+        "metric": "attribution_overhead_pct",
+        "value": overhead_pct,
+        # the ISSUE 17 acceptance budget: cost/heat attribution costs
+        # the federated ingest path <2% throughput
+        "within_budget": overhead_pct < 2.0,
+        "records_per_sec_attribution_on": round(on_rate, 1),
+        "records_per_sec_attribution_off": round(off_rate, 1),
+        "groups": FED_GROUPS,
+        "records": FED_RECORDS,
+        "runs_per_arm": runs,
+        "skew": skew,
     }
 
 
@@ -1765,6 +1893,8 @@ def main():
         result["federation"] = federation_bench()
     if OBS_BENCH and BACKEND == "device":
         result["observability"] = observability_bench()
+    if CAP_BENCH and BACKEND == "device":
+        result["capacity"] = capacity_bench()
     if TAIL and BACKEND == "device":
         result["tail_latency"] = tail_latency_bench()
     print(json.dumps(result))

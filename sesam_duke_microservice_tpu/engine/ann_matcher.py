@@ -43,6 +43,7 @@ the int8 scale vector.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Optional, Sequence
 
 import numpy as np
@@ -226,10 +227,14 @@ class _AnnScorerCache(_ScorerCache):
 
         key = ("ivf", top_c, nprobe, group_filtering, from_rows)
         if key not in self._scorers:
+            from ..telemetry import costs
+
             record_compile()
+            t_compile = time.monotonic()
             self._scorers[key] = self._build_ivf(
                 top_c, nprobe, group_filtering, from_rows
             )
+            costs.note_compile(time.monotonic() - t_compile)
         else:
             record_cache_hit()
         return self._scorers[key]

@@ -53,7 +53,7 @@ from ..core.records import GROUP_NO_PROPERTY_NAME, Record, SchemaError
 from ..index.base import CandidateIndex
 from ..ops import features as F
 from ..ops.features import CHARS as _F_CHARS, CHARS_WEIGHTED as _F_CHARS_W
-from ..telemetry import tracing
+from ..telemetry import costs, tracing
 from ..telemetry.env import env_flag, env_int, env_int_tuple, env_str
 from .scheduler import DEFAULT_QUERY_BUCKETS
 from ..utils.jit_cache import record_cache_hit, record_compile
@@ -2000,6 +2000,7 @@ class _ScorerCache:
             record_compile()
             ctx = (self._cache_bypass() if store is not None
                    else contextlib.nullcontext())
+            t_compile = time.monotonic()
             with ctx:
                 compiled = self._lower_one(
                     row_feats, cap_i, bucket, group_filtering,
@@ -2007,6 +2008,7 @@ class _ScorerCache:
                     probe_feats=None if from_rows else probe_feats,
                     plan=plan,
                 )
+            costs.note_compile(time.monotonic() - t_compile)
             self._warm_compiled += 1
             k = self._ladder_k(cap_i)
             akey = (k, bool(group_filtering), bool(from_rows),
@@ -2056,8 +2058,10 @@ class _ScorerCache:
             # first call (or reads the persistent cache).  The counter
             # pair makes recompile storms visible on /metrics.
             record_compile()
+            t_compile = time.monotonic()
             self._scorers[key] = self._build(top_k, group_filtering,
                                              from_rows)
+            costs.note_compile(time.monotonic() - t_compile)
         else:
             record_cache_hit()
         return self._scorers[key]
@@ -2410,7 +2414,8 @@ class DeviceProcessor:
             for record in records:
                 self.database.index(record)
             self.database.commit()
-        self.phases.observe(PHASE_ENCODE, time.monotonic() - t0)
+        encode_dt = time.monotonic() - t0
+        self.phases.observe(PHASE_ENCODE, encode_dt)
         retrieval0 = self.stats.retrieval_seconds
         compare0 = self.stats.compare_seconds
         # corpus growth / value-slot widening changes the scorer shapes;
@@ -2449,7 +2454,11 @@ class DeviceProcessor:
         with tracing.span(PHASE_PERSIST, annotate=True):
             for listener in self.listeners:
                 listener.batch_done()
-        self.phases.observe(PHASE_PERSIST, time.monotonic() - t_persist)
+        persist_dt = time.monotonic() - t_persist
+        self.phases.observe(PHASE_PERSIST, persist_dt)
+        # the same four durations feed the process-wide busy ledger, so
+        # per-workload phase counters reconcile against it by definition
+        costs.note_busy(encode_dt + retrieve_dt + score_dt + persist_dt)
         if self.profile:
             logger.info(
                 "batch=%d records, corpus=%d, %.3fs",

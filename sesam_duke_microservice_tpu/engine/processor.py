@@ -36,7 +36,7 @@ from ..core.bayes import combine_probabilities
 from ..core.config import DukeSchema
 from ..core.records import Record
 from ..index.base import CandidateIndex
-from ..telemetry import PhaseRecorder, tracing
+from ..telemetry import PhaseRecorder, costs, tracing
 from .listeners import MatchListener
 
 # Per-batch engine phases recorded into each processor's PhaseRecorder
@@ -183,10 +183,14 @@ class Processor:
         # ProfileStats above; the histogram granule is the batch)
         retrieve_dt = self.stats.retrieval_seconds - retrieval0
         score_dt = self.stats.compare_seconds - compare0
+        persist_dt = time.monotonic() - t2
         self.phases.observe(PHASE_ENCODE, t1 - t0)
         self.phases.observe(PHASE_RETRIEVE, retrieve_dt)
         self.phases.observe(PHASE_SCORE, score_dt)
-        self.phases.observe(PHASE_PERSIST, time.monotonic() - t2)
+        self.phases.observe(PHASE_PERSIST, persist_dt)
+        # the same four durations feed the process-wide busy ledger, so
+        # per-workload phase counters reconcile against it by definition
+        costs.note_busy((t1 - t0) + retrieve_dt + score_dt + persist_dt)
         # retrieval and scoring interleave per record (and across the
         # thread pool): the shared aggregate-span layout
         tracing.add_phase_spans(match_ns, retrieve_dt, score_dt)

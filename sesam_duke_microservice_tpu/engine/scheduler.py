@@ -542,7 +542,13 @@ class IngestScheduler:
                 # have minted (plain attribute write)
                 done = time.monotonic()
                 slo.tracker("ingest", q.kind, q.name).record_batch(
-                    [done - req.enqueued for req in batch], done)
+                    [done - req.enqueued for req in batch], done,
+                    # exemplar trace ids for violation entries: only
+                    # sampled traces link anywhere, so unsampled → None
+                    [(req.trace_ctx[0].trace_id
+                      if req.trace_ctx is not None
+                      and req.trace_ctx[0].sampled else None)
+                     for req in batch])
                 slo.feed_meter(q.kind, q.name).note_write()
                 q.microbatches += 1
                 q.merged_requests += len(batch)

@@ -27,6 +27,7 @@ import contextlib
 import logging
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional, Sequence
 
 from ..core.config import ServiceConfig, WorkloadConfig
@@ -37,6 +38,7 @@ from ..links import create_link_database
 from ..links.base import LinkDatabase
 from ..service.datasource import IncrementalDataSource
 from ..store.records import RecordStore
+from ..telemetry import memory
 from ..utils import faults
 from .listeners import ServiceMatchListener
 from .processor import Processor
@@ -46,6 +48,41 @@ def _snapshot_path(data_folder: str) -> str:
     import os
 
     return os.path.join(data_folder, "corpus_snapshot.npz")
+
+
+def _hbm_components(wl_ref) -> Dict[str, int]:
+    """Device-buffer bytes for one workload, keyed by component — the
+    HBM ledger's registered callable.  Reads single-writer numpy mirrors
+    lock-free (torn reads tolerated, the /stats stance); host backends
+    (no device corpus) report nothing."""
+    wl = wl_ref()
+    if wl is None:
+        return {}
+    corpus = getattr(wl.index, "corpus", None)
+    if corpus is None:
+        return {}
+    from ..ops.encoder import ANN_PROP, ANN_SCALE
+
+    out = {"corpus_tensors": 0, "corpus_embeddings": 0, "int8_scales": 0}
+    for prop, arrays in list(corpus.feats.items()):
+        for name, arr in list(arrays.items()):
+            nbytes = int(getattr(arr, "nbytes", 0) or 0)
+            if prop == ANN_PROP:
+                if name == ANN_SCALE:
+                    out["int8_scales"] += nbytes
+                else:
+                    out["corpus_embeddings"] += nbytes
+            else:
+                out["corpus_tensors"] += nbytes
+    for mask in ("row_valid", "row_deleted", "row_group"):
+        arr = getattr(corpus, mask, None)
+        out["corpus_tensors"] += int(getattr(arr, "nbytes", 0) or 0)
+    ivf = getattr(wl.index, "ivf", None)
+    if ivf is not None:
+        out["ivf_membership"] = sum(
+            int(getattr(getattr(ivf, field, None), "nbytes", 0) or 0)
+            for field in ("centroids", "cell_of", "cell_rows", "counts"))
+    return {k: v for k, v in out.items() if v}
 
 
 class _BatchRequest:
@@ -102,6 +139,13 @@ class Workload:
             ds.dataset_id: IncrementalDataSource(ds)
             for ds in config.duke.data_sources
         }
+        # HBM ledger enrollment (telemetry/memory.py): the components
+        # callable holds this workload weakly, so a reload-replaced
+        # workload drops out of the books with its last reference and
+        # the closed flag hides it meanwhile
+        wl_ref = weakref.ref(self)
+        memory.register(self, self.kind, self.name,
+                        lambda: _hbm_components(wl_ref))
 
     def replace_link_database(self, link_database: LinkDatabase) -> None:
         """Swap the link database wrapper in place — the dispatcher

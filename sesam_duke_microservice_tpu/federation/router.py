@@ -31,9 +31,9 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..engine.workload import Workload
 from ..links.replica import feed_row
-from ..telemetry import slo, tracing
+from ..telemetry import heat, slo, tracing
 from ..telemetry.decisions import _MonitorHist
-from ..telemetry.env import env_float, env_int
+from ..telemetry.env import env_flag, env_float, env_int
 from ..telemetry.registry import DEFAULT_LATENCY_BUCKETS
 from ..utils import faults
 from ..utils.backoff import full_jitter_delay
@@ -203,7 +203,8 @@ class LocalGroup:
             # scheduler-arrival equivalent — lock-wait (the queueing
             # here) included.  Leaf tracker lock, no other lock held.
             done = time.monotonic()
-            slo.tracker("ingest", kind, name).record(done - t0, done)
+            slo.tracker("ingest", kind, name).record(
+                done - t0, done, tracing.sampled_trace_id())
             slo.feed_meter(kind, name).note_write()
         return cap.wire()
 
@@ -276,6 +277,12 @@ class FederationRouter:
         self._range_lock = threading.Lock()
         # range_id -> [ {outcome: count}, _MonitorHist ]
         self._range_stats: Dict[str, list] = {}  # guarded by: self._range_lock [writes]
+        # sub-range heat map (ISSUE 17): fed per routed record in
+        # _route_entities with unlocked increments (torn counts
+        # tolerated — the QUERY_BLOCKS stance); DUKE_FED_HEAT=0 turns
+        # the bookkeeping off entirely (the bench's attribution-off arm)
+        self.heat: Optional[heat.HeatMap] = (
+            heat.HeatMap() if env_flag("DUKE_FED_HEAT", True) else None)
 
     # -- health bookkeeping ---------------------------------------------------
 
@@ -433,6 +440,11 @@ class FederationRouter:
                 if owner.range_id not in frozen:
                     frozen.append(owner.range_id)
                 continue
+            if self.heat is not None:
+                # counts every routing pass: a record re-routed after a
+                # live migration is noted once per attempt — rare, and
+                # irrelevant to where the hot band sits
+                self.heat.note(owner, key)
             per_group.setdefault(owner.group, []).append(entity)
             group_touched = touched.setdefault(owner.group, [])
             if owner.range_id not in group_touched:

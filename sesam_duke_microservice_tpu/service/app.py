@@ -499,6 +499,7 @@ _STATIC_ROUTES = frozenset((
     "/", "/config", "/health", "/healthz", "/readyz", "/metrics", "/stats",
     "/debug/traces", "/debug/requests", "/debug/decisions", "/explain",
     "/debug/profile", "/debug/profile/reset",
+    "/debug/costs", "/debug/memory", "/debug/loadmap", "/debug/slo",
 ))
 
 
@@ -733,6 +734,18 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
             self._reply(*debug_api.handle_decision(self.app, m.group(1)))
         elif path == "/debug/profile":
             self._reply(*debug_api.handle_profile_status())
+        elif path == "/debug/costs":
+            self._reply(*debug_api.handle_costs(
+                debug_api._app_workloads(self.app)))
+        elif path == "/debug/memory":
+            self._reply(*debug_api.handle_memory())
+        elif path == "/debug/loadmap":
+            # the single-process plane routes nothing through a
+            # federation router; the payload reports zero ranges (the
+            # federation plane serves its router's live heat map)
+            self._reply(*debug_api.handle_loadmap(None))
+        elif path == "/debug/slo":
+            self._reply(*debug_api.handle_slo())
         elif m := _ENTITY_PATH.match(path):
             self._validate_entity_path(m)
             raise _HttpError(405, "This endpoint only supports POST requests.")
@@ -1172,7 +1185,9 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
             # always-on feed SLO signal (ISSUE 16): backlog walk wall
             # time against DUKE_SLO_FEED_MS; reaching the short page
             # means the feed is caught up, so the lag meter stops aging
-            slo.tracker("feed", kind, name).record(time.monotonic() - t0)
+            slo.tracker("feed", kind, name).record(
+                time.monotonic() - t0,
+                trace_id=tracing.sampled_trace_id())
             slo.feed_meter(kind, name).note_drain()
             if started:
                 self._write_chunk(b"]")
@@ -1217,7 +1232,8 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                 break
             finally:
                 workload.lock.release()
-        slo.tracker("feed", kind, name).record(time.monotonic() - t0)
+        slo.tracker("feed", kind, name).record(
+            time.monotonic() - t0, trace_id=tracing.sampled_trace_id())
         slo.feed_meter(kind, name).note_drain()
         body = "[" + ",\n".join(json.dumps(r) for r in rows) + "]"
         self._reply(200, body.encode("utf-8"))

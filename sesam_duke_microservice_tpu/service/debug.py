@@ -10,6 +10,10 @@ Rendering helpers for the debug/explainability endpoints (ISSUE 2 + 5):
     POST /explain                   replay a pair in explain mode
     POST /debug/profile?seconds=N   on-demand jax.profiler capture
     POST /debug/profile/reset       re-arm the PROFILE_TRACE_DIR budget
+    GET  /debug/costs               device-time ledger + reconciliation
+    GET  /debug/memory              HBM ledger + headroom forecast
+    GET  /debug/loadmap             sub-range heat + split suggestions
+    GET  /debug/slo                 SLO violations w/ exemplar traces
 
 Each helper returns ``(status, body_bytes, content_type)`` so the HTTP
 layer stays a thin switch (service/app.py) and the logic is unit-testable
@@ -165,16 +169,25 @@ def handle_profile_status() -> Reply:
     return _reply_json(200, {"capturing": profiling.capture_status()})
 
 
-def handle_profile_start(query: dict) -> Reply:
+def handle_profile_start(query: dict, owner: str = "app") -> Reply:
+    """``POST /debug/profile?seconds=N`` — served by all three planes
+    (app / replica / federation), which share the process's ONE
+    profiler; ``owner`` names the requesting plane so a conflict 409
+    says who holds the capture and until when."""
     raw = (query.get("seconds") or ["5"])[0]
     try:
         seconds = float(raw)
     except ValueError:
         return _reply_json(400, {"error": f"unparseable seconds {raw!r}"})
     try:
-        info = profiling.start_capture(seconds)
+        info = profiling.start_capture(seconds, owner=owner)
     except profiling.CaptureActiveError as e:
-        return _reply_json(409, {"error": str(e)})
+        return _reply_json(409, {
+            "error": str(e),
+            "owner": e.owner,
+            "deadline_unix": e.deadline_unix,
+            "remaining_seconds": e.remaining_seconds,
+        })
     except ValueError as e:
         return _reply_json(400, {"error": str(e)})
     return _reply_json(200, {"capturing": info})
@@ -185,3 +198,76 @@ def handle_profile_reset() -> Reply:
         "trace_budget_reset": True,
         "budget_batches": profiling.reset_trace_budget(),
     })
+
+
+# -- cost & capacity attribution (ISSUE 17) ----------------------------------
+
+
+def _app_workloads(app):
+    """(kind, name, workload) across both registries — the cost/memory
+    debug surfaces' workload iterator for the main plane."""
+    for kind, registry in (("deduplication", app.deduplications),
+                           ("recordlinkage", app.record_linkages)):
+        for name, wl in list(registry.items()):
+            yield kind, name, wl
+
+
+def handle_costs(workload_iter=()) -> Reply:
+    """``GET /debug/costs``: the device-time ledger reconciled against
+    per-workload phase attribution.  ``attributed_seconds`` sums every
+    live PhaseRecorder; the residual vs the busy ledger is reported as
+    ``unattributed_seconds`` (PhaseRecorders die with reloaded-away
+    workloads, the ledger survives) and ``reconciles`` asserts the two
+    agree within max(50 ms, 1%) — the tested invariant."""
+    from ..telemetry import costs
+
+    snap = costs.snapshot()
+    workloads = []
+    attributed = 0.0
+    for kind, name, wl in workload_iter:
+        phases = wl.processor.phases.phase_seconds()
+        total = sum(phases.values())
+        attributed += total
+        workloads.append({
+            "kind": kind,
+            "workload": name,
+            "phase_seconds": {p: round(s, 6)
+                              for p, s in sorted(phases.items())},
+            "device_seconds": round(total, 6),
+        })
+    busy = snap["busy_seconds_total"]
+    residual = busy - attributed
+    tolerance = max(0.05, 0.01 * busy)
+    snap.update({
+        "attributed_seconds": round(attributed, 6),
+        "unattributed_seconds": round(residual, 6),
+        "reconciles": abs(residual) <= tolerance,
+        "tolerance_seconds": round(tolerance, 6),
+        "workloads": workloads,
+    })
+    return _reply_json(200, snap)
+
+
+def handle_memory() -> Reply:
+    """``GET /debug/memory``: the HBM ledger (per-workload components,
+    process components, headroom and overflow forecast)."""
+    from ..telemetry import memory
+
+    return _reply_json(200, memory.debug_snapshot())
+
+
+def handle_loadmap(heatmap) -> Reply:
+    """``GET /debug/loadmap``: sub-range heat per owned range with the
+    suggested split point (``heatmap`` may be None — single-group
+    deployments route nothing through a federation router)."""
+    from ..telemetry import heat
+
+    return _reply_json(200, heat.loadmap(heatmap))
+
+
+def handle_slo() -> Reply:
+    """``GET /debug/slo``: per-tracker burn-rate state plus recent
+    violations with exemplar trace links."""
+    from ..telemetry import slo
+
+    return _reply_json(200, slo.debug_snapshot())

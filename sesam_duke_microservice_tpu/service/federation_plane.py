@@ -68,7 +68,7 @@ from ..federation.router import (
     PartialIngestFailure,
     UnknownFederatedWorkload,
 )
-from ..telemetry import FamilySnapshot, MetricRegistry, slo, tracing
+from ..telemetry import FamilySnapshot, MetricRegistry, heat, slo, tracing
 from ..telemetry.logctx import new_request_id, request_id_var
 from ..telemetry.registry import DEFAULT_LATENCY_BUCKETS, histogram_snapshot
 from ..telemetry.rollup import GroupRollup
@@ -161,6 +161,9 @@ def make_federation_collector(fed: Federation):
                 "duke_fed_range_latency_seconds", "histogram",
                 "Per-range scatter-call latency (group call including "
                 "router-side retries)", range_lat_samples),
+            # sub-range heat rollup (ISSUE 17): 256-bucket load
+            # histogram per owned range, non-zero buckets only
+            heat.collect_family(router.heat),
         ]
 
     return collect
@@ -170,6 +173,8 @@ _FED_STATIC_ROUTES = frozenset((
     "/health", "/healthz", "/readyz", "/stats", "/metrics",
     "/federation/map", "/federation/migration", "/federation/migrate",
     "/debug/traces", "/debug/requests", "/debug/migrations",
+    "/debug/profile", "/debug/profile/reset",
+    "/debug/costs", "/debug/memory", "/debug/loadmap", "/debug/slo",
 ))
 
 
@@ -307,6 +312,22 @@ class FederationHandler(BaseHTTPRequestHandler):
         elif path == "/debug/migrations":
             self._reply_json(200, {
                 "migrations": self.fed.migrator.timelines_snapshot()})
+        elif path == "/debug/profile":
+            self._reply(*debug_api.handle_profile_status())
+        elif path == "/debug/costs":
+            # reconcile against every group's workloads: the federation
+            # process runs them all, so the plane-wide attribution must
+            # cover them all to balance the process-wide busy ledger
+            self._reply(*debug_api.handle_costs(
+                (kind, name, wl)
+                for g in self.fed.groups
+                for (kind, name), wl in list(g.workloads.items())))
+        elif path == "/debug/memory":
+            self._reply(*debug_api.handle_memory())
+        elif path == "/debug/loadmap":
+            self._reply(*debug_api.handle_loadmap(self.fed.router.heat))
+        elif path == "/debug/slo":
+            self._reply(*debug_api.handle_slo())
         elif m := _FEED_PATH.match(path):
             self._handle_feed(m, parse_qs(parsed.query))
         else:
@@ -316,6 +337,14 @@ class FederationHandler(BaseHTTPRequestHandler):
         path = parsed.path
         if path == "/federation/migrate":
             self._handle_migrate(body)
+        elif path == "/debug/profile":
+            # ISSUE 17 satellite: device captures through the federated
+            # front door; the owner tag makes a cross-plane conflict
+            # 409 carry who holds the profiler and its deadline
+            self._reply(*debug_api.handle_profile_start(
+                parse_qs(parsed.query), owner="federation"))
+        elif path == "/debug/profile/reset":
+            self._reply(*debug_api.handle_profile_reset())
         elif m := _ENTITY_PATH.match(path):
             self._handle_ingest(m, body)
         else:

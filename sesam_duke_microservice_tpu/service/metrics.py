@@ -27,6 +27,7 @@ from ..telemetry import (
     FamilySnapshot,
     MetricRegistry,
 )
+from ..telemetry import memory as hbm
 
 class HttpMetrics:
     """HTTP-layer families, bound to one app registry."""
@@ -211,12 +212,26 @@ def make_app_collector(app):
         pair_logit_samples = []
         margin_slack_samples = []
         similarity_samples = []
+        cost_samples = []
+        hbm_samples = []
         for kind, name, wl in _workload_iter(app):
             labels = (("kind", kind), ("workload", name))
             proc = wl.processor
             phases = getattr(proc, "phases", None)
             if phases is not None:
                 phase_samples.extend(phases.collect_samples(labels))
+                # device-time attribution (ISSUE 17): the same
+                # PhaseRecorder totals, flattened to per-phase counters
+                # that reconcile against duke_cost_busy_seconds_total
+                for phase, seconds in sorted(
+                        phases.phase_seconds().items()):
+                    cost_samples.append(
+                        ("", labels + (("phase", phase),), seconds))
+            # HBM attribution (ISSUE 17): this workload's registered
+            # device-buffer components from the process-wide ledger
+            for comp, nbytes in sorted(hbm.components_for(wl).items()):
+                hbm_samples.append(
+                    ("", labels + (("component", comp),), nbytes))
             stats = getattr(proc, "stats", None)
             if stats is not None:
                 counter_samples["batches"].append(
@@ -395,7 +410,18 @@ def make_app_collector(app):
                            "EWMA of recent write-side workload lock holds "
                            "(the Retry-After hint source; absent until the "
                            "first write)", hold_samples),
+            FamilySnapshot(
+                "duke_cost_device_seconds_total", "counter",
+                "Attributed device-busy seconds by workload and engine "
+                "phase; sums to duke_cost_busy_seconds_total (the ledger "
+                "reconciliation invariant)", cost_samples),
         ]
+        if hbm_samples:
+            out.append(FamilySnapshot(
+                "duke_device_bytes", "gauge",
+                "Registered device-buffer bytes by workload and component "
+                "(corpus tensors, embeddings, int8 scales, IVF "
+                "membership)", hbm_samples))
         if scheduler is not None:
             out.append(FamilySnapshot(
                 "duke_sched_queue_depth", "gauge",
@@ -558,12 +584,21 @@ def make_group_collector(group):
         link_samples = []
         queue_samples = []
         hold_samples = []
+        cost_samples = []
+        hbm_samples = []
         for (kind, name), wl in list(group.workloads.items()):
             labels = (("kind", kind), ("workload", name))
             proc = wl.processor
             phases = getattr(proc, "phases", None)
             if phases is not None:
                 phase_samples.extend(phases.collect_samples(labels))
+                for phase, seconds in sorted(
+                        phases.phase_seconds().items()):
+                    cost_samples.append(
+                        ("", labels + (("phase", phase),), seconds))
+            for comp, nbytes in sorted(hbm.components_for(wl).items()):
+                hbm_samples.append(
+                    ("", labels + (("component", comp),), nbytes))
             stats = getattr(proc, "stats", None)
             if stats is not None:
                 counter_samples["batches"].append(
@@ -625,6 +660,16 @@ def make_group_collector(group):
                            "EWMA of recent write-side workload lock holds "
                            "(the Retry-After hint source; absent until the "
                            "first write)", hold_samples),
+            FamilySnapshot(
+                "duke_cost_device_seconds_total", "counter",
+                "Attributed device-busy seconds by workload and engine "
+                "phase; sums to duke_cost_busy_seconds_total (the ledger "
+                "reconciliation invariant)", cost_samples),
+            FamilySnapshot(
+                "duke_device_bytes", "gauge",
+                "Registered device-buffer bytes by workload and component "
+                "(corpus tensors, embeddings, int8 scales, IVF "
+                "membership)", hbm_samples),
         ]
 
     return collect

@@ -141,13 +141,59 @@ class ReplicaReadHandler(BaseHTTPRequestHandler):
             self._reply(*debug_api.handle_trace(m.group(1), fmt))
         elif path == "/debug/requests":
             self._reply(*debug_api.handle_requests())
+        elif path == "/debug/profile":
+            self._reply(*debug_api.handle_profile_status())
+        elif path == "/debug/costs":
+            # a replica runs no workload processors of its own; the
+            # process-level ledger (compile/busy credited by replay)
+            # still reconciles trivially with zero attributed seconds
+            self._reply(*debug_api.handle_costs())
+        elif path == "/debug/memory":
+            self._reply(*debug_api.handle_memory())
+        elif path == "/debug/slo":
+            self._reply(*debug_api.handle_slo())
         elif m := _FEED_PATH.match(path):
             self._handle_feed(m, parse_qs(parsed.query))
         else:
             self._reply(404, b"Not found (replica read plane serves "
                         b"feeds, /stats, /metrics, /debug/traces, "
-                        b"/debug/requests and health probes)",
+                        b"/debug/requests, /debug/profile, /debug/costs, "
+                        b"/debug/memory, /debug/slo and health probes)",
                         "text/plain")
+
+    def do_POST(self):
+        try:
+            parsed = urlparse(self.path)
+            with tracing.start_trace(
+                f"POST {parsed.path}",
+                traceparent=self.headers.get("traceparent"),
+                attributes={"http.method": "POST",
+                            "http.target": parsed.path},
+            ):
+                self._route_post(parsed)
+        except Exception:
+            logger.exception("replica plane: error serving %s", self.path)
+            self._reply(500, b"Internal server error", "text/plain")
+
+    def _route_post(self, parsed) -> None:
+        # drain any body so keep-alive framing survives the reply
+        length = int(self.headers.get("Content-Length") or 0)
+        if length:
+            self.rfile.read(length)
+        path = parsed.path
+        if path == "/debug/profile":
+            # ISSUE 17 satellite: a federated/replicated deployment can
+            # capture a device trace through any plane's front door; the
+            # owner tag makes a cross-plane conflict 409 say who holds
+            # the profiler and until when
+            self._reply(*debug_api.handle_profile_start(
+                parse_qs(parsed.query), owner="replica"))
+        elif path == "/debug/profile/reset":
+            self._reply(*debug_api.handle_profile_reset())
+        else:
+            self._reply(404, b"Not found (replica read plane accepts "
+                        b"POST /debug/profile and "
+                        b"POST /debug/profile/reset)", "text/plain")
 
     def _handle_stats(self) -> None:
         lags = self._lag_snapshot()

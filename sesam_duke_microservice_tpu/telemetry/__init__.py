@@ -238,3 +238,13 @@ MESH_DEVICES = GLOBAL.gauge(
 from . import slo  # noqa: E402,F401
 
 GLOBAL.register_collector(slo.collect)
+
+# -- resource attribution (ISSUE 17: telemetry/{costs,memory}.py) ------------
+# Same pattern: the device-time cost ledger and the HBM ledger register
+# scrape-time collectors on GLOBAL so every plane serves the busy /
+# compile / utilization and headroom / overflow families for free.
+from . import costs  # noqa: E402,F401
+from . import memory  # noqa: E402,F401
+
+GLOBAL.register_collector(costs.collect)
+GLOBAL.register_collector(memory.collect)

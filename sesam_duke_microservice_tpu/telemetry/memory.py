@@ -1,0 +1,260 @@
+"""HBM ledger: registration-based device-buffer accounting (ISSUE 17
+tentpole b).
+
+Owners of device-resident state register a components callable
+(``register(owner, kind, name, fn)`` — ``fn() -> {component: bytes}``)
+held through a weakref, so a reloaded-away workload drops out of the
+books automatically.  ``engine.workload.Workload`` registers its corpus
+tensors / int8 scales / IVF membership at construction; process-wide
+components (the digest-keyed feature cache, the on-disk AOT executable
+store) are computed here.
+
+Scrape surfaces:
+
+  * per-workload ``duke_device_bytes{kind,workload,component}`` — emitted
+    by the app/group collectors (service/metrics.py) so the federation
+    rollup relabels them per group, exactly like every other workload
+    gauge; the collectors read this ledger via ``components_for``.
+  * process-wide ``duke_device_bytes{component}`` (feature cache, AOT
+    store), ``duke_device_headroom_bytes`` and
+    ``duke_device_overflow_days`` — emitted by ``collect`` on
+    ``telemetry.GLOBAL`` (one device budget per process, so headroom is
+    process-scoped even when N federation groups share the process).
+
+Headroom = budget − total registered bytes.  The budget resolves from
+``DUKE_HBM_BUDGET_MB``, else the backend's reported ``bytes_limit``
+(``Device.memory_stats``), else a documented 16 GiB default.  The
+overflow forecast extrapolates the corpus-byte growth rate observed
+across scrapes: days-to-overflow = headroom / (bytes per day); -1 means
+"no growth observed" (never extrapolate from silence).
+
+All byte math reads single-writer numpy mirrors lock-free (torn reads
+tolerated — the /stats stance); the ledger's own dict is guarded by a
+leaf lock taken only at register/scrape time.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from .env import env_int
+from .registry import FamilySnapshot
+
+DEFAULT_BUDGET_BYTES = 16 << 30  # 16 GiB: one modern accelerator's HBM
+
+_REG_LOCK = threading.Lock()
+# id(owner) -> (weakref(owner), kind, name, components_fn)
+_ENTRIES: Dict[int, tuple] = {}  # guarded by: _REG_LOCK [writes]
+# (unix_ts, corpus_bytes) scrape-time samples driving the growth forecast
+_growth: deque = deque(maxlen=256)  # guarded by: _REG_LOCK
+
+
+def register(owner: object, kind: str, name: str,
+             fn: Callable[[], Dict[str, int]]) -> None:
+    """Enroll ``owner``'s device buffers; ``fn`` must be lock-free and
+    must not strongly reference ``owner`` (close over a weakref)."""
+    key = id(owner)
+    with _REG_LOCK:
+        _ENTRIES[key] = (weakref.ref(owner), kind, name, fn)
+
+
+def _iter_live() -> List[Tuple[str, str, object, Callable]]:
+    """Live registrations, pruning dead/closed owners in passing."""
+    out = []
+    with _REG_LOCK:
+        items = list(_ENTRIES.items())
+    dead = []
+    for key, (ref, kind, name, fn) in items:
+        owner = ref()
+        if owner is None:
+            dead.append(key)
+            continue
+        if getattr(owner, "closed", False):
+            continue  # replaced by reload; the weakref reaps it later
+        out.append((kind, name, owner, fn))
+    if dead:
+        with _REG_LOCK:
+            for key in dead:
+                _ENTRIES.pop(key, None)
+    return out
+
+
+def components_for(owner: object) -> Dict[str, float]:
+    """One owner's current component bytes (empty if unregistered) —
+    the app/group collectors' per-workload read."""
+    with _REG_LOCK:
+        entry = _ENTRIES.get(id(owner))
+    if entry is None:
+        return {}
+    try:
+        return {k: float(v) for k, v in entry[3]().items() if v}
+    except Exception:
+        return {}  # a mid-mutation read must never fail a scrape
+
+
+def process_components() -> Dict[str, float]:
+    """Process-wide device/pinned buffers outside any workload."""
+    out: Dict[str, float] = {}
+    try:
+        from ..ops import feature_cache as FC
+
+        out["feature_cache"] = float(FC.stats()[3])
+    except Exception:
+        pass
+    try:
+        from ..utils.jit_cache import aot_dir
+
+        total = 0
+        with os.scandir(aot_dir()) as it:
+            for entry in it:
+                if entry.name.endswith(".aotx"):
+                    total += entry.stat().st_size
+        out["aot_executables"] = float(total)
+    except OSError:
+        pass  # store not created yet
+    except Exception:
+        pass
+    return out
+
+
+def budget_bytes() -> Tuple[float, str]:
+    """(bytes, source) — DUKE_HBM_BUDGET_MB, else the backend's
+    reported limit, else the documented default."""
+    mb = env_int("DUKE_HBM_BUDGET_MB", 0)
+    if mb > 0:
+        return float(mb) * 1024 * 1024, "env"
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        limit = (stats or {}).get("bytes_limit")
+        if limit:
+            return float(limit), "device"
+    except Exception:
+        pass
+    return float(DEFAULT_BUDGET_BYTES), "default"
+
+
+_CORPUS_COMPONENTS = ("corpus_tensors", "corpus_embeddings", "int8_scales",
+                      "ivf_membership")
+
+
+def _totals(now_unix: Optional[float] = None
+            ) -> Tuple[float, float, List[Tuple[str, str, str, float]]]:
+    """(total_bytes, corpus_bytes, [(kind, name, component, bytes)]) and
+    feed the growth ring with the corpus share."""
+    rows: List[Tuple[str, str, str, float]] = []
+    total = 0.0
+    corpus = 0.0
+    for kind, name, owner, _fn in _iter_live():
+        for comp, nbytes in sorted(components_for(owner).items()):
+            rows.append((kind, name, comp, nbytes))
+            total += nbytes
+            if comp in _CORPUS_COMPONENTS:
+                corpus += nbytes
+    for comp, nbytes in sorted(process_components().items()):
+        rows.append(("process", "", comp, nbytes))
+        total += nbytes
+    now_unix = time.time() if now_unix is None else now_unix
+    with _REG_LOCK:
+        if not _growth or _growth[-1][1] != corpus:
+            _growth.append((now_unix, corpus))
+    return total, corpus, rows
+
+
+def growth_bytes_per_day() -> float:
+    """Corpus-byte growth rate across observed scrapes (0 until two
+    distinct observations exist)."""
+    with _REG_LOCK:
+        if len(_growth) < 2:
+            return 0.0
+        (t0, b0), (t1, b1) = _growth[0], _growth[-1]
+    dt = t1 - t0
+    if dt <= 0 or b1 <= b0:
+        return 0.0
+    return (b1 - b0) / dt * 86400.0
+
+
+def overflow_days(headroom: float) -> float:
+    """Days until the corpus growth rate consumes ``headroom``; -1 when
+    no growth has been observed (never extrapolate from silence)."""
+    rate = growth_bytes_per_day()
+    if rate <= 0.0:
+        return -1.0
+    return max(0.0, headroom) / rate
+
+
+def live_arrays_bytes() -> Optional[int]:
+    """Backend cross-check: total bytes of all live jax arrays, or None
+    where the backend/API does not support it."""
+    try:
+        import jax
+
+        return int(sum(getattr(a, "nbytes", 0) for a in jax.live_arrays()))
+    except Exception:
+        return None
+
+
+def debug_snapshot() -> Dict[str, object]:
+    """``GET /debug/memory`` payload."""
+    budget, source = budget_bytes()
+    total, corpus, rows = _totals()
+    headroom = budget - total
+    return {
+        "budget_bytes": int(budget),
+        "budget_source": source,
+        "total_bytes": int(total),
+        "corpus_bytes": int(corpus),
+        "headroom_bytes": int(headroom),
+        "growth_bytes_per_day": round(growth_bytes_per_day(), 3),
+        "overflow_days": round(overflow_days(headroom), 3),
+        "workloads": [
+            {"kind": kind, "workload": name, "component": comp,
+             "bytes": int(nbytes)}
+            for kind, name, comp, nbytes in rows if kind != "process"
+        ],
+        "process": {comp: int(nbytes)
+                    for kind, _n, comp, nbytes in rows if kind == "process"},
+        "live_arrays_bytes": live_arrays_bytes(),
+    }
+
+
+def _reset_for_tests() -> None:
+    with _REG_LOCK:
+        _ENTRIES.clear()
+        _growth.clear()
+
+
+def collect() -> List[FamilySnapshot]:
+    """Scrape-time collector (registered on ``telemetry.GLOBAL``):
+    process-component bytes + the headroom/forecast gauges.  The
+    per-workload ``duke_device_bytes`` samples come from the app/group
+    collectors so the federation rollup can relabel them per group."""
+    budget, _source = budget_bytes()
+    total, _corpus, rows = _totals()
+    headroom = budget - total
+    proc_samples = [("", (("component", comp),), nbytes)
+                    for kind, _n, comp, nbytes in rows if kind == "process"]
+    return [
+        FamilySnapshot(
+            "duke_device_bytes", "gauge",
+            "Registered device-buffer bytes by component (per-workload "
+            "series carry kind/workload labels; process-wide components "
+            "— feature cache, AOT executable store — carry only "
+            "component)", proc_samples),
+        FamilySnapshot(
+            "duke_device_headroom_bytes", "gauge",
+            "HBM budget (DUKE_HBM_BUDGET_MB, else the backend's "
+            "bytes_limit, else 16 GiB) minus all registered device "
+            "bytes", [("", (), headroom)]),
+        FamilySnapshot(
+            "duke_device_overflow_days", "gauge",
+            "Days until the observed corpus-byte growth rate consumes "
+            "the headroom (-1 = no growth observed yet)",
+            [("", (), overflow_days(headroom))]),
+    ]

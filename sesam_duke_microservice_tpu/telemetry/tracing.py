@@ -216,6 +216,16 @@ def current_trace_id() -> Optional[str]:
     return active[0].trace_id if active is not None else None
 
 
+def sampled_trace_id() -> Optional[str]:
+    """The active trace id only when that trace is actually recorded —
+    the form exemplars (SLO violations) must use, because an unsampled
+    id would be a dead link in /debug/traces."""
+    active = _ACTIVE.get()
+    if active is None or not active[0].sampled:
+        return None
+    return active[0].trace_id
+
+
 # -- W3C trace context -------------------------------------------------------
 
 class TraceContext:

@@ -132,22 +132,27 @@ def capture_status() -> Optional[Dict[str, Any]]:
         if _capture is None:
             return None
         info = {k: _capture[k] for k in
-                ("dir", "seconds", "started_unix")}
+                ("dir", "seconds", "started_unix", "owner",
+                 "deadline_unix")}
         info["remaining_seconds"] = round(
             max(0.0, _capture["until"] - time.monotonic()), 3)
         return info
 
 
-def start_capture(seconds: float,
-                  logdir: Optional[str] = None) -> Dict[str, Any]:
+def start_capture(seconds: float, logdir: Optional[str] = None,
+                  owner: str = "app") -> Dict[str, Any]:
     """Open a ``jax.profiler`` capture NOW for ``seconds`` seconds.
 
     Generalizes the first-N-batches ``PROFILE_TRACE_DIR`` capture to any
     moment in a running service: a timer thread stops the capture, and
     while it is live the request-tracing layer bridges its engine phase
-    spans into device TraceAnnotations.  One capture at a time
-    (``CaptureActiveError``); failures to start propagate to the caller
-    (the endpoint answers 500) with no state latched.
+    spans into device TraceAnnotations.  The profiler is one per
+    process but the serving planes (app / replica / federation) each
+    expose the endpoint, so a second ``start_capture`` — from ANY plane
+    — raises ``CaptureActiveError`` carrying the live capture's owner
+    plane and deadline for the endpoint's 409 body; failures to start
+    propagate to the caller (the endpoint answers 500) with no state
+    latched.  ``owner`` names the requesting plane.
     """
     seconds = float(seconds)
     if not (0 < seconds <= MAX_CAPTURE_SECONDS):
@@ -158,8 +163,12 @@ def start_capture(seconds: float,
     with _capture_lock:
         if _capture is not None:
             raise CaptureActiveError(
-                f"a device capture is already running into "
-                f"{_capture['dir']}"
+                f"a device capture (owner={_capture['owner']}) is "
+                f"already running into {_capture['dir']}",
+                owner=_capture["owner"],
+                deadline_unix=_capture["deadline_unix"],
+                remaining_seconds=round(
+                    max(0.0, _capture["until"] - time.monotonic()), 3),
             )
         directory = (logdir or trace_dir()
                      or tempfile.mkdtemp(prefix="duke-profile-"))
@@ -171,13 +180,17 @@ def start_capture(seconds: float,
             "dir": directory,
             "seconds": seconds,
             "started_unix": round(time.time(), 3),
+            "deadline_unix": round(time.time() + seconds, 3),
             "until": time.monotonic() + seconds,
+            "owner": owner,
             "timer": timer,
         }
         timer.start()
-        logger.info("on-demand device capture started: %.3gs into %s",
-                    seconds, directory)
-        return {k: _capture[k] for k in ("dir", "seconds", "started_unix")}
+        logger.info("on-demand device capture started: %.3gs into %s "
+                    "(owner=%s)", seconds, directory, owner)
+        return {k: _capture[k] for k in
+                ("dir", "seconds", "started_unix", "deadline_unix",
+                 "owner")}
 
 
 def stop_capture() -> Optional[Dict[str, Any]]:
@@ -204,4 +217,17 @@ def stop_capture() -> Optional[Dict[str, Any]]:
 
 
 class CaptureActiveError(RuntimeError):
-    """A second ``start_capture`` while one is live (endpoint: 409)."""
+    """A second ``start_capture`` while one is live (endpoint: 409).
+
+    Carries the live capture's owner plane and deadline so the 409 body
+    can say WHO holds the profiler and until when — a capture started
+    through one plane must never swallow another plane's request with a
+    misleading success."""
+
+    def __init__(self, message: str, owner: Optional[str] = None,
+                 deadline_unix: Optional[float] = None,
+                 remaining_seconds: Optional[float] = None):
+        super().__init__(message)
+        self.owner = owner
+        self.deadline_unix = deadline_unix
+        self.remaining_seconds = remaining_seconds

@@ -1,0 +1,649 @@
+"""Load & capacity attribution (ISSUE 17).
+
+Covers the three tentpole ledgers end to end:
+
+  * device-time cost ledger — busy/compile accumulation, the sliding
+    utilization window, and the RECONCILIATION invariant: the
+    per-workload × per-phase ``duke_cost_device_seconds_total`` counters
+    sum to the process busy ledger within tolerance, proven under the
+    scheduler's merged-microbatch path;
+  * HBM ledger — weakref registration, per-workload corpus components,
+    headroom vs the budget and the overflow forecast;
+  * sub-range heat maps — bucket/split math, the skewed-keyspace case
+    (80% of traffic in 5% of a range must pull the suggested split into
+    the hot band), and the ``/debug/loadmap`` payload.
+
+Satellites riding along: the four ``GET /debug/{costs,memory,loadmap,
+slo}`` endpoints on both serving planes, lossless rollup of the two new
+families through the federation ``/metrics``, cross-plane profile
+ownership (second start answers 409 with the live owner + deadline, not
+a misleading 200), and SLO violation exemplar trace links.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from sesam_duke_microservice_tpu import telemetry
+from sesam_duke_microservice_tpu.core.config import parse_config
+from sesam_duke_microservice_tpu.federation.ranges import route_key
+from sesam_duke_microservice_tpu.service import debug as debug_api
+from sesam_duke_microservice_tpu.service.app import DukeApp, serve
+from sesam_duke_microservice_tpu.telemetry import costs, heat, memory, slo
+from sesam_duke_microservice_tpu.utils import faults, profiling
+
+from test_federation import FED_XML, duplicate_batch, make_fed  # noqa: F401
+from test_observability import parse_exposition  # noqa: F401
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    faults.configure("")
+    costs._reset_for_tests()
+    memory._reset_for_tests()
+    slo._reset_for_tests()
+    yield
+    faults.configure(None)
+    costs._reset_for_tests()
+    memory._reset_for_tests()
+    slo._reset_for_tests()
+
+
+# -- tentpole a: the device-time cost ledger ----------------------------------
+
+
+class TestCostLedger:
+    def test_busy_and_compile_accumulate(self):
+        now = 1_000_000.0
+        costs.note_busy(0.25, now)
+        costs.note_busy(0.5, now + 1)
+        costs.note_compile(2.0)
+        assert costs.busy_seconds_total() == pytest.approx(0.75)
+        assert costs.compile_seconds_total() == pytest.approx(2.0)
+
+    def test_disabled_ledger_is_a_noop(self):
+        costs.configure(False)
+        try:
+            costs.note_busy(1.0)
+            costs.note_compile(1.0)
+            assert costs.busy_seconds_total() == 0.0
+            assert costs.compile_seconds_total() == 0.0
+            assert costs.snapshot()["enabled"] is False
+        finally:
+            costs.configure(True)
+
+    def test_utilization_window_ages_out(self):
+        """2.5 busy seconds inside the 60 s window → ~4.2% utilization;
+        the same credit 2 windows ago → 0 (uptime pinned past the
+        window so the clamp does not distort the denominator)."""
+        import time as _time
+
+        now = _time.monotonic() + 2 * costs.WINDOW_S
+        costs.note_busy(2.5, now - 10.0)
+        assert costs.utilization(now) == pytest.approx(
+            2.5 / costs.WINDOW_S, rel=1e-6)
+        costs._reset_for_tests()
+        costs.note_busy(2.5, now - 3 * costs.WINDOW_S)
+        assert costs.utilization(now + costs.WINDOW_S) == 0.0
+
+    def test_utilization_clamps_to_one(self):
+        import time as _time
+
+        now = _time.monotonic() + 2 * costs.WINDOW_S
+        costs.note_busy(10_000.0, now - 1.0)
+        assert costs.utilization(now) == 1.0
+
+    def test_ledger_families_render_on_global(self):
+        costs.note_busy(0.125)
+        costs.note_compile(0.5)
+        scraped = parse_exposition(telemetry.render(telemetry.GLOBAL))
+        assert scraped[("duke_cost_busy_seconds_total", ())] == \
+            pytest.approx(0.125)
+        assert scraped[("duke_cost_compile_seconds_total", ())] == \
+            pytest.approx(0.5)
+        assert ("duke_device_utilization", ()) in scraped
+
+
+class TestReconciliation:
+    """The acceptance invariant: attributed phase seconds == measured
+    busy seconds, under the scheduler's merged-microbatch path."""
+
+    def _submit_concurrently(self, app, n_threads=4, batches_each=3):
+        errors = []
+
+        def worker(t):
+            for b in range(batches_each):
+                try:
+                    app.scheduler.submit(
+                        "deduplication", "people", "crm",
+                        duplicate_batch(8, identities=4,
+                                        start=1000 * t + 100 * b))
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+
+    def test_ledger_reconciles_under_scheduler(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MIN_RELEVANCE", "0.05")
+        sc = parse_config(FED_XML.format(folder=tmp_path))
+        app = DukeApp(sc, persistent=False)
+        try:
+            assert app.scheduler is not None, \
+                "scheduler must be on (default) for the merged path"
+            self._submit_concurrently(app)
+            attributed = 0.0
+            for _kind, _name, wl in debug_api._app_workloads(app):
+                attributed += sum(
+                    wl.processor.phases.phase_seconds().values())
+            busy = costs.busy_seconds_total()
+            assert busy > 0.0
+            assert attributed == pytest.approx(
+                busy, abs=max(0.05, 0.01 * busy))
+        finally:
+            app.close()
+
+    def test_debug_costs_reports_reconciles(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MIN_RELEVANCE", "0.05")
+        sc = parse_config(FED_XML.format(folder=tmp_path))
+        app = DukeApp(sc, persistent=False)
+        try:
+            self._submit_concurrently(app, n_threads=2, batches_each=2)
+            status, body, _ = debug_api.handle_costs(
+                debug_api._app_workloads(app))
+            payload = json.loads(body)
+            assert status == 200
+            assert payload["reconciles"] is True
+            assert payload["busy_seconds_total"] > 0.0
+            assert payload["attributed_seconds"] == pytest.approx(
+                payload["busy_seconds_total"],
+                abs=payload["tolerance_seconds"])
+            (wl_row,) = payload["workloads"]
+            assert wl_row["workload"] == "people"
+            assert set(wl_row["phase_seconds"]) == \
+                {"encode", "retrieve", "score", "persist"}
+        finally:
+            app.close()
+
+
+# -- tentpole b: the HBM ledger -----------------------------------------------
+
+
+class _FakeOwner:
+    closed = False
+
+
+class TestHbmLedger:
+    def test_register_components_and_weakref_reaping(self):
+        owner = _FakeOwner()
+        memory.register(owner, "deduplication", "x",
+                        lambda: {"corpus_tensors": 1024, "empty": 0})
+        assert memory.components_for(owner) == {"corpus_tensors": 1024.0}
+        owner.closed = True
+        assert all(o is not owner for _k, _n, o, _f in memory._iter_live())
+        owner.closed = False
+        del owner
+        import gc
+
+        gc.collect()
+        assert memory._iter_live() == []
+
+    def test_components_fn_failure_never_fails_a_scrape(self):
+        owner = _FakeOwner()
+
+        def boom():
+            raise RuntimeError("mid-mutation")
+
+        memory.register(owner, "deduplication", "x", boom)
+        assert memory.components_for(owner) == {}
+        assert memory.debug_snapshot()["workloads"] == []
+
+    def test_budget_env_override_and_headroom(self, monkeypatch):
+        monkeypatch.setenv("DUKE_HBM_BUDGET_MB", "64")
+        owner = _FakeOwner()
+        memory.register(owner, "deduplication", "x",
+                        lambda: {"corpus_tensors": 1 << 20})
+        snap = memory.debug_snapshot()
+        assert snap["budget_source"] == "env"
+        assert snap["budget_bytes"] == 64 << 20
+        assert snap["headroom_bytes"] == \
+            snap["budget_bytes"] - snap["total_bytes"]
+        assert snap["total_bytes"] >= 1 << 20
+        assert {"kind": "deduplication", "workload": "x",
+                "component": "corpus_tensors",
+                "bytes": 1 << 20} in snap["workloads"]
+
+    def test_overflow_forecast(self):
+        assert memory.overflow_days(1000.0) == -1.0  # no growth observed
+        with memory._REG_LOCK:
+            memory._growth.append((1_000.0, 100.0))
+            memory._growth.append((1_000.0 + 86_400.0, 200.0))
+        assert memory.growth_bytes_per_day() == pytest.approx(100.0)
+        assert memory.overflow_days(1000.0) == pytest.approx(10.0)
+
+    def test_headroom_families_render_on_global(self, monkeypatch):
+        monkeypatch.setenv("DUKE_HBM_BUDGET_MB", "64")
+        scraped = parse_exposition(telemetry.render(telemetry.GLOBAL))
+        assert scraped[("duke_device_headroom_bytes", ())] <= 64 << 20
+        assert ("duke_device_overflow_days", ()) in scraped
+
+    def test_workload_registers_corpus_components(self, tmp_path,
+                                                  monkeypatch):
+        monkeypatch.setenv("MIN_RELEVANCE", "0.05")
+        sc = parse_config(FED_XML.format(folder=tmp_path))
+        # device backend: the host index keeps no device-resident corpus
+        # tensors, so only device-backed workloads have HBM components
+        app = DukeApp(sc, backend="device", persistent=False)
+        try:
+            app.scheduler.submit("deduplication", "people", "crm",
+                                 duplicate_batch(12))
+            (_, _, wl), = list(debug_api._app_workloads(app))
+            comps = memory.components_for(wl)
+            assert comps.get("corpus_tensors", 0) > 0
+        finally:
+            app.close()
+
+
+# -- tentpole c: sub-range heat maps ------------------------------------------
+
+
+class _Rng:
+    def __init__(self, lo, hi, range_id=None):
+        self.lo, self.hi = lo, hi
+        self.range_id = range_id or f"{lo:016x}"
+
+
+class TestHeat:
+    def test_uniform_load_splits_near_midpoint(self):
+        lo, hi = 0, 1 << 32
+        counts = [4] * heat.N_BUCKETS
+        split = int(heat.suggest_split(lo, hi, counts), 16)
+        mid = (lo + hi) // 2
+        span = hi - lo
+        assert abs(split - mid) <= span // heat.N_BUCKETS
+
+    def test_no_traffic_no_split(self):
+        assert heat.suggest_split(0, 1 << 32, [0] * heat.N_BUCKETS) is None
+        assert heat.suggest_split(5, 6, [1] * heat.N_BUCKETS) is None
+
+    def test_skewed_keyspace_split_lands_in_hot_band(self):
+        """80% of traffic in the first 5% of the span: the naive
+        midpoint is exactly wrong; the suggested split must bisect the
+        OBSERVED load, i.e. land inside the hot band."""
+        lo, hi = 1 << 40, 1 << 41
+        span = hi - lo
+        hm = heat.HeatMap()
+        rng = _Rng(lo, hi)
+        hot_hi = lo + span // 20  # first 5% of the keyspan
+        for i in range(800):
+            hm.note(rng, lo + (i * (hot_hi - lo) // 800))
+        for i in range(200):
+            hm.note(rng, lo + (i * span // 200))
+        (range_id, slo_, shi, counts), = hm.snapshot()
+        total = sum(counts)
+        assert total == 1000
+        split = int(heat.suggest_split(slo_, shi, counts), 16)
+        # load-bisecting split sits inside the hot 5% band, nowhere
+        # near the naive midpoint
+        assert lo < split <= hot_hi + span // heat.N_BUCKETS
+        assert abs(split - (lo + hi) // 2) > span // 4
+
+    def test_counts_reset_on_bound_change(self):
+        hm = heat.HeatMap()
+        hm.note(_Rng(0, 100, "r"), 10)
+        hm.note(_Rng(0, 200, "r"), 10)  # re-keyed span: old buckets lie
+        (_, _, hi, counts), = hm.snapshot()
+        assert hi == 200 and sum(counts) == 1
+
+    def test_loadmap_payload(self):
+        hm = heat.HeatMap()
+        rng = _Rng(0, 256)
+        for key in (0, 0, 0, 200):
+            hm.note(rng, key)
+        payload = heat.loadmap(hm)
+        assert payload["n_buckets"] == heat.N_BUCKETS
+        (row,) = payload["ranges"]
+        assert row["records_total"] == 4
+        assert row["hot_bucket_share"] == pytest.approx(0.75)
+        assert row["suggested_split"] is not None
+        assert heat.loadmap(None) == {"n_buckets": heat.N_BUCKETS,
+                                      "ranges": []}
+
+    def test_collect_family_emits_nonzero_buckets_only(self):
+        hm = heat.HeatMap()
+        hm.note(_Rng(0, 256, "r0"), 7)
+        fam = heat.collect_family(hm)
+        assert fam.name == "duke_fed_subrange_records_total"
+        assert fam.samples == [
+            ("", (("range", "r0"), ("bucket", "7")), 1.0)]
+
+
+# -- the federation plane: rollup + debug surface -----------------------------
+
+
+class TestFederationPlaneCapacity:
+    @pytest.fixture()
+    def plane(self, tmp_path):
+        from sesam_duke_microservice_tpu.federation import Federation
+        from sesam_duke_microservice_tpu.service.federation_plane import (
+            serve_federation,
+        )
+
+        # device-backed groups so the HBM ledger has corpus components
+        # to roll up (the host index keeps nothing device-resident)
+        sc = parse_config(FED_XML.format(folder=tmp_path),
+                          env={"MIN_RELEVANCE": "0.05"})
+        fed = Federation(sc, n_groups=2, backend="device")
+        server = serve_federation(fed)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        yield fed, base
+        server.shutdown()
+        fed.close()
+
+    @staticmethod
+    def _get(url):
+        return urllib.request.urlopen(url, timeout=60)
+
+    @staticmethod
+    def _post(url, obj=None):
+        req = urllib.request.Request(
+            url, data=json.dumps(obj or []).encode("utf-8"), method="POST",
+            headers={"Content-Type": "application/json"})
+        return urllib.request.urlopen(req, timeout=60)
+
+    def _ingest(self, fed, base, n=24):
+        with self._post(base + "/deduplication/people/crm",
+                        duplicate_batch(n)) as r:
+            assert r.status == 200
+        for g in fed.groups:
+            for wl in g.workloads.values():
+                wl.link_database.drain()
+
+    def test_cost_and_hbm_families_roll_up_losslessly(self, plane):
+        """The acceptance differential for the two new families: the
+        fed scrape's ``duke_cost_device_seconds_total`` equals the
+        key-wise SUM of the groups' own collector samples, and every
+        per-group ``duke_device_bytes`` gauge appears relabeled under
+        its disjoint ``group=`` label set."""
+        from sesam_duke_microservice_tpu.service.metrics import (
+            make_group_collector,
+        )
+
+        fed, base = plane
+        self._ingest(fed, base)
+
+        expected_sums = {}
+        expected_gauges = {}
+        for g in fed.groups:
+            for fam in make_group_collector(g)():
+                if fam.name not in ("duke_cost_device_seconds_total",
+                                    "duke_device_bytes"):
+                    continue
+                for suffix, labels, value in fam.samples:
+                    if fam.mtype == "gauge":
+                        key = (fam.name + suffix, tuple(sorted(
+                            labels + (("group", str(g.idx)),))))
+                        expected_gauges[key] = float(value)
+                    else:
+                        key = (fam.name + suffix, tuple(sorted(labels)))
+                        expected_sums[key] = (
+                            expected_sums.get(key, 0.0) + float(value))
+
+        with self._get(base + "/metrics") as r:
+            scraped = parse_exposition(r.read().decode("utf-8"))
+
+        assert expected_sums, "no cost counters emitted"
+        assert expected_gauges, "no per-workload device-bytes gauges"
+        for key, value in expected_sums.items():
+            assert key in scraped, key
+            assert scraped[key] == pytest.approx(value), key
+        for key, value in expected_gauges.items():
+            assert key in scraped, key
+            assert scraped[key] == pytest.approx(value), key
+        # both groups ran all four phases
+        phases = {dict(ls).get("phase")
+                  for (n, ls) in scraped
+                  if n == "duke_cost_device_seconds_total"}
+        assert phases == {"encode", "retrieve", "score", "persist"}
+        # the process-level ledger + headroom gauges ride the same scrape
+        assert scraped[("duke_cost_busy_seconds_total", ())] > 0.0
+        assert ("duke_device_headroom_bytes", ()) in scraped
+
+    def test_subrange_heat_reaches_metrics_and_loadmap(self, plane):
+        fed, base = plane
+        self._ingest(fed, base, n=30)
+        with self._get(base + "/metrics") as r:
+            scraped = parse_exposition(r.read().decode("utf-8"))
+        routed = sum(v for (n, _ls), v in scraped.items()
+                     if n == "duke_fed_subrange_records_total")
+        assert routed == 30
+        with self._get(base + "/debug/loadmap") as r:
+            payload = json.loads(r.read())
+        assert payload["n_buckets"] == heat.N_BUCKETS
+        assert sum(row["records_total"]
+                   for row in payload["ranges"]) == 30
+        for row in payload["ranges"]:
+            assert set(row) >= {"range", "lo", "hi", "records_total",
+                                "buckets", "hot_bucket_share",
+                                "suggested_split"}
+
+    def test_heat_counts_follow_ownership(self, plane):
+        """Bucket placement is not just volume: every routed record's
+        key must land in the histogram of the range that OWNS it."""
+        fed, base = plane
+        batch = duplicate_batch(20)
+        self._ingest(fed, base, n=20)
+        ds = fed.groups[0].workload(
+            "deduplication", "people").datasources["crm"]
+        per_range = {}
+        for e in batch:
+            rng = fed.map.owner(route_key(ds.record_id_for_entity(e)))
+            per_range[rng.range_id] = per_range.get(rng.range_id, 0) + 1
+        observed = {range_id: sum(counts) for range_id, _lo, _hi, counts
+                    in fed.router.heat.snapshot()}
+        assert observed == per_range
+
+    def test_debug_costs_memory_slo_on_fed_plane(self, plane):
+        fed, base = plane
+        self._ingest(fed, base)
+        with self._get(base + "/debug/costs") as r:
+            payload = json.loads(r.read())
+        assert payload["reconciles"] is True
+        assert len(payload["workloads"]) == len(fed.groups)
+        with self._get(base + "/debug/memory") as r:
+            payload = json.loads(r.read())
+        assert payload["budget_bytes"] > 0
+        assert any(row["component"] == "corpus_tensors"
+                   for row in payload["workloads"])
+        with self._get(base + "/debug/slo") as r:
+            payload = json.loads(r.read())
+        assert any(t["signal"] == "ingest" for t in payload["trackers"])
+
+    def test_heat_disabled_by_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DUKE_FED_HEAT", "0")
+        fed = make_fed(tmp_path, n_groups=2)
+        try:
+            assert fed.router.heat is None
+            fed.router.submit("deduplication", "people", "crm",
+                              duplicate_batch(6))
+            assert heat.loadmap(fed.router.heat)["ranges"] == []
+        finally:
+            fed.close()
+
+
+# -- the main serving plane: the four debug endpoints -------------------------
+
+
+class TestMainPlaneEndpoints:
+    @pytest.fixture()
+    def app_base(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MIN_RELEVANCE", "0.05")
+        sc = parse_config(FED_XML.format(folder=tmp_path))
+        app = DukeApp(sc, persistent=False)
+        server = serve(app, port=0, host="127.0.0.1")
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        yield app, f"http://127.0.0.1:{server.server_address[1]}"
+        server.shutdown()
+        app.close()
+
+    def test_capacity_endpoints_live(self, app_base):
+        app, base = app_base
+        app.scheduler.submit("deduplication", "people", "crm",
+                             duplicate_batch(8))
+        with urllib.request.urlopen(base + "/debug/costs",
+                                    timeout=60) as r:
+            payload = json.loads(r.read())
+        assert payload["reconciles"] is True
+        assert payload["busy_seconds_total"] > 0.0
+        with urllib.request.urlopen(base + "/debug/memory",
+                                    timeout=60) as r:
+            payload = json.loads(r.read())
+        assert payload["headroom_bytes"] == \
+            payload["budget_bytes"] - payload["total_bytes"]
+        with urllib.request.urlopen(base + "/debug/loadmap",
+                                    timeout=60) as r:
+            payload = json.loads(r.read())
+        # a single-process plane routes nothing through a federation
+        # router: the loadmap is present but empty
+        assert payload == {"n_buckets": heat.N_BUCKETS, "ranges": []}
+        with urllib.request.urlopen(base + "/debug/slo",
+                                    timeout=60) as r:
+            payload = json.loads(r.read())
+        assert isinstance(payload["trackers"], list)
+
+
+# -- cross-plane profile ownership (satellite 1 + 6) --------------------------
+
+
+class TestProfileOwnership:
+    @pytest.fixture(autouse=True)
+    def _stub_profiler(self, monkeypatch):
+        monkeypatch.setattr(profiling, "profiler_start", lambda d: None)
+        monkeypatch.setattr(profiling, "profiler_stop", lambda: None)
+        yield
+        profiling.stop_capture()
+
+    def test_second_start_is_409_with_owner_and_deadline(self):
+        status, body, _ = debug_api.handle_profile_start(
+            {"seconds": ["60"]}, owner="federation")
+        assert status == 200
+        assert json.loads(body)["capturing"]["owner"] == "federation"
+        status, body, _ = debug_api.handle_profile_start(
+            {"seconds": ["5"]}, owner="replica")
+        payload = json.loads(body)
+        assert status == 409
+        assert payload["owner"] == "federation"
+        assert payload["deadline_unix"] > 0
+        assert 0 < payload["remaining_seconds"] <= 60
+        status, body, _ = debug_api.handle_profile_status()
+        assert json.loads(body)["capturing"]["owner"] == "federation"
+
+    def test_fed_plane_profile_endpoints(self, tmp_path):
+        from sesam_duke_microservice_tpu.service.federation_plane import (
+            serve_federation,
+        )
+
+        fed = make_fed(tmp_path, n_groups=2)
+        server = serve_federation(fed)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            req = urllib.request.Request(
+                base + "/debug/profile?seconds=30", data=b"",
+                method="POST")
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert r.status == 200
+                assert json.loads(r.read())["capturing"]["owner"] == \
+                    "federation"
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(urllib.request.Request(
+                    base + "/debug/profile?seconds=5", data=b"",
+                    method="POST"), timeout=60)
+            assert exc.value.code == 409
+            conflict = json.loads(exc.value.read())
+            assert conflict["owner"] == "federation"
+            assert conflict["deadline_unix"] > 0
+            req = urllib.request.Request(
+                base + "/debug/profile/reset", data=b"", method="POST")
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert json.loads(r.read())["trace_budget_reset"] is True
+        finally:
+            server.shutdown()
+            fed.close()
+
+    def test_replica_plane_profile_endpoints(self):
+        from sesam_duke_microservice_tpu.service.replica_plane import (
+            serve_replica_plane,
+        )
+        from test_observability import _StubSession
+
+        server = serve_replica_plane(_StubSession(), port=0,
+                                     host="127.0.0.1")
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            req = urllib.request.Request(
+                base + "/debug/profile?seconds=30", data=b"",
+                method="POST")
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert r.status == 200
+                assert json.loads(r.read())["capturing"]["owner"] == \
+                    "replica"
+            # the replica's read-side debug routes ride along
+            with urllib.request.urlopen(base + "/debug/costs",
+                                        timeout=60) as r:
+                assert json.loads(r.read())["reconciles"] is True
+            with urllib.request.urlopen(base + "/debug/memory",
+                                        timeout=60) as r:
+                assert "headroom_bytes" in json.loads(r.read())
+        finally:
+            server.shutdown()
+
+
+# -- SLO violation exemplars (satellite 2) ------------------------------------
+
+
+class TestSloExemplars:
+    def test_violation_carries_exemplar_trace_link(self):
+        t = slo.tracker("ingest", "deduplication", "people")
+        t.record(0.001)                      # within objective: no row
+        t.record(30.0, trace_id="cafe1234")  # violation with exemplar
+        t.record(30.0)                       # violation, unsampled
+        snap = slo.debug_snapshot()
+        tracker = next(row for row in snap["trackers"]
+                       if row["signal"] == "ingest"
+                       and row["workload"] == "people")
+        assert tracker["violations_total"] == 2
+        recent = tracker["recent_violations"]
+        assert len(recent) == 2
+        # newest first: the unsampled one, then the exemplar
+        assert recent[0]["trace_id"] is None
+        assert recent[0]["trace"] is None
+        assert recent[1]["trace_id"] == "cafe1234"
+        assert recent[1]["trace"] == "/debug/traces/cafe1234"
+        assert recent[1]["age_seconds"] >= 0.0
+
+    def test_debug_snapshot_limit(self):
+        t = slo.tracker("ingest", "deduplication", "people")
+        for i in range(30):
+            t.record(30.0, trace_id=f"t{i}")
+        snap = slo.debug_snapshot(limit=5)
+        tracker = next(row for row in snap["trackers"]
+                       if row["workload"] == "people")
+        assert [v["trace_id"] for v in tracker["recent_violations"]] == \
+            ["t29", "t28", "t27", "t26", "t25"]
+
+    def test_batch_exemplars_align_with_latencies(self):
+        t = slo.SloTracker(objective_s=0.1, target=0.99)
+        now = 1_000_000.0
+        t.record_batch([0.01, 0.5, 0.02, 0.9], now,
+                       trace_ids=[None, "aa", None, "bb"])
+        rows = t.recent_violations()
+        assert [(ts, tid) for ts, tid in rows] == \
+            [(now, "bb"), (now, "aa")]
