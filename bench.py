@@ -1247,6 +1247,19 @@ OBS_RUNS = int(os.environ.get("BENCH_OBS_RUNS", "2"))
 # asserting the suggested split point lands in the hot band.
 # BENCH_CAPACITY=0 skips it.
 CAP_BENCH = os.environ.get("BENCH_CAPACITY", "1") != "0"
+# mesh differential bench (ISSUE 18): the SAME deterministic batches
+# through the single-device brute-force arm and the N-way virtual-mesh
+# sharded arm (constraint-driven GSPMD — jit over NamedSharding-placed
+# corpus tensors, XLA inserts the merge collectives) inside ONE forced
+# N-device child process.  Reports records/s per arm, the analytic
+# per-device score-FLOP split, the top-K merge collective's payload in
+# bytes/query, and asserts the ordered event tapes bit-identical (exact
+# blocking: the merged global top-K IS the single-device top-K).  Also
+# snapshots the outcome to MULTICHIP_r06.json at the repo root.
+# BENCH_MESH=0 skips it.
+MESH_BENCH = os.environ.get("BENCH_MESH", "1") != "0"
+MESH_DEVICES = int(os.environ.get("BENCH_MESH_DEVICES", "8"))
+MESH_RECORDS = int(os.environ.get("BENCH_MESH_RECORDS", "384"))
 
 FED_XML = """
 <DukeMicroService dataFolder="{folder}">
@@ -1547,6 +1560,146 @@ def capacity_bench() -> dict:
         "runs_per_arm": runs,
         "skew": skew,
     }
+
+
+# -- mesh differential: single-device vs N-way virtual mesh (ISSUE 18) -------
+
+_MESH_CHILD = r'''
+import json, os, time
+from sesam_duke_microservice_tpu.utils.virtual_mesh import force_cpu_platform
+force_cpu_platform()
+from bench import bench_schema, stresstest_records
+from sesam_duke_microservice_tpu.core.config import MatchTunables
+from sesam_duke_microservice_tpu.engine.device_matcher import (
+    DeviceIndex, DeviceProcessor)
+from sesam_duke_microservice_tpu.engine.listeners import MatchListener
+from sesam_duke_microservice_tpu.engine.sharded_matcher import (
+    ShardedDeviceIndex, ShardedDeviceProcessor)
+
+
+class Tape(MatchListener):
+    def __init__(self):
+        self.events = []
+
+    def matches(self, r1, r2, confidence):
+        self.events.append(
+            ("match", r1.record_id, r2.record_id, round(confidence, 9)))
+
+    def matches_perhaps(self, r1, r2, confidence):
+        self.events.append(
+            ("maybe", r1.record_id, r2.record_id, round(confidence, 9)))
+
+    def no_match_for(self, record):
+        self.events.append(("none", record.record_id))
+
+
+n = int(os.environ["MESH_RECORDS"])
+schema = bench_schema()
+# warm batch compiles the arm's programs AND fills the corpus; the timed
+# batch then scores against an identical corpus state in both arms
+warm_batch = stresstest_records(n, seed=77, dataset="ds1")
+timed_batch = stresstest_records(n, seed=78, dataset="ds2")
+
+
+def run_arm(arm):
+    if arm == "mesh":
+        index = ShardedDeviceIndex(schema, tunables=MatchTunables())
+        proc = ShardedDeviceProcessor(schema, index)
+        ndev = index.mesh.size
+    else:
+        index = DeviceIndex(schema, tunables=MatchTunables())
+        proc = DeviceProcessor(schema, index)
+        ndev = 1
+    tape = Tape()
+    proc.add_match_listener(tape)
+    proc.deduplicate(warm_batch)
+    t0 = time.perf_counter()
+    proc.deduplicate(timed_batch)
+    dt = time.perf_counter() - t0
+    cap = index.corpus.capacity
+    top_k = int(os.environ.get("DEVICE_TOP_K", "64"))
+    chars = int(os.environ.get("DEVICE_MAX_CHARS", "24"))
+    grams = int(os.environ.get("DEVICE_MAX_GRAMS", "24"))
+    nprops = len(index.plan.device_props)
+    # coarse analytic attribution (same spirit as the ivf section's
+    # retrieval model): ~2 flops per char/gram cell per device property
+    # per scored corpus row.  The mesh splits the row axis N ways, so
+    # per-device work is the single-chip figure / ndev.
+    flops_q = 2.0 * cap * (chars + grams) * nprops
+    return {
+        "devices": ndev,
+        "records_per_sec": round(len(timed_batch) / dt, 1),
+        "batch_seconds": round(dt, 3),
+        "corpus_capacity": cap,
+        "score_flops_per_query": flops_q,
+        "score_flops_per_query_per_device": flops_q / ndev,
+        # the GSPMD top-K merge: each device contributes top_k
+        # (logit f32 + index i32) rows per query into the replicated
+        # gather XLA inserts for parallel.sharded.merge_topk
+        "collective_bytes_per_query": ndev * top_k * 8 if ndev > 1 else 0,
+    }, tape.events
+
+
+single, single_events = run_arm("single")
+mesh, mesh_events = run_arm("mesh")
+print("MESH " + json.dumps({
+    "single_device": single,
+    "mesh": mesh,
+    "events": len(mesh_events),
+    # exact blocking: the merged global top-K IS the single-device
+    # top-K, so the whole ordered tape must be bit-identical
+    "events_identical": mesh_events == single_events,
+}))
+'''
+
+
+def mesh_bench() -> dict:
+    """ISSUE 18 acceptance surface: single-device vs N-way virtual-mesh
+    differential in a forced-device-count child, bit-identical tapes
+    required, snapshot written to MULTICHIP_r06.json."""
+    import subprocess
+
+    from sesam_duke_microservice_tpu.utils.virtual_mesh import (
+        virtual_mesh_env,
+    )
+
+    env = virtual_mesh_env(MESH_DEVICES, "_BENCH_MESH_INNER")
+    env.update({
+        "PYTHONPATH": os.path.dirname(os.path.abspath(__file__)),
+        "MESH_RECORDS": str(MESH_RECORDS),
+        # small shapes keep the forced-CPU child's compiles in seconds;
+        # the chunk is the mesh granule unit (capacity pads to
+        # ndev * chunk), sized so the timed corpus fits one granule
+        "DEVICE_CHUNK": "64",
+        "DEVICE_QUERY_BUCKETS": "64",
+        "DEVICE_TOP_K": "16",
+        "DEVICE_MAX_CHARS": "24",
+        "DEVICE_MAX_GRAMS": "24",
+        "DEVICE_PREWARM": "0",
+        "DEVICE_INITIAL_CAPACITY": "0",
+        "DUKE_AOT": "0",
+    })
+    proc = subprocess.run(
+        [sys.executable, "-c", _MESH_CHILD], env=env,
+        capture_output=True, text=True, timeout=1800,
+    )
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("MESH ")]
+    if proc.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"mesh bench child failed: rc={proc.returncode}\n"
+            f"{proc.stdout}\n{proc.stderr}")
+    out = json.loads(lines[0][len("MESH "):])
+    assert out["events_identical"], "mesh arm diverged from single-device"
+    out["n_devices"] = MESH_DEVICES
+    snapshot = dict(out, rc=proc.returncode, ok=bool(out["events_identical"]),
+                    skipped=False)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "MULTICHIP_r06.json")
+    with open(path, "w") as fh:
+        json.dump(snapshot, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return out
 
 
 # -- open-loop tail latency / cold start / recovery window (ISSUE 15) --------
@@ -1895,6 +2048,8 @@ def main():
         result["observability"] = observability_bench()
     if CAP_BENCH and BACKEND == "device":
         result["capacity"] = capacity_bench()
+    if MESH_BENCH and BACKEND == "device":
+        result["mesh"] = mesh_bench()
     if TAIL and BACKEND == "device":
         result["tail_latency"] = tail_latency_bench()
     print(json.dumps(result))

@@ -264,15 +264,13 @@ class _AnnScorerCache(_ScorerCache):
         embedding tree).  The IVF program is deliberately NOT pre-warmed:
         its shapes depend on trained cell geometry, which only exists
         once data arrived."""
-        import jax
-
         row_feats = dict(row_feats)
         emb_tree = row_feats.pop(E.ANN_PROP)
         cfeats, (mb, mb2, mi, qg, qr, ml) = self._lower_args(
             row_feats, cap, bucket
         )
         corpus_tree = {
-            name: jax.ShapeDtypeStruct((cap,) + arr.shape[1:], arr.dtype)
+            name: self._sds((cap,) + arr.shape[1:], arr.dtype)
             for name, arr in emb_tree.items()
         }
         c = self._ladder_k(cap)
@@ -280,21 +278,21 @@ class _AnnScorerCache(_ScorerCache):
         # _ScorerCache._lower_one
         scorer = self._build(c, group_filtering, from_rows, plan=plan)
         if from_rows:
-            q_emb = jax.ShapeDtypeStruct((), np.float32)
+            q_emb = self._sds((), np.float32, "queries")
             qfeats = {}
         else:
             pf = dict(probe_feats)
             pemb = pf.pop(E.ANN_PROP)
             q_emb = {
-                name: jax.ShapeDtypeStruct(
-                    (bucket,) + arr.shape[1:], arr.dtype
+                name: self._sds(
+                    (bucket,) + arr.shape[1:], arr.dtype, "queries"
                 )
                 for name, arr in pemb.items()
             }
             qfeats = {
                 prop: {
-                    name: jax.ShapeDtypeStruct(
-                        (bucket,) + arr.shape[1:], arr.dtype
+                    name: self._sds(
+                        (bucket,) + arr.shape[1:], arr.dtype, "queries"
                     )
                     for name, arr in tensors.items()
                 }

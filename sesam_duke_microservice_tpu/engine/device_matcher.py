@@ -1655,13 +1655,13 @@ class _ScorerCache:
 
     # Indexed-query batches normally gather their features on device from
     # the corpus rows (only the row-index array crosses the host->device
-    # link).  The sharded cache disables this: a cross-shard gather inside
-    # shard_map would need collectives, so sharded queries upload replicated.
+    # link).  The sharded caches disable this: queries ride replicated over
+    # the mesh, so they upload bucket-shaped feature tensors instead.
     queries_from_rows = True
 
-    # AOT executable-store participation (ISSUE 15): the sharded caches
-    # opt out — their shard_map programs compile against a live mesh and
-    # their prewarm ladder is disabled anyway.
+    # AOT executable-store participation (ISSUE 15/18): on by default;
+    # the sharded caches keep it on with mesh-annotated lowering shapes
+    # and mesh facets in the store key (engine.sharded_matcher).
     supports_aot = True
     # store-key namespace: the ANN cache's programs share the ladder
     # geometry but different HLO, so the builders must never collide
@@ -1708,6 +1708,12 @@ class _ScorerCache:
         cache overrides with its top-C)."""
         return min(_INITIAL_TOP_K, cap)
 
+    def _min_warm_cap(self) -> int:
+        """Smallest capacity the ladder lowers at — one scan chunk for
+        the single-device programs; the sharded caches override with the
+        mesh granule (every shard needs whole chunks)."""
+        return _CHUNK
+
     def _store_key(self, plan, k: int, group_filtering: bool,
                    from_rows: bool, cap: int, bucket: int) -> dict:
         """The AOT store key for one ladder entry: everything the
@@ -1753,7 +1759,7 @@ class _ScorerCache:
         # executables register for the dispatch fast path directly).
         if enable_persistent_cache() is None and not aot:
             return  # no cache -> warming could never help the live scorer
-        cap = max(self.index.corpus.capacity, _CHUNK)
+        cap = max(self.index.corpus.capacity, self._min_warm_cap())
         key = (
             cap,
             tuple((s.v, s.chars) for s in self.index.plan.device_props),
@@ -1870,21 +1876,31 @@ class _ScorerCache:
         dummy.add_value(ID_PROPERTY_NAME, "__prewarm__")
         return self.index._extract([dummy])
 
-    def _lower_args(self, row_feats, cap: int, bucket: int):
+    def _sds(self, shape, dtype, family: str = "corpus"):
+        """Lowering-shape factory: the abstract aval one ladder entry
+        lowers against.  ``family`` names the partition-rule family the
+        tensor belongs to ("corpus" record-axis state vs "queries"
+        replicated query-side inputs) — meaningless on one device, but
+        the sharded caches override this to annotate each aval with its
+        mesh sharding so AOT executables compile against the real
+        layouts (parallel.sharded.PARTITION_RULES)."""
         import jax
 
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    def _lower_args(self, row_feats, cap: int, bucket: int):
         def sds(a):
-            return jax.ShapeDtypeStruct((cap,) + a.shape[1:], a.dtype)
+            return self._sds((cap,) + a.shape[1:], a.dtype)
 
         cfeats = {
             prop: {name: sds(arr) for name, arr in tensors.items()}
             for prop, tensors in row_feats.items()
         }
-        mb = jax.ShapeDtypeStruct((cap,), np.bool_)
-        mi = jax.ShapeDtypeStruct((cap,), np.int32)
-        qr = jax.ShapeDtypeStruct((bucket,), np.int32)
-        qg = jax.ShapeDtypeStruct((bucket,), np.int32)
-        ml = jax.ShapeDtypeStruct((), np.float32)
+        mb = self._sds((cap,), np.bool_)
+        mi = self._sds((cap,), np.int32)
+        qr = self._sds((bucket,), np.int32, "queries")
+        qg = self._sds((bucket,), np.int32, "queries")
+        ml = self._sds((), np.float32, "queries")
         return cfeats, (mb, mb, mi, qg, qr, ml)
 
     def _probe_shapes(self):
@@ -1902,8 +1918,6 @@ class _ScorerCache:
     def _lower_one(self, row_feats, cap: int, bucket: int,
                    group_filtering: bool, *, from_rows: bool = True,
                    probe_feats=None, plan=None):
-        import jax
-
         cfeats, (mb, mb2, mi, qg, qr, ml) = self._lower_args(
             row_feats, cap, bucket
         )
@@ -1919,8 +1933,8 @@ class _ScorerCache:
         else:
             qfeats = {
                 prop: {
-                    name: jax.ShapeDtypeStruct(
-                        (bucket,) + arr.shape[1:], arr.dtype
+                    name: self._sds(
+                        (bucket,) + arr.shape[1:], arr.dtype, "queries"
                     )
                     for name, arr in tensors.items()
                 }
@@ -2196,10 +2210,11 @@ class _ScorerCache:
         pending = self.dispatch_block(records, group_filtering=group_filtering)
         return resolve_block(pending)
 
-    # device-resident certified finalization (ISSUE 12): the sharded
-    # caches disable it — their corpus feature tensors live record-axis
-    # sharded across the mesh, so a global survivor gather would need
-    # collectives that the multi-host follower replay never enqueues
+    # device-resident certified finalization (ISSUE 12/18): on for every
+    # single-process backend — the sharded caches route the survivor
+    # gather through a replicated-layout mesh program first (_dd_call
+    # override in engine.sharded_matcher, gated off multi-host meshes)
+    # and then run the same dd rescorer
     supports_dd = True
 
     def dd_rescore(self, result: _BlockResult):
@@ -2210,9 +2225,11 @@ class _ScorerCache:
         dd-certifiable device properties plus the truncation-safety mask
         (ops.scoring.build_dd_rescorer) — or None when the block cannot
         ride the device (no certifiable property, no survivors at all,
-        sharded corpus).  Collective-free: under a multi-host dispatcher
-        this extra device program runs on the frontend only, which is
-        safe exactly because it never synchronizes across hosts.
+        multi-host mesh).  Collective-free on multi-host: under a
+        dispatcher this extra device program runs on the frontend only,
+        so the sharded caches expose ``supports_dd`` only when the whole
+        mesh is addressable from this process (their ``_dd_call`` gather
+        IS a collective — safe single-process, a deadlock cross-host).
         """
         if not self.supports_dd:
             return None
@@ -2242,9 +2259,17 @@ class _ScorerCache:
             return None
         cfeats_all = self.index.corpus.device_arrays()[0]
         cfeats = {s.name: cfeats_all[s.name] for s in S.dd_plan_specs(plan)}
-        hi, lo, unsafe = fn(qfeats, cfeats, query_row_j,
-                            jnp.asarray(result.top_index))
+        hi, lo, unsafe = self._dd_call(fn, qfeats, cfeats, query_row_j,
+                                       jnp.asarray(result.top_index))
         return (np.asarray(hi), np.asarray(lo), np.asarray(unsafe))
+
+    def _dd_call(self, fn, qfeats, cfeats, query_row_j, top_index):
+        """Run the dd program against the corpus tensors.  One device:
+        the gather happens inside ``fn``.  The sharded caches override
+        this to pre-gather the survivors to replicated layout and feed
+        ``fn`` an identity index — same program, same arithmetic, so the
+        verdicts stay bit-identical across backends."""
+        return fn(qfeats, cfeats, query_row_j, top_index)
 
 
 class _PendingBlock:

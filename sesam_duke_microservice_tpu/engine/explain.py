@@ -277,8 +277,13 @@ def _dd_breakdown(index, frozen, feats, r1: Record, r2: Record,
         out["decided_path"] = "band_skip"
         return out
     if not getattr(index.scorer_cache, "supports_dd", True):
-        # sharded backends: the survivor gather would need collectives,
-        # so the live path always rescores on host
+        # only multi-host meshes land here now (ISSUE 18): their dd
+        # survivor gather is a collective the follower replay never
+        # enqueues, so the live path rescores on host.  Fully-addressable
+        # sharded backends report supports_dd=True and fall through to
+        # the same dd replay the single-device path runs — the gathered
+        # 1x1 layout below is exactly the replicated block
+        # _MeshProgramLift._dd_call feeds the live program.
         out["decided_path"] = "host_rescore"
         out["dd_residue_reason"] = "backend"
         return out

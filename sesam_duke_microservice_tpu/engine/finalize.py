@@ -278,7 +278,9 @@ class FinalizeExecutor:
         # device-certified finalization (ISSUE 12): the caller attaches
         # the block's dd rescore output (hi, lo, unsafe numpy arrays) to
         # the result; None means the block could not ride the device
-        # (sharded corpus, http-transform probes, dd rescore disabled)
+        # (multi-host mesh, http-transform probes, dd rescore disabled —
+        # fully-addressable sharded corpora DO ride it since ISSUE 18's
+        # replicated survivor gather)
         dd = getattr(result, "dd", None) if self.device else None
         plan = database.plan
         plan_has_dd = self.device and bool(S.dd_plan_specs(plan))

@@ -17,10 +17,18 @@ handlers (App.java:343-345,1005); here the same wiring scales to a
     brute-force blocking backends over that corpus — snapshots, value-slot
     growth, delete/tombstone and the ``CandidateIndex`` interface are all
     inherited unchanged;
-  * the scorer caches swap the single-device programs for the shard_map
-    ones: per-shard retrieval/scan with global row offsets, local exact
-    rescoring, and an ``all_gather`` top-K merge over ICI — communication
-    is O(Q * K * D) while compute scales 1/D (SURVEY.md section 5.7).
+  * the scorer caches swap the single-device programs for the
+    constraint-driven mesh ones (``parallel.sharded.PARTITION_RULES``):
+    per-shard retrieval/scan with global row offsets, local exact
+    rescoring, and a replicated-layout top-K merge the partitioner lowers
+    to one all-gather over ICI — communication is O(Q * K * D) while
+    compute scales 1/D (SURVEY.md section 5.7);
+  * both mesh caches are first-class engine citizens (ISSUE 18): they
+    ride the AOT executable store (mesh facets join the store key, the
+    prewarm ladder lowers against mesh-annotated avals) and the certified
+    dd finalize (survivors gather to replicated layout, then the same
+    ``ops.scoring.build_dd_rescorer`` program runs bit-identical to the
+    single-device path).
 
 Queries are replicated (uploaded per block, never gathered cross-shard),
 escalation loops (K for brute force, C for ANN recall) run unchanged
@@ -94,7 +102,7 @@ class ShardedDeviceCorpus(DeviceCorpus):
     """``DeviceCorpus`` whose device mirror is record-axis sharded.
 
     Capacity grows in ``mesh.size * chunk`` granules (each shard always
-    holds whole scan chunks — required by the shard_map scorers' local
+    holds whole scan chunks — required by the mesh scorers' per-shard
     ``row_offset`` arithmetic); placement and the incremental tree updater
     carry explicit shardings so the arrays never silently collapse to a
     single device.
@@ -188,15 +196,111 @@ class ShardedDeviceCorpus(DeviceCorpus):
         return self._mask_scatter_fn
 
 
-class _ShardedScorerCache(_ScorerCache):
-    """Brute-force scorer cache over the mesh (parallel.sharded program)."""
+class _MeshProgramLift:
+    """dd + AOT lifts shared by the mesh scorer caches (ISSUE 18).
+
+    Mixed in ahead of the base caches, this makes the sharded backends
+    first-class: queries upload replicated (never gathered cross-shard),
+    the dd survivor rescore runs on device through a replicated-layout
+    gather, and the AOT executable store serves mesh executables whose
+    store keys carry the mesh facets and whose lowering avals carry the
+    real shardings (``parallel.sharded.PARTITION_RULES``).
+    """
 
     queries_from_rows = False
-    # no device finalize on the sharded backends: the corpus feature
-    # tensors are record-axis sharded, so the survivor gather would need
-    # cross-shard collectives the follower replay never enqueues
-    # (engine.finalize falls back to the host path for every survivor)
-    supports_dd = False
+    supports_aot = True
+
+    # single-writer mesh observability (plain ints — scrape-time
+    # snapshots in service/metrics.py, never a registry write here)
+    _dd_gathers = 0
+    _dd_gather_rows = 0
+
+    @property
+    def supports_dd(self) -> bool:
+        """dd finalize runs on the FRONTEND only (the follower replay of
+        parallel/dispatch.py never enqueues it), so the survivor-gather
+        collective in ``_dd_call`` is only safe when every mesh device is
+        addressable from this process.  A multi-host mesh keeps the host
+        dd path (README: dd/AOT parity matrix)."""
+        return self._mesh_fully_addressable()
+
+    def _mesh_fully_addressable(self) -> bool:
+        cached = getattr(self, "_mesh_local", None)
+        if cached is None:
+            import jax
+
+            pid = jax.process_index()
+            cached = all(d.process_index == pid
+                         for d in self.index.mesh.devices.flat)
+            self._mesh_local = cached
+        return cached
+
+    def _dd_call(self, fn, qfeats, cfeats, query_row_j, top_index):
+        """Certified dd finalize over the mesh: gather the resolved
+        block's (Q, K) survivors from the record-axis-sharded corpus
+        tensors into a compact replicated block, then run the SAME
+        memoized single-device dd program against it with an identity
+        index.  Clipping ``top_index`` before the gather reproduces the
+        single-device "-1 padding gathers row 0" semantics exactly, so
+        the verdicts are bit-identical (tests/test_mesh_parity.py)."""
+        import jax.numpy as jnp
+
+        from ..parallel.sharded import build_replicated_gather
+
+        gather = getattr(self, "_dd_gather_fn", None)
+        if gather is None:
+            gather = build_replicated_gather(self.index.mesh)
+            self._dd_gather_fn = gather
+        q, k = top_index.shape
+        rows = jnp.clip(top_index, 0).reshape(-1)
+        gathered = gather(cfeats, rows)
+        self._dd_gathers += 1
+        self._dd_gather_rows += int(q * k)
+        ident = jnp.arange(q * k, dtype=jnp.int32).reshape(q, k)
+        return fn(qfeats, gathered, query_row_j, ident)
+
+    def _sds(self, shape, dtype, family: str = "corpus"):
+        """Mesh-annotated lowering avals: corpus-family tensors carry the
+        record-axis sharding, query-family tensors the replicated spec —
+        so an AOT executable compiles against (and at load time only
+        accepts) the layouts dispatch actually passes."""
+        import jax
+
+        from ..parallel.sharded import rule_sharding
+
+        fam = "corpus" if family == "corpus" else "queries"
+        return jax.ShapeDtypeStruct(
+            shape, dtype,
+            sharding=rule_sharding(self.index.mesh, fam, len(shape)),
+        )
+
+    def _ladder(self, cap: int):
+        # mesh queries never gather from corpus rows (queries_from_rows
+        # is False), so only the replicated-upload variant is ever
+        # dispatched — half the single-device ladder
+        return [e for e in super()._ladder(cap) if not e[2]]
+
+    def _min_warm_cap(self) -> int:
+        # the smallest real corpus capacity is one mesh granule (every
+        # shard holds whole scan chunks); lowering below it would bake
+        # shapes dispatch can never present
+        return self.index.corpus.granule
+
+    def _store_key(self, plan, k: int, group_filtering: bool,
+                   from_rows: bool, cap: int, bucket: int) -> dict:
+        from ..utils.jit_cache import mesh_fingerprint
+
+        key = super()._store_key(plan, k, group_filtering, from_rows,
+                                 cap, bucket)
+        # a mesh executable is only valid on the topology it was
+        # partitioned for: a 4-way entry must be unreachable from an
+        # 8-way mesh even on the same host (tests/test_mesh_aot.py)
+        key["mesh"] = mesh_fingerprint(self.index.mesh)
+        return key
+
+
+class _ShardedScorerCache(_MeshProgramLift, _ScorerCache):
+    """Brute-force scorer cache over the mesh (parallel.sharded program)."""
 
     def _build(self, top_k: int, group_filtering: bool, from_rows: bool,
                plan=None):
@@ -209,27 +313,14 @@ class _ShardedScorerCache(_ScorerCache):
             group_filtering=group_filtering,
         )
 
-    # no AOT participation either (ISSUE 15): shard_map executables
-    # compile against a live mesh topology; serialize/deserialize is
-    # unvalidated there and the prewarm ladder is disabled anyway
-    supports_aot = False
 
-    def prewarm_async(self, group_filtering: bool) -> None:
-        # the shard_map programs need mesh-aware lowering shapes; until a
-        # sharded prewarm ladder exists, first-contact compiles (cached in
-        # the persistent XLA cache) are the cost of this backend
-        return
-
-
-class _ShardedAnnScorerCache(_AnnScorerCache):
+class _ShardedAnnScorerCache(_MeshProgramLift, _AnnScorerCache):
     """ANN scorer cache over the mesh (parallel.ann_sharded program)."""
-
-    queries_from_rows = False
-    supports_dd = False  # see _ShardedScorerCache
-    supports_aot = False  # see _ShardedScorerCache
 
     def _build(self, top_c: int, group_filtering: bool, from_rows: bool,
                plan=None):
+        import jax
+
         from ..parallel.ann_sharded import build_sharded_ann_scorer
 
         base = build_sharded_ann_scorer(
@@ -237,10 +328,12 @@ class _ShardedAnnScorerCache(_AnnScorerCache):
             group_filtering=group_filtering,
         )
 
-        # adapt to the single-device ANN call convention (embedding tree
-        # carried separately): reassemble the corpus feature tree the
-        # sharded program expects (embedding — and the int8 scale when
-        # present — riding as a pseudo-property)
+        # a JITTED adapter to the single-device ANN call convention (the
+        # embedding tree — and the int8 scale when present — rides
+        # separately and is reassembled as the ANN_PROP pseudo-property
+        # inside the trace): AOT lowering needs a traceable callable
+        # with the engine's flat signature, not a host-side wrapper
+        @jax.jit
         def call(q_emb, qfeats, corpus_emb, corpus_feats, cvalid, cdeleted,
                  cgroup, query_group, query_row, min_logit):
             cfeats = dict(corpus_feats)
@@ -271,24 +364,21 @@ class _ShardedAnnScorerCache(_AnnScorerCache):
         return call
 
     def _ivf_placers(self):
-        """SNIPPETS.md pjit partition-rule pattern: replicate the small
-        lookup table (centroids), shard the big per-row state (the
-        stacked local-row membership matrix) on the record axis."""
+        """SNIPPETS.md pjit partition-rule pattern, through the shared
+        rule table: replicate the small lookup table (centroids), shard
+        the big per-row state (the stacked local-row membership matrix)
+        on the record axis."""
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from ..parallel.sharded import SHARD_AXIS
+        from ..parallel.sharded import rule_sharding
 
         mesh = self.index.mesh
-        repl = NamedSharding(mesh, P())
-        sharded = NamedSharding(mesh, P(SHARD_AXIS))
+        repl = rule_sharding(mesh, "centroids", 2)
+        sharded = rule_sharding(mesh, "ivf_membership", 2)
         return (
             lambda arr: jax.device_put(arr, repl),
             lambda arr: jax.device_put(arr, sharded),
         )
-
-    def prewarm_async(self, group_filtering: bool) -> None:
-        return  # see _ShardedScorerCache.prewarm_async
 
 
 class ShardedDeviceIndex(DeviceIndex):
@@ -338,8 +428,8 @@ class ShardedAnnIndex(AnnIndex):
 
     def _ivf_shards(self) -> int:
         # the IVF membership matrix stacks per-shard (K, B) blocks of
-        # LOCAL row ids so P(SHARD_AXIS) placement hands each shard_map
-        # instance exactly its own block (parallel.ann_sharded)
+        # LOCAL row ids so P(SHARD_AXIS) placement hands each mesh
+        # program lane exactly its own block (parallel.ann_sharded)
         return self.mesh.size
 
     @property

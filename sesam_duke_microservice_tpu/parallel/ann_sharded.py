@@ -6,13 +6,18 @@ over a 1-D device mesh, following the same layout as the brute-force
 sharded scorer (``parallel.sharded``): corpus tensors (including the
 ``ops.encoder`` embedding tree riding as a pseudo-property — the int8
 scale vector shards with it) sharded on the record axis, queries
-replicated.
+replicated.  Like the brute scorer, the program is a plain ``jit`` with
+``with_sharding_constraint`` annotations — per-shard work is a ``vmap``
+over the shard axis and the merge a constraint back to replicated layout,
+with the partitioner inserting the collectives.
 
 Per-shard work is fully local: cosine top-C over the local embedding rows
 (one bf16 — or int8 x int8 -> int32 — matmul per chunk), then exact
-rescoring of the local candidates — feature gathers never cross shards.
-Only the (Q, C) scored results move: ``all_gather`` over ICI collects
-every shard's (logit, global_row) pairs ((D, Q, C) — C is tiny) and each
+rescoring of the local candidates — feature gathers never cross shards
+(candidate rows are clipped into the shard's local range before the
+gather, so each vmap lane only indexes its own slice).  Only the (Q, C)
+scored results move: the replicated-layout constraint collects every
+shard's (logit, global_row) pairs ((D, Q, C) — C is tiny) and each
 device reduces them to the global top-C.  Communication is O(Q * C * D)
 while compute scales 1/D — the candidate matrix never materializes
 anywhere, matching the design target of SURVEY.md §5.7 (ring/allgather
@@ -21,12 +26,13 @@ configs[4]).
 
 IVF placement (ISSUE 9) follows the SNIPPETS.md pjit partition-rule
 pattern — shard the big per-row state, replicate the small lookup
-tables: the ``(nshards * K, B)`` cell-membership matrix of shard-LOCAL
-row ids is placed ``P(SHARD_AXIS)`` (each shard_map instance sees
-exactly its own (K, B) block) while the tiny (K, D) centroid matrix
-rides replicated ``P()``.  Every shard probes the same top-``nprobe``
-cells (the replicated stage-1 matmul is identical everywhere) and scans
-only its local members of those cells.
+tables (``parallel.sharded.PARTITION_RULES``): the ``(nshards * K, B)``
+cell-membership matrix of shard-LOCAL row ids is placed
+``P(SHARD_AXIS)`` (each shard lane sees exactly its own (K, B) block)
+while the tiny (K, D) centroid matrix rides replicated ``P()``.  Every
+shard probes the same top-``nprobe`` cells (the replicated stage-1
+matmul is identical everywhere) and scans only its local members of
+those cells.
 
 Because every shard keeps its own local top-C before the merge, the merged
 candidate pool is a superset of the single-device pool (which keeps a
@@ -36,29 +42,27 @@ reduce it — asserted by ``tests/test_ann_sharded.py``.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops import encoder as E
 from ..ops import ivf as IVF
 from ..ops import scoring as S
-from .sharded import SHARD_AXIS
+from .sharded import merge_topk, replicated, shard_offsets, shardwise
 
 
-def _local_rescore_merge(pair_logits, q_tree, qfeats, feats, emb_tree,
-                         top_sim, top_index, row_offset, min_logit, *,
-                         top_c: int, ndev: int):
-    """Shared tail of both sharded ANN programs: local exact rescoring of
-    the shard's retrieved candidates (gathers never cross shards), the
-    shared ``scoring.saturation_count`` predicate on the local count (a
-    local top-C whose int8 cutoff band holds quantization-ambiguous
-    candidates may have truncated a true candidate BEFORE the merge),
-    and the all_gather global top-C merge."""
+def _local_rescore(pair_logits, q_tree, qfeats, feats, emb_tree,
+                   top_sim, top_index, row_offset, min_logit, *,
+                   top_c: int):
+    """Per-shard tail of both sharded ANN programs (runs inside the vmap
+    lane): local exact rescoring of the shard's retrieved candidates
+    (gathers never cross shards), plus the shared
+    ``scoring.saturation_count`` predicate on the local count (a local
+    top-C whose int8 cutoff band holds quantization-ambiguous candidates
+    may have truncated a true candidate BEFORE the merge)."""
     retrieved = top_index >= 0
     local_rows = jnp.clip(top_index - row_offset, 0).reshape(-1)
     q = top_index.shape[0]
@@ -77,33 +81,28 @@ def _local_rescore_merge(pair_logits, q_tree, qfeats, feats, emb_tree,
         logits, top_sim, retrieved, min_logit,
         S.retrieval_amb_eps(q_tree, emb_tree),
     )
+    return logits, top_index, local_count
 
-    # merge: (D, Q, C) gathered over ICI, reduced to global top-C
-    all_logit = lax.all_gather(logits, SHARD_AXIS)
-    all_index = lax.all_gather(top_index, SHARD_AXIS)
-    merged_logit = jnp.transpose(all_logit, (1, 0, 2)).reshape(
-        q, ndev * top_c
-    )
-    merged_index = jnp.transpose(all_index, (1, 0, 2)).reshape(
-        q, ndev * top_c
-    )
-    out_logit, sel = lax.top_k(merged_logit, top_c)
-    out_index = jnp.take_along_axis(merged_index, sel, axis=1)
-    # escalation signal must see BOTH truncation modes: a shard whose
-    # local top-C saturated (may have dropped above-bound rows before
-    # the merge), and a merged pool with more above-bound rows than the
-    # merge keeps (indices are unique across shards, so counting the
-    # merged pool counts each candidate once)
+
+def _merge(mesh, logits, top_index, local_count, min_logit, *, top_c: int):
+    """Merge the vmapped (D, Q, C) per-shard results to the global top-C.
+
+    The escalation signal must see BOTH truncation modes: a shard whose
+    local top-C saturated (may have dropped above-bound rows before the
+    merge — the max over shards of ``local_count``, the old ``pmax``), and
+    a merged pool with more above-bound rows than the merge keeps (indices
+    are unique across shards, so counting the merged pool counts each
+    candidate once)."""
+    repl = replicated(mesh)
+    out_logit, out_index, merged_logit = merge_topk(mesh, logits, top_index, top_c)
     merged_above = (merged_logit > min_logit).sum(axis=1).astype(jnp.int32)
-    count_sat = jnp.maximum(
-        lax.pmax(local_count, SHARD_AXIS), merged_above
-    )
+    count_sat = jnp.maximum(repl(local_count.max(axis=0)), merged_above)
     return out_logit, out_index, count_sat
 
 
 def build_sharded_ann_scorer(
     plan,
-    mesh: Mesh,
+    mesh,
     *,
     chunk: int = 512,
     top_c: int = 64,
@@ -130,51 +129,46 @@ def build_sharded_ann_scorer(
     pair_logits = S.build_gathered_pair_logits(plan)
     ndev = mesh.size
 
-    corpus_spec = P(SHARD_AXIS)
-    repl = P()
-
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(repl, repl, corpus_spec, corpus_spec, corpus_spec,
-                  corpus_spec, repl, repl, repl),
-        out_specs=(repl, repl, repl),
-        # scan carries start replicated and become shard-varying when local
-        # corpus data folds in; skip the varying-manual-axes typecheck
-        check_vma=False,
-    )
     def score_shard(q_emb, qfeats, corpus_feats, corpus_valid,
                     corpus_deleted, corpus_group, query_group, query_row,
                     min_logit):
-        local_cap = corpus_valid.shape[0]
-        shard = lax.axis_index(SHARD_AXIS)
-        row_offset = shard.astype(jnp.int32) * jnp.int32(local_cap)
-
-        emb_tree = E.as_emb_tree(corpus_feats[E.ANN_PROP])
-        feats = {
-            prop: tensors for prop, tensors in corpus_feats.items()
-            if prop != E.ANN_PROP
-        }
-
-        # stage 1: local cosine top-C (global row ids via row_offset)
+        split = shardwise(mesh)
+        cf = jax.tree_util.tree_map(split, corpus_feats)
+        cv = split(corpus_valid)
+        cd = split(corpus_deleted)
+        cg = split(corpus_group)
+        local_cap = corpus_valid.shape[0] // ndev
+        offsets = shard_offsets(mesh, local_cap)
         q_tree = E.as_emb_tree(q_emb)
-        top_sim, top_index = E.retrieval_scan(
-            q_tree, emb_tree, corpus_valid, corpus_deleted,
-            corpus_group, query_group, query_row,
-            chunk=chunk, top_c=top_c, group_filtering=group_filtering,
-            row_offset=row_offset,
-        )
-        return _local_rescore_merge(
-            pair_logits, q_tree, qfeats, feats, emb_tree, top_sim,
-            top_index, row_offset, min_logit, top_c=top_c, ndev=ndev,
-        )
+
+        def one_shard(cf, cv, cd, cg, row_offset):
+            emb_tree = E.as_emb_tree(cf[E.ANN_PROP])
+            feats = {
+                prop: tensors for prop, tensors in cf.items()
+                if prop != E.ANN_PROP
+            }
+            # stage 1: local cosine top-C (global row ids via row_offset)
+            top_sim, top_index = E.retrieval_scan(
+                q_tree, emb_tree, cv, cd, cg, query_group, query_row,
+                chunk=chunk, top_c=top_c, group_filtering=group_filtering,
+                row_offset=row_offset,
+            )
+            return _local_rescore(
+                pair_logits, q_tree, qfeats, feats, emb_tree, top_sim,
+                top_index, row_offset, min_logit, top_c=top_c,
+            )
+
+        logits, top_index, local_count = jax.vmap(one_shard)(
+            cf, cv, cd, cg, offsets)
+        return _merge(mesh, logits, top_index, local_count, min_logit,
+                      top_c=top_c)
 
     return jax.jit(score_shard)
 
 
 def build_sharded_ivf_scorer(
     plan,
-    mesh: Mesh,
+    mesh,
     *,
     top_c: int = 64,
     nprobe: int = 8,
@@ -190,46 +184,46 @@ def build_sharded_ivf_scorer(
 
     ``centroids`` ride replicated; ``cell_rows`` is the stacked
     ``(mesh.size * K, B)`` shard-LOCAL membership matrix placed
-    ``P(SHARD_AXIS)`` so each shard_map instance sees its own (K, B)
-    block (``ops.ivf.IvfState`` builds exactly this layout).
+    ``P(SHARD_AXIS)`` so each shard lane sees its own (K, B) block
+    (``ops.ivf.IvfState`` builds exactly this layout).
     """
     pair_logits = S.build_gathered_pair_logits(plan)
     ndev = mesh.size
     slot_chunk = IVF.scan_slots()
 
-    corpus_spec = P(SHARD_AXIS)
-    repl = P()
-
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(repl, repl, corpus_spec, repl, corpus_spec, corpus_spec,
-                  corpus_spec, corpus_spec, repl, repl, repl),
-        out_specs=(repl, repl, repl),
-        check_vma=False,
-    )
     def score_shard(q_emb, qfeats, corpus_feats, centroids, cell_rows,
                     corpus_valid, corpus_deleted, corpus_group, query_group,
                     query_row, min_logit):
-        local_cap = corpus_valid.shape[0]
-        shard = lax.axis_index(SHARD_AXIS)
-        row_offset = shard.astype(jnp.int32) * jnp.int32(local_cap)
-
-        emb_tree = E.as_emb_tree(corpus_feats[E.ANN_PROP])
-        feats = {
-            prop: tensors for prop, tensors in corpus_feats.items()
-            if prop != E.ANN_PROP
-        }
+        split = shardwise(mesh)
+        cf = jax.tree_util.tree_map(split, corpus_feats)
+        cv = split(corpus_valid)
+        cd = split(corpus_deleted)
+        cg = split(corpus_group)
+        rows = split(cell_rows)
+        local_cap = corpus_valid.shape[0] // ndev
+        offsets = shard_offsets(mesh, local_cap)
         q_tree = E.as_emb_tree(q_emb)
-        top_sim, top_index = IVF.ivf_probe_topc(
-            q_tree, emb_tree, centroids, cell_rows,
-            corpus_valid, corpus_deleted, corpus_group, query_group,
-            query_row, top_c=top_c, nprobe=nprobe, slot_chunk=slot_chunk,
-            group_filtering=group_filtering, row_offset=row_offset,
-        )
-        return _local_rescore_merge(
-            pair_logits, q_tree, qfeats, feats, emb_tree, top_sim,
-            top_index, row_offset, min_logit, top_c=top_c, ndev=ndev,
-        )
+
+        def one_shard(cf, rows, cv, cd, cg, row_offset):
+            emb_tree = E.as_emb_tree(cf[E.ANN_PROP])
+            feats = {
+                prop: tensors for prop, tensors in cf.items()
+                if prop != E.ANN_PROP
+            }
+            top_sim, top_index = IVF.ivf_probe_topc(
+                q_tree, emb_tree, centroids, rows, cv, cd, cg,
+                query_group, query_row, top_c=top_c, nprobe=nprobe,
+                slot_chunk=slot_chunk, group_filtering=group_filtering,
+                row_offset=row_offset,
+            )
+            return _local_rescore(
+                pair_logits, q_tree, qfeats, feats, emb_tree, top_sim,
+                top_index, row_offset, min_logit, top_c=top_c,
+            )
+
+        logits, top_index, local_count = jax.vmap(one_shard)(
+            cf, rows, cv, cd, cg, offsets)
+        return _merge(mesh, logits, top_index, local_count, min_logit,
+                      top_c=top_c)
 
     return jax.jit(score_shard)

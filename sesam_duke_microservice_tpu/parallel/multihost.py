@@ -73,6 +73,7 @@ def initialize(coordinator_address: Optional[str] = None,
             "MEGASCALE_COORDINATOR_ADDRESS",
         )):
             try:
+                _enable_cpu_collectives()
                 jax.distributed.initialize()
                 logger.info(
                     "joined auto-detected distributed job: process %d/%d",
@@ -113,6 +114,7 @@ def initialize(coordinator_address: Optional[str] = None,
     if process_id is not None:
         kwargs["process_id"] = process_id
     logger.info("jax.distributed.initialize(%s)", kwargs)
+    _enable_cpu_collectives()
     jax.distributed.initialize(**kwargs)
     logger.info(
         "joined distributed job: process %d/%d, %d local / %d global devices",
@@ -125,6 +127,24 @@ def initialize(coordinator_address: Optional[str] = None,
 def _int_env(name: str) -> Optional[int]:
     raw = env_str(name)
     return int(raw) if raw and raw.isdigit() else None
+
+
+def _enable_cpu_collectives() -> None:
+    """XLA:CPU only runs cross-process programs through an explicit
+    collectives layer; without one every multi-process computation —
+    including ``device_put`` onto a global-mesh sharding — fails with
+    "Multiprocess computations aren't implemented on the CPU backend".
+    Select gloo before the distributed client comes up (the backend
+    captures the option at client init).  TPU/GPU backends ignore it, so
+    this is safe to set unconditionally for any distributed job."""
+    import jax
+
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception as exc:  # pragma: no cover - jaxlib built without gloo
+        logger.warning(
+            "CPU collectives backend unavailable (%s); multi-process CPU "
+            "meshes will not run", exc)
 
 
 def global_corpus_mesh():

@@ -1,19 +1,24 @@
-"""Ring-parallel corpus scoring: rotating query blocks over ppermute.
+"""Ring-parallel corpus scoring: rotating query blocks around the mesh.
 
 The ring-attention pattern applied to this workload's scaling axis
 (SURVEY.md section 5.7 — "ring-structured pass of query blocks around the
 mesh").  Where ``parallel.sharded`` replicates the whole query block to
-every device and merges per-shard top-Ks with one ``all_gather``, the ring
+every device and merges per-shard top-Ks with one all-gather, the ring
 scorer shards BOTH axes:
 
   * corpus feature tensors: record-axis sharded (as in parallel.sharded);
   * query block: ALSO sharded — each device starts with Q/D queries;
   * D ring steps: every device scores its resident query block against its
     local corpus shard, threading the block's accumulated global top-K
-    through the scan (``ops.scoring.scan_topk(init=...)``), then
-    ``ppermute``s the block + its carry to the next device.  After D hops
-    each block has visited every shard and is back home with its global
-    top-K — no all_gather, no replication.
+    through the scan (``ops.scoring.scan_topk(init=...)``), then rotates
+    the block + its carry to the next device.  After D hops each block has
+    visited every shard and is back home with its global top-K — no
+    all_gather, no replication.
+
+The rotation is expressed as ``jnp.roll(..., 1, axis=0)`` over the pinned
+shard axis of a ``jit`` program — the partitioner lowers a roll of a
+shard-axis-sharded array to the neighbor-to-neighbor collective-permute
+the old hand-written ``ppermute`` spelled out.
 
 Communication per step is O((Q/D) * (features + K)) point-to-point over
 ICI — independent of corpus size and of D — while per-device compute and
@@ -31,7 +36,6 @@ single-device scan uses — results equal the single-device scorer
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable
 
 import numpy as np
@@ -39,10 +43,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..ops import scoring as S
-from .sharded import SHARD_AXIS, LeadingAxisPlacer
+from .sharded import (LeadingAxisPlacer, rule_sharding, shard_offsets,
+                      shardwise)
 
 
 def build_ring_scorer(
@@ -63,45 +68,46 @@ def build_ring_scorer(
     """
     pair_logits = S.build_pair_logits(plan)
     ndev = mesh.size
-    perm = [(i, (i + 1) % ndev) for i in range(ndev)]
 
-    shard_spec = P(SHARD_AXIS)
-    repl = P()
+    def pin(a):
+        return lax.with_sharding_constraint(
+            a, rule_sharding(mesh, "corpus", a.ndim))
 
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(shard_spec, shard_spec, shard_spec, shard_spec,
-                  shard_spec, shard_spec, shard_spec, repl),
-        out_specs=(shard_spec, shard_spec, shard_spec),
-        check_vma=False,
-    )
+    def rotate(a):
+        # roll over the pinned shard axis == collective-permute
+        # [(i, (i + 1) % D)]: device i+1 receives device i's block
+        return pin(jnp.roll(a, 1, axis=0))
+
     def score_ring(qfeats, corpus_feats, corpus_valid, corpus_deleted,
                    corpus_group, query_group, query_row, min_logit):
-        local_cap = corpus_valid.shape[0]
-        shard = lax.axis_index(SHARD_AXIS)
-        row_offset = shard.astype(jnp.int32) * jnp.int32(local_cap)
+        split = shardwise(mesh)
+        cf = jax.tree_util.tree_map(split, corpus_feats)
+        cv = split(corpus_valid)
+        cd = split(corpus_deleted)
+        cg = split(corpus_group)
+        qf = jax.tree_util.tree_map(split, qfeats)
+        qg = split(query_group)
+        qr = split(query_row)
+        local_cap = corpus_valid.shape[0] // ndev
+        offsets = shard_offsets(mesh, local_cap)
 
-        first = next(iter(qfeats.values()))
-        qlocal = first["valid"].shape[0]
-        carry_logit = jnp.full((qlocal, top_k), S.NEG_INF, jnp.float32)
-        carry_index = jnp.full((qlocal, top_k), -1, jnp.int32)
-        carry_count = jnp.zeros((qlocal,), jnp.int32)
+        qlocal = query_group.shape[0] // ndev
+        tl = pin(jnp.full((ndev, qlocal, top_k), S.NEG_INF, jnp.float32))
+        ti = pin(jnp.full((ndev, qlocal, top_k), -1, jnp.int32))
+        cnt = pin(jnp.zeros((ndev, qlocal), jnp.int32))
 
-        def rotate(a):
-            return lax.ppermute(a, SHARD_AXIS, perm)
-
-        qf, qg, qr = qfeats, query_group, query_row
-        tl, ti, cnt = carry_logit, carry_index, carry_count
-        # D is small and static: unroll the ring so each step's ppermute
-        # can overlap the next step's compute under XLA's scheduler
-        for step in range(ndev):
-            tl, ti, cnt = S.scan_topk(
-                pair_logits, qf, corpus_feats, corpus_valid,
-                corpus_deleted, corpus_group, qg, qr, min_logit,
+        def one_shard(cf, cv, cd, cg, row_offset, qf, qg, qr, tl, ti, cnt):
+            return S.scan_topk(
+                pair_logits, qf, cf, cv, cd, cg, qg, qr, min_logit,
                 chunk=chunk, top_k=top_k, group_filtering=group_filtering,
                 row_offset=row_offset, init=(tl, ti, cnt),
             )
+
+        # D is small and static: unroll the ring so each step's rotation
+        # can overlap the next step's compute under XLA's scheduler
+        for step in range(ndev):
+            tl, ti, cnt = jax.vmap(one_shard)(
+                cf, cv, cd, cg, offsets, qf, qg, qr, tl, ti, cnt)
             if step + 1 < ndev:
                 qf = jax.tree_util.tree_map(rotate, qf)
                 qg, qr = rotate(qg), rotate(qr)
@@ -109,7 +115,11 @@ def build_ring_scorer(
             # block's top-K home); the query payload — the big per-hop
             # transfer — skips the final dead rotation
             tl, ti, cnt = rotate(tl), rotate(ti), rotate(cnt)
-        return tl, ti, cnt
+
+        def unsplit(a):
+            return pin(jnp.reshape(a, (-1,) + a.shape[2:]))
+
+        return unsplit(tl), unsplit(ti), unsplit(cnt)
 
     return jax.jit(score_ring)
 

@@ -1,4 +1,4 @@
-"""Sharded corpus scoring: shard_map over a device mesh.
+"""Sharded corpus scoring: constraint-driven GSPMD over a device mesh.
 
 Data layout (the scaling-book recipe — pick a mesh, annotate shardings, let
 XLA insert collectives):
@@ -7,9 +7,19 @@ XLA insert collectives):
     ``"shard"`` — each device holds ``capacity / n_devices`` rows in HBM;
   * query block: replicated — every device scores the same queries against
     its local rows (no query-side communication at all);
-  * merge: each device's local top-K is ``all_gather``ed over ICI
-    ((D, Q, K) — K is tiny, so the collective moves Q*K*D*8 bytes, not the
-    candidate matrix) and reduced to the global top-K on every device.
+  * merge: each device's local top-K is constrained back to replicated
+    layout ((D, Q, K) — K is tiny, so the all-gather XLA inserts moves
+    Q*K*D*8 bytes over ICI, not the candidate matrix) and reduced to the
+    global top-K on every device.
+
+The program is a plain ``jit`` over ``NamedSharding``-placed inputs: the
+per-shard scan is expressed as ``vmap`` over a leading shard axis pinned to
+the mesh with ``with_sharding_constraint`` and the merge as a constraint to
+replicated layout, so the partitioner — not a hand-written ``shard_map``
+closure — chooses the collectives.  The partition rules per tensor family
+live in ``PARTITION_RULES`` and are shared by the placement helpers here,
+the in-program constraints, and the IVF placers in
+``engine/sharded_matcher.py``.
 
 This scales the O(Q x N) pair-scoring work linearly in device count while
 the communication stays O(Q x K x D): the framework's counterpart of
@@ -23,7 +33,6 @@ rows 16-17); parity obligations stop at "same results as one device", which
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable, Dict, Optional, Sequence
 
 import numpy as np
@@ -37,12 +46,81 @@ from ..ops import scoring as S
 
 SHARD_AXIS = "shard"
 
+# Partition-rule table: tensor family -> leading-axis PartitionSpec maker.
+# Record-carrying families shard their leading axis over the mesh; query-side
+# and centroid tensors replicate.  Everything that places or constrains a
+# tensor (LeadingAxisPlacer, the in-program constraints below, the IVF
+# placers in engine/sharded_matcher.py) goes through this table so the
+# layout contract lives in exactly one place.
+PARTITION_RULES: Dict[str, Callable[[int], P]] = {
+    # corpus feature tensors / embedding codes / int8 scales: record axis
+    "corpus": lambda ndim: P(SHARD_AXIS, *([None] * (ndim - 1))),
+    # IVF cell membership (stacked shard-local row-id matrix): record axis
+    "ivf_membership": lambda ndim: P(SHARD_AXIS, *([None] * (ndim - 1))),
+    # query block, thresholds, masks-of-queries: replicated
+    "queries": lambda ndim: P(),
+    # IVF centroids (and any other model-side small tensors): replicated
+    "centroids": lambda ndim: P(),
+}
+
+
+def rule_sharding(mesh: Mesh, family: str, ndim: int) -> NamedSharding:
+    """NamedSharding for ``family`` (a PARTITION_RULES key) at ``ndim``."""
+    return NamedSharding(mesh, PARTITION_RULES[family](ndim))
+
 
 def corpus_mesh(devices: Optional[Sequence] = None) -> Mesh:
     """A 1-D mesh over all (or the given) devices; the single sharding axis
     carries the corpus record dimension."""
     devices = list(devices) if devices is not None else jax.devices()
     return Mesh(np.array(devices), (SHARD_AXIS,))
+
+
+def shardwise(mesh: Mesh):
+    """(cap, ...) -> (ndev, cap/ndev, ...) with the leading axis pinned to
+    the mesh.  The flat array is already record-axis sharded with a
+    shard-granule-aligned capacity, so the reshape moves no data — it just
+    exposes the shard axis for ``vmap``."""
+    ndev = mesh.size
+
+    def split(a):
+        local = a.shape[0] // ndev
+        r = jnp.reshape(a, (ndev, local) + a.shape[1:])
+        return lax.with_sharding_constraint(
+            r, NamedSharding(mesh, PARTITION_RULES["corpus"](r.ndim)))
+
+    return split
+
+
+def replicated(mesh: Mesh):
+    """Constrain to replicated layout; XLA inserts the all-gather."""
+    def repl(a):
+        return lax.with_sharding_constraint(a, NamedSharding(mesh, P()))
+
+    return repl
+
+
+def shard_offsets(mesh: Mesh, local_cap) -> jnp.ndarray:
+    """Per-shard global row offset, one element resident per device."""
+    offs = jnp.arange(mesh.size, dtype=jnp.int32) * jnp.int32(local_cap)
+    return lax.with_sharding_constraint(
+        offs, NamedSharding(mesh, P(SHARD_AXIS)))
+
+
+def merge_topk(mesh: Mesh, top_logit, top_index, top_k: int):
+    """Reduce per-shard (D, Q, K) candidates to the global top-K.
+
+    The transpose/reshape ordering (shard 0's K entries first, then shard
+    1's, ...) matches the historical all_gather merge, so ``lax.top_k``'s
+    stable tie-breaking-by-position yields the same winners.
+    """
+    repl = replicated(mesh)
+    ndev, q = top_logit.shape[0], top_logit.shape[1]
+    merged_logit = repl(jnp.transpose(top_logit, (1, 0, 2)).reshape(q, ndev * top_k))
+    merged_index = repl(jnp.transpose(top_index, (1, 0, 2)).reshape(q, ndev * top_k))
+    out_logit, sel = lax.top_k(merged_logit, top_k)
+    out_index = jnp.take_along_axis(merged_index, sel, axis=1)
+    return out_logit, out_index, merged_logit
 
 
 def build_sharded_scorer(
@@ -63,45 +141,53 @@ def build_sharded_scorer(
     pair_logits = S.build_pair_logits(plan)
     ndev = mesh.size
 
-    corpus_spec = P(SHARD_AXIS)
-    repl = P()
-
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(repl, corpus_spec, corpus_spec, corpus_spec, corpus_spec,
-                  repl, repl, repl),
-        out_specs=(repl, repl, repl),
-        # the scan carry starts from replicated zeros but becomes
-        # shard-varying once per-shard corpus data folds in; skip the
-        # varying-manual-axes typecheck rather than pcast every init
-        check_vma=False,
-    )
     def score_shard(qfeats, corpus_feats, corpus_valid, corpus_deleted,
                     corpus_group, query_group, query_row, min_logit):
-        local_cap = corpus_valid.shape[0]
-        shard = lax.axis_index(SHARD_AXIS)
-        row_offset = shard.astype(jnp.int32) * jnp.int32(local_cap)
+        split = shardwise(mesh)
+        repl = replicated(mesh)
+        cf = jax.tree_util.tree_map(split, corpus_feats)
+        cv = split(corpus_valid)
+        cd = split(corpus_deleted)
+        cg = split(corpus_group)
+        local_cap = corpus_valid.shape[0] // ndev
+        offsets = shard_offsets(mesh, local_cap)
 
-        top_logit, top_index, count = S.scan_topk(
-            pair_logits, qfeats, corpus_feats, corpus_valid, corpus_deleted,
-            corpus_group, query_group, query_row, min_logit,
-            chunk=chunk, top_k=top_k, group_filtering=group_filtering,
-            row_offset=row_offset,
-        )
+        def one_shard(cf, cv, cd, cg, row_offset):
+            return S.scan_topk(
+                pair_logits, qfeats, cf, cv, cd, cg,
+                query_group, query_row, min_logit,
+                chunk=chunk, top_k=top_k, group_filtering=group_filtering,
+                row_offset=row_offset,
+            )
 
-        # merge: (D, Q, K) gathered over ICI, reduced to global top-K
-        all_logit = lax.all_gather(top_logit, SHARD_AXIS)   # (D, Q, K)
-        all_index = lax.all_gather(top_index, SHARD_AXIS)
-        q = top_logit.shape[0]
-        merged_logit = jnp.transpose(all_logit, (1, 0, 2)).reshape(q, ndev * top_k)
-        merged_index = jnp.transpose(all_index, (1, 0, 2)).reshape(q, ndev * top_k)
-        out_logit, sel = lax.top_k(merged_logit, top_k)
-        out_index = jnp.take_along_axis(merged_index, sel, axis=1)
-        total_count = lax.psum(count, SHARD_AXIS)
+        top_logit, top_index, count = jax.vmap(one_shard)(cf, cv, cd, cg, offsets)
+        out_logit, out_index, _ = merge_topk(mesh, top_logit, top_index, top_k)
+        total_count = repl(count.sum(axis=0))
         return out_logit, out_index, total_count
 
     return jax.jit(score_shard)
+
+
+def build_replicated_gather(mesh: Mesh) -> Callable:
+    """Gather corpus rows from record-axis-sharded tensors into a compact
+    replicated layout.
+
+    ``rows`` is a flat vector of global (non-negative) row ids; the result
+    tree holds ``(len(rows), ...)`` arrays constrained to replicated layout,
+    so XLA inserts the cross-shard gather and every device ends up with the
+    full survivor block.  This is the bridge that lets the sharded backends
+    reuse the single-device ``build_dd_rescorer`` program bit-identically:
+    gather the resolved block's (Q, K) survivors here, then rescore with an
+    identity ``top_index``.
+    """
+    repl = replicated(mesh)
+
+    @jax.jit
+    def gather(cfeats, rows):
+        return jax.tree_util.tree_map(
+            lambda a: repl(jnp.take(a, rows, axis=0)), cfeats)
+
+    return gather
 
 
 class LeadingAxisPlacer:
@@ -110,7 +196,8 @@ class LeadingAxisPlacer:
 
     Base for ``ShardedCorpus`` (record axis, granule = mesh.size * chunk)
     and ``parallel.ring.RingQueryPlacer`` (query axis, granule =
-    mesh.size) — one copy of the padding/sharding conventions.
+    mesh.size) — one copy of the padding/sharding conventions.  The
+    shardings come from ``PARTITION_RULES["corpus"]``.
     """
 
     def __init__(self, mesh: Mesh, granule: int):
@@ -124,8 +211,7 @@ class LeadingAxisPlacer:
 
     def _sharding(self, ndim: int) -> NamedSharding:
         if ndim not in self._sharding_cache:
-            spec = P(SHARD_AXIS, *([None] * (ndim - 1)))
-            self._sharding_cache[ndim] = NamedSharding(self.mesh, spec)
+            self._sharding_cache[ndim] = rule_sharding(self.mesh, "corpus", ndim)
         return self._sharding_cache[ndim]
 
     def _put(self, arr: np.ndarray, size: int, cap: int, fill=0):

@@ -214,6 +214,9 @@ def make_app_collector(app):
         similarity_samples = []
         cost_samples = []
         hbm_samples = []
+        mesh_dd_gather_samples = []
+        mesh_dd_row_samples = []
+        mesh_aot_samples = []
         for kind, name, wl in _workload_iter(app):
             labels = (("kind", kind), ("workload", name))
             proc = wl.processor
@@ -350,6 +353,21 @@ def make_app_collector(app):
                     ("", labels, getattr(cache, "_warm_compiled", 0)))
                 warm_seconds_samples.append(
                     ("", labels, getattr(cache, "_warm_seconds", 0.0)))
+                mesh = getattr(wl.index, "mesh", None)
+                if mesh is not None and mesh.size:
+                    # sharded mesh backend (ISSUE 18): single-writer
+                    # plain-int counters on the scorer cache, snapshotted
+                    # here at scrape time — the scoring path never writes
+                    # a registry child
+                    mesh_dd_gather_samples.append(
+                        ("", labels,
+                         float(getattr(cache, "_dd_gathers", 0))))
+                    mesh_dd_row_samples.append(
+                        ("", labels,
+                         float(getattr(cache, "_dd_gather_rows", 0))))
+                    mesh_aot_samples.append(
+                        ("", labels,
+                         float(len(getattr(cache, "_aot", ()) or ()))))
 
         # ingest-scheduler families (ISSUE 6): scrape-time snapshots of
         # the scheduler's single-writer tenant-queue counters — the
@@ -499,6 +517,21 @@ def make_app_collector(app):
                 "duke_corpus_capacity_rows_per_shard", "gauge",
                 "Per-shard slice of the corpus capacity (sharded "
                 "backends)", shard_samples))
+        if mesh_dd_gather_samples:
+            out.append(FamilySnapshot(
+                "duke_mesh_dd_gathers_total", "counter",
+                "Replicated dd survivor gathers run on the mesh — the "
+                "collective that lets a fully-addressable sharded "
+                "backend certify finalize verdicts on device",
+                mesh_dd_gather_samples))
+            out.append(FamilySnapshot(
+                "duke_mesh_dd_gather_rows_total", "counter",
+                "Survivor rows moved by dd gathers (queries x top_k "
+                "per gather)", mesh_dd_row_samples))
+            out.append(FamilySnapshot(
+                "duke_mesh_aot_executables", "gauge",
+                "Mesh-partitioned AOT executables resident in the "
+                "sharded scorer cache", mesh_aot_samples))
         if warm_samples:
             out.append(FamilySnapshot(
                 "duke_prewarm_compiles", "gauge",

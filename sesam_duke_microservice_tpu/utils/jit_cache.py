@@ -167,6 +167,30 @@ def environment_fingerprint() -> dict:
     }
 
 
+def mesh_fingerprint(mesh) -> dict:
+    """The mesh facets a sharded executable is only valid under: device
+    grid shape and axis names.  These join the store KEY (not the env
+    fingerprint — single-device and mesh workloads share a process, and
+    ``device_count`` alone cannot distinguish a 4-way from an 8-way mesh
+    on the same 8-device host), so an executable partitioned for one
+    topology is unreachable from any other.
+
+    In a multi-process job the facets also carry this controller's
+    process coordinates: every process of the job shares the store
+    directory and derives otherwise-identical keys, but a serialized
+    executable embeds the saving process's device assignment — process 0
+    must never deserialize process 1's artifact."""
+    import jax
+
+    doc = {
+        "shape": [int(s) for s in mesh.devices.shape],
+        "axes": [str(a) for a in mesh.axis_names],
+    }
+    if jax.process_count() > 1:
+        doc["proc"] = [jax.process_index(), jax.process_count()]
+    return doc
+
+
 class AotStore:
     """On-disk store of serialized compiled executables.
 

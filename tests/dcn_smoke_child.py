@@ -61,23 +61,23 @@ def main() -> None:
 
     arr = jax.make_array_from_callback((4, 8), sharding, local_block)
 
+    repl = NamedSharding(mesh, P())
+
     @jax.jit
     def collect(x):
-        def body(x):
-            total = jax.lax.psum(x, SHARD_AXIS)
-            gathered = jax.lax.all_gather(x, SHARD_AXIS)
-            return total, gathered
-
-        return jax.shard_map(
-            body, mesh=mesh, in_specs=P(SHARD_AXIS),
-            out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
-        )(x)
+        # constraint-driven collectives (the corpus merge recipe): a
+        # replicated shard-axis reduction lowers to the psum, a
+        # replicated constraint on the sharded array to the all_gather —
+        # here both cross the process boundary (DCN)
+        total = jax.lax.with_sharding_constraint(x.sum(axis=0), repl)
+        gathered = jax.lax.with_sharding_constraint(x, repl)
+        return total, gathered
 
     total, gathered = collect(arr)
-    local_total = np.asarray(
-        [s.data for s in total.addressable_shards][0]
-    )
-    assert float(local_total[0, 0]) == 0.0 + 1.0 + 2.0 + 3.0, local_total
+    # replicated outputs are addressable on every process
+    local_total = np.asarray(total)
+    assert float(local_total[0]) == 0.0 + 1.0 + 2.0 + 3.0, local_total
+    assert np.asarray(gathered).shape == (4, 8)
 
     # (b) the real sharded scorer over a cross-process record axis
     from sesam_duke_microservice_tpu.core import comparators as C

@@ -1,0 +1,78 @@
+"""Child process for the MESH AOT restart differential (tests/test_mesh_aot.py).
+
+The sharded counterpart of aot_restart_child.py: builds a
+``ShardedDeviceIndex`` over the virtual 8-device mesh, ingests a
+deterministic corpus, waits for the warm thread (every mesh ladder entry
+compiled AND serialized), and prints one JSON line with the
+compile/load counters plus the full event stream — the parent asserts
+the SECOND process deserializes the whole mesh ladder and compiles ZERO
+scorers while producing an identical stream.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--records", type=int, default=40)
+    args = ap.parse_args()
+
+    from test_device_matcher import EventLog, dedup_schema, random_records
+
+    from sesam_duke_microservice_tpu import telemetry
+    from sesam_duke_microservice_tpu.engine.sharded_matcher import (
+        ShardedDeviceIndex,
+        ShardedDeviceProcessor,
+    )
+
+    schema = dedup_schema()
+    index = ShardedDeviceIndex(schema)
+    processor = ShardedDeviceProcessor(schema, index, group_filtering=False)
+    log = EventLog()
+    processor.add_match_listener(log)
+    records = random_records(args.records, seed=3)
+    t0 = time.monotonic()
+    processor.deduplicate(records)
+    first_batch_s = time.monotonic() - t0
+    # the acceptance counter is read BEFORE waiting on the warm thread:
+    # "zero scorer compiles before serving its first scoring batch"
+    compiles_at_first_batch = telemetry.JIT_COMPILES.single().value
+    cache = index.scorer_cache
+    t = cache._warm_thread
+    if t is not None:
+        t.join(timeout=600)
+    print("RESULT " + json.dumps({
+        "jit_compiles_at_first_batch": compiles_at_first_batch,
+        "jit_compiles": telemetry.JIT_COMPILES.single().value,
+        "jit_cache_hits": telemetry.JIT_CACHE_HITS.single().value,
+        "aot_loaded": cache._aot_loaded,
+        "warm_compiled": cache._warm_compiled,
+        "warm_seconds": cache._warm_seconds,
+        "first_batch_seconds": first_batch_s,
+        "mesh_devices": index.mesh.size,
+        "supports_dd": bool(cache.supports_dd),
+        "dd_gathers": cache._dd_gathers,
+        "events": log.events,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,195 @@
+"""Sharded AOT executable store (ISSUE 18).
+
+The mesh caches keep ``supports_aot`` on: ladder executables are lowered
+against mesh-annotated avals (``parallel.sharded.PARTITION_RULES``),
+serialized through the same validate-on-save ``AotStore``, and keyed by
+the mesh facets — so a restart of a mesh replica deserializes the whole
+sharded ladder and compiles ZERO scorers (the cold-start acceptance of
+ISSUE 15, extended to the sharded backends), while an executable
+partitioned for one topology is unreachable from any other.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from sesam_duke_microservice_tpu.utils.jit_cache import (
+    AotStore,
+    mesh_fingerprint,
+)
+
+CHILD = os.path.join(os.path.dirname(__file__), "mesh_restart_child.py")
+
+
+def _run_child(aot_dir, xla_dir, *, prewarm="1", aot="1"):
+    env = dict(os.environ)
+    env.update({
+        "DEVICE_CHUNK": "64",
+        # one bucket and the from_rows-free mesh ladder keep the cold
+        # arm at 2 entries (2 caps x 1 bucket x 1 variant) on the slow
+        # CPU backend
+        "DEVICE_QUERY_BUCKETS": "8",
+        "DEVICE_TOP_K": "16",
+        "DEVICE_MAX_CHARS": "24",
+        "DEVICE_MAX_GRAMS": "24",
+        "DEVICE_PREWARM": prewarm,
+        "DUKE_AOT": aot,
+        "DUKE_AOT_DIR": str(aot_dir),
+        "JAX_COMPILATION_CACHE_DIR": str(xla_dir),
+        "DUKE_JIT_CACHE_MIN_SECS": "0",
+    })
+    proc = subprocess.run(
+        [sys.executable, CHILD], capture_output=True, text=True,
+        timeout=600, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("RESULT ")][0]
+    return json.loads(line[len("RESULT "):])
+
+
+def test_mesh_restart_compiles_zero_scorers(tmp_path):
+    """THE sharded cold-start differential: process 1 compiles +
+    serializes the mesh ladder; process 2 deserializes everything — zero
+    compiles through its first scoring batch — with the event stream
+    bit-identical."""
+    aot_dir, xla_dir = tmp_path / "aot", tmp_path / "xla"
+    cold = _run_child(aot_dir, xla_dir)
+    assert cold["mesh_devices"] == 8, cold
+    assert cold["supports_dd"] is True, cold
+    assert cold["warm_compiled"] == 2, cold  # 2 caps x 1 bucket x 1 variant
+    assert cold["jit_compiles"] >= 2
+    saved = list(aot_dir.glob("*.aotx"))
+    assert len(saved) == 2, saved
+
+    warm = _run_child(aot_dir, xla_dir)
+    assert warm["jit_compiles_at_first_batch"] == 0, warm
+    assert warm["jit_compiles"] == 0, warm  # no miss-fill ran either
+    assert warm["aot_loaded"] == 2
+    assert warm["warm_compiled"] == 0
+    # the scoring outcome is the same mesh program: bit-identical events
+    assert warm["events"] == cold["events"]
+    assert warm["jit_cache_hits"] >= 1
+
+
+def test_mesh_aot_off_leg_still_serves(tmp_path):
+    """DUKE_AOT=0 pins the legacy jit-only mesh path: nothing saved,
+    restart compiles again, events unchanged."""
+    aot_dir, xla_dir = tmp_path / "aot", tmp_path / "xla"
+    cold = _run_child(aot_dir, xla_dir)
+    off = _run_child(aot_dir, xla_dir, aot="0")
+    assert off["aot_loaded"] == 0
+    assert off["jit_compiles"] > 0
+    assert off["events"] == cold["events"]
+
+
+def _mesh(n):
+    import jax
+
+    from sesam_duke_microservice_tpu.parallel.sharded import corpus_mesh
+
+    return corpus_mesh(jax.devices()[:n])
+
+
+def test_mesh_executable_roundtrip_validate_on_save(tmp_path, monkeypatch):
+    """Save/load round-trip of a REAL mesh-partitioned executable: the
+    deserialized program executes sharded inputs and reproduces the
+    compiled output (including the collective the replicated constraint
+    inserts)."""
+    import jax
+    import jax.numpy as jnp
+
+    from sesam_duke_microservice_tpu.parallel.sharded import rule_sharding
+
+    monkeypatch.setenv("DUKE_AOT_DIR", str(tmp_path / "store"))
+    mesh = _mesh(8)
+    corpus_sh = rule_sharding(mesh, "corpus", 2)
+    repl = rule_sharding(mesh, "queries", 1)
+
+    @jax.jit
+    def fn(x):
+        return jax.lax.with_sharding_constraint((x * 2.0).sum(axis=1), repl)
+
+    aval = jax.ShapeDtypeStruct((16, 4), jnp.float32, sharding=corpus_sh)
+    compiled = fn.lower(aval).compile()
+
+    store = AotStore()
+    key = {"builder": "mesh-test", "cap": 16,
+           "mesh": mesh_fingerprint(mesh)}
+    assert store.save(key, compiled) is True
+    loaded = store.load(key)
+    assert loaded is not None
+    x = jax.device_put(
+        np.arange(64, dtype=np.float32).reshape(16, 4), corpus_sh)
+    np.testing.assert_array_equal(np.asarray(loaded(x)),
+                                  np.asarray(compiled(x)))
+
+
+def test_mesh_save_reject_path_is_loud(tmp_path, monkeypatch, caplog):
+    """Validate-on-save: when the PJRT layer cannot round-trip a mesh
+    executable, save() refuses (False), persists NOTHING, and logs — the
+    warm thread then counts a prewarm miss instead of planting an entry
+    every restart would reject."""
+    import logging
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import serialize_executable as se
+
+    from sesam_duke_microservice_tpu.parallel.sharded import rule_sharding
+
+    monkeypatch.setenv("DUKE_AOT_DIR", str(tmp_path / "store"))
+    mesh = _mesh(8)
+    corpus_sh = rule_sharding(mesh, "corpus", 1)
+    fn = jax.jit(lambda x: x * 2.0)
+    compiled = fn.lower(
+        jax.ShapeDtypeStruct((16,), jnp.float32, sharding=corpus_sh)
+    ).compile()
+
+    def broken(*a, **k):
+        raise RuntimeError("Symbols not found: mesh executable thin")
+
+    monkeypatch.setattr(se, "deserialize_and_load", broken)
+    store = AotStore()
+    key = {"builder": "mesh-test", "mesh": mesh_fingerprint(mesh)}
+    with caplog.at_level(logging.WARNING, logger="jit-cache"):
+        assert store.save(key, compiled) is False
+    assert not os.path.exists(store._path(key))
+    assert any("save failed" in r.message for r in caplog.records)
+
+
+def test_mesh_shape_keys_isolate(tmp_path, monkeypatch):
+    """A 4-way entry is unreachable from an 8-way mesh (and vice versa)
+    even though the environment fingerprint — same host, same 8 visible
+    devices — is identical: the mesh facets live in the store KEY."""
+    import jax
+    import jax.numpy as jnp
+
+    from sesam_duke_microservice_tpu.parallel.sharded import rule_sharding
+
+    monkeypatch.setenv("DUKE_AOT_DIR", str(tmp_path / "store"))
+    mesh8, mesh4 = _mesh(8), _mesh(4)
+    fp8, fp4 = mesh_fingerprint(mesh8), mesh_fingerprint(mesh4)
+    assert fp8 != fp4
+    assert fp8["shape"] == [8] and fp4["shape"] == [4]
+
+    store = AotStore()
+    logical = {"builder": "mesh-test", "cap": 16}
+    key8 = dict(logical, mesh=fp8)
+    key4 = dict(logical, mesh=fp4)
+    assert store._path(key8) != store._path(key4)
+
+    fn = jax.jit(lambda x: x + 1.0)
+    compiled8 = fn.lower(
+        jax.ShapeDtypeStruct((16,), jnp.float32,
+                             sharding=rule_sharding(mesh8, "corpus", 1))
+    ).compile()
+    assert store.save(key8, compiled8) is True
+    # the 8-way entry exists; the 4-way key misses instead of loading a
+    # wrongly-partitioned executable
+    assert store.load(key4) is None
+    assert store.load(key8) is not None
