@@ -1260,6 +1260,21 @@ CAP_BENCH = os.environ.get("BENCH_CAPACITY", "1") != "0"
 MESH_BENCH = os.environ.get("BENCH_MESH", "1") != "0"
 MESH_DEVICES = int(os.environ.get("BENCH_MESH_DEVICES", "8"))
 MESH_RECORDS = int(os.environ.get("BENCH_MESH_RECORDS", "384"))
+# multi-tenant density differential (ISSUE 19): BENCH_MT_TENANTS
+# same-process device workloads over BENCH_MT_SCHEMAS distinct schemas,
+# three arms — (a) arena OFF / per-workload pinning (the HBM control),
+# (b) arena ON with the budget forced to a quarter of the control's
+# pinned bytes (spill/fault-in under pressure, tapes must stay
+# bit-identical to the control), (c) a 4-schema single-tenant run whose
+# jit-compile count and shared-ladder executable census the 100-tenant
+# arm must MATCH (N same-schema tenants pay one warm pass).  Plus the
+# quota proof: one flooding tenant against a small queue cap absorbs
+# every 429 while the polite tenants' p99 submit latency stays inside
+# DUKE_SLO_INGEST_MS.  BENCH_MULTITENANT=0 skips it.
+MT_BENCH = os.environ.get("BENCH_MULTITENANT", "1") != "0"
+MT_TENANTS = int(os.environ.get("BENCH_MT_TENANTS", "100"))
+MT_SCHEMAS = max(1, min(4, int(os.environ.get("BENCH_MT_SCHEMAS", "4"))))
+MT_BATCHES = int(os.environ.get("BENCH_MT_BATCHES", "2"))
 
 FED_XML = """
 <DukeMicroService dataFolder="{folder}">
@@ -2010,6 +2025,303 @@ def tail_latency_bench() -> dict:
     return out
 
 
+# -- multi-tenant density (ISSUE 19) ------------------------------------------
+
+
+_MT_PROPS = [
+    [("NAME", "levenshtein"), ("EMAIL", "exact")],
+    [("NAME", "levenshtein")],
+    [("NAME", "levenshtein"), ("SSN", "exact")],
+    [("NAME", "levenshtein"), ("EMAIL", "exact"), ("PHONE", "exact")],
+]
+
+
+def _mt_xml(name: str, props) -> str:
+    prop_xml = "".join(
+        f"<property><name>{p}</name><comparator>{c}</comparator>"
+        f"<low>0.1</low><high>0.95</high></property>"
+        for p, c in props)
+    cols = "".join(
+        f'<column name="{p.lower()}" property="{p}"/>' for p, _ in props)
+    return (
+        '<DukeMicroService>'
+        f'<Deduplication name="{name}" link-database-type="in-memory">'
+        '<duke><schema><threshold>0.8</threshold>' + prop_xml +
+        '</schema><data-source class="io.sesam.dukemicroservice.'
+        'IncrementalDeduplicationDataSource">'
+        '<param name="dataset-id" value="crm"/>' + cols +
+        '</data-source></duke></Deduplication></DukeMicroService>')
+
+
+def _mt_entities(i: int, r: int, props) -> list:
+    """One tenant's round-``r`` batch: a duplicate pair plus two
+    distinct records (every tenant links something every round)."""
+    out = []
+    for j in range(4):
+        rec = {"_id": f"t{i}r{r}x{j}"}
+        for p, _ in props:
+            if j < 2:
+                rec[p.lower()] = f"dup {p.lower()} {i} {r}"
+            else:
+                rec[p.lower()] = f"uniq {p.lower()} {i} {r} {j}"
+        out.append(rec)
+    return out
+
+
+class _MtTape:
+    def __init__(self):
+        self.events = []
+
+    def start_processing(self):
+        pass
+
+    def batch_ready(self, size):
+        self.events.append(("batch_ready", size))
+
+    def batch_done(self):
+        self.events.append(("batch_done",))
+
+    def end_processing(self):
+        pass
+
+    def matches(self, r1, r2, confidence):
+        self.events.append(
+            ("match", r1.record_id, r2.record_id, repr(confidence)))
+
+    def matches_perhaps(self, r1, r2, confidence):
+        self.events.append(
+            ("maybe", r1.record_id, r2.record_id, repr(confidence)))
+
+    def no_match_for(self, record):
+        self.events.append(("none", record.record_id))
+
+
+def _mt_arm(n_tenants: int, *, arena: bool, budget=None,
+            aot_dir: str) -> dict:
+    """Build ``n_tenants`` device workloads round-robin over the schema
+    variants, prewarm (joining the warm threads so the compile census is
+    complete), ingest MT_BATCHES rounds, and report tapes + compile /
+    executable / HBM counters."""
+    from sesam_duke_microservice_tpu.core.config import parse_config
+    from sesam_duke_microservice_tpu.engine.workload import build_workload
+    from sesam_duke_microservice_tpu.ops.arena import ARENA
+    from sesam_duke_microservice_tpu.telemetry import JIT_COMPILES
+    from sesam_duke_microservice_tpu.utils.jit_cache import SHARED_LADDERS
+
+    keep = {k: os.environ.get(k)
+            for k in ("DUKE_ARENA", "DUKE_AOT_DIR",
+                      "DEVICE_INITIAL_CAPACITY")}
+    os.environ["DUKE_ARENA"] = "1" if arena else "0"
+    os.environ["DUKE_AOT_DIR"] = aot_dir
+    os.environ["DEVICE_INITIAL_CAPACITY"] = "64"
+    ARENA._reset_for_tests()
+    SHARED_LADDERS._reset_for_tests()
+    old_budget = ARENA._budget_bytes
+    if budget is not None:
+        ARENA._budget_bytes = lambda: float(budget)
+    compiles0 = JIT_COMPILES.single().value
+    wls, tapes = [], []
+    t0 = time.monotonic()
+    try:
+        for i in range(n_tenants):
+            props = _MT_PROPS[i % MT_SCHEMAS]
+            sc = parse_config(_mt_xml(f"tenant{i}", props),
+                              env={"MIN_RELEVANCE": "0.05"})
+            wl = build_workload(sc.deduplications[f"tenant{i}"], sc,
+                                backend="device", persistent=False)
+            tape = _MtTape()
+            wl.processor.add_match_listener(tape)
+            wls.append(wl)
+            tapes.append(tape)
+            # warm the ladder BEFORE ingest and join the warm thread:
+            # the ingest below then dispatches through registered
+            # executables, so the compile census counts warm compiles
+            # only — deterministic across arms
+            cache = wl.index.scorer_cache
+            cache.prewarm_async(False)
+            t = cache._warm_thread
+            if t is not None:
+                t.join(timeout=600)
+        for r in range(MT_BATCHES):
+            for i, wl in enumerate(wls):
+                wl.submit_batch(
+                    "crm", _mt_entities(i, r, _MT_PROPS[i % MT_SCHEMAS]))
+        elapsed = time.monotonic() - t0
+        pinned = sum(wl.index.corpus._device_nbytes() for wl in wls)
+        device_bytes = ARENA.tier_bytes()["device"] if arena else pinned
+        stats = SHARED_LADDERS.stats()
+        return {
+            "tapes": [tape.events for tape in tapes],
+            "compiles": JIT_COMPILES.single().value - compiles0,
+            "executables": stats["executables"],
+            "ladders": stats["ladders"],
+            "pinned_bytes": int(pinned),
+            "device_bytes": int(device_bytes),
+            "faults": ARENA.faults,
+            "spills": ARENA.spills,
+            "elapsed_s": round(elapsed, 3),
+        }
+    finally:
+        ARENA._budget_bytes = old_budget
+        for wl in wls:
+            wl.close()
+        for k, v in keep.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _mt_quota() -> dict:
+    """One-tenant flood against a small admission queue: the flooder
+    absorbs every SchedulerReject (the HTTP 429) while the polite
+    tenants' p99 submit latency stays inside DUKE_SLO_INGEST_MS — the
+    DRR quantum keeps their rounds coming."""
+    import threading as _threading
+
+    from sesam_duke_microservice_tpu.core.config import parse_config
+    from sesam_duke_microservice_tpu.engine.scheduler import (
+        IngestScheduler,
+        SchedulerReject,
+    )
+    from sesam_duke_microservice_tpu.engine.workload import build_workload
+
+    keep = {k: os.environ.get(k)
+            for k in ("DUKE_SCHED_QUEUE_MAX", "DUKE_SCHED_QUANTUM")}
+    os.environ["DUKE_SCHED_QUEUE_MAX"] = "4"
+    os.environ["DUKE_SCHED_QUANTUM"] = "32"
+    slo_ms = float(os.environ.get("DUKE_SLO_INGEST_MS", "1000"))
+    names = ["flood", "polite0", "polite1", "polite2"]
+    wls = {}
+    try:
+        for name in names:
+            sc = parse_config(_mt_xml(name, _MT_PROPS[0]),
+                              env={"MIN_RELEVANCE": "0.05"})
+            wls[name] = build_workload(sc.deduplications[name], sc,
+                                       backend="host", persistent=False)
+        sched = IngestScheduler(lambda kind, name: wls[name])
+        stop = _threading.Event()
+        flood_rejects = [0]
+        flood_submitted = [0]
+        polite_rejects = [0]
+        lat_lock = _threading.Lock()
+        polite_lat = []
+
+        def flooder(f: int):
+            i = 0
+            while not stop.is_set():
+                batch = [{"_id": f"f{f}b{i}x{j}",
+                          "name": f"flood {f} {i} {j}",
+                          "email": f"f{f}@x"} for j in range(4)]
+                try:
+                    sched.submit("deduplication", "flood", "crm", batch)
+                    flood_submitted[0] += 1
+                except SchedulerReject:
+                    flood_rejects[0] += 1
+                    time.sleep(0.002)
+                i += 1
+
+        def polite(name: str):
+            for r in range(25):
+                batch = [{"_id": f"{name}r{r}a", "name": f"dup {name} {r}",
+                          "email": f"{name}@x"},
+                         {"_id": f"{name}r{r}b", "name": f"dup {name} {r}",
+                          "email": f"{name}@x"}]
+                t0 = time.perf_counter()
+                try:
+                    sched.submit("deduplication", name, "crm", batch)
+                except SchedulerReject:
+                    polite_rejects[0] += 1
+                with lat_lock:
+                    polite_lat.append(time.perf_counter() - t0)
+
+        floods = [_threading.Thread(target=flooder, args=(f,))
+                  for f in range(10)]
+        for t in floods:
+            t.start()
+        time.sleep(0.25)  # build a flood backlog first
+        polites = [_threading.Thread(target=polite, args=(n,))
+                   for n in names[1:]]
+        for t in polites:
+            t.start()
+        for t in polites:
+            t.join(timeout=300)
+        stop.set()
+        for t in floods:
+            t.join(timeout=60)
+        sched.shutdown()
+        lat = sorted(polite_lat)
+        p99_ms = lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1000.0
+        return {
+            "slo_ms": slo_ms,
+            "polite_p99_ms": round(p99_ms, 3),
+            "p99_within_slo": bool(p99_ms <= slo_ms),
+            "polite_rejects": polite_rejects[0],
+            "flooder_rejects": flood_rejects[0],
+            "flooder_submitted": flood_submitted[0],
+            "flood_absorbs_all_429s": bool(
+                flood_rejects[0] > 0 and polite_rejects[0] == 0),
+        }
+    finally:
+        for wl in wls.values():
+            wl.close()
+        for k, v in keep.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def multitenant_bench() -> dict:
+    """ISSUE 19 acceptance surface: the 100-tenant density differential
+    plus the quota proof."""
+    import shutil as _shutil
+    import tempfile
+
+    dirs = [tempfile.mkdtemp(prefix=f"duke-mt-{arm}-")
+            for arm in ("control", "off", "on")]
+    try:
+        # (c) 4-schema single-tenant control: the compile/executable
+        # census the dense arm must match
+        single = _mt_arm(MT_SCHEMAS, arena=False, aot_dir=dirs[0])
+        # (a) per-workload pinning control (arena off)
+        off = _mt_arm(MT_TENANTS, arena=False, aot_dir=dirs[1])
+        # (b) the dense arm: budget = a quarter of the control's pinned
+        # bytes, so residency stays >= 4x below per-workload pinning
+        budget = max(1, off["pinned_bytes"] // 4)
+        on = _mt_arm(MT_TENANTS, arena=True, budget=budget,
+                     aot_dir=dirs[2])
+        out = {
+            "tenants": MT_TENANTS,
+            "schemas": MT_SCHEMAS,
+            "batches_per_tenant": MT_BATCHES,
+            "compiles_single_tenant": single["compiles"],
+            "compiles_dense": on["compiles"],
+            "compiles_equal": on["compiles"] == single["compiles"],
+            "executables_single_tenant": single["executables"],
+            "executables_dense": on["executables"],
+            "executables_equal":
+                on["executables"] == single["executables"],
+            "ladders_dense": on["ladders"],
+            "pinned_control_bytes": off["pinned_bytes"],
+            "arena_device_bytes": on["device_bytes"],
+            "hbm_ratio": round(
+                off["pinned_bytes"] / max(1, on["device_bytes"]), 2),
+            "hbm_at_least_4x_denser":
+                on["device_bytes"] * 4 <= off["pinned_bytes"],
+            "arena_faults": on["faults"],
+            "arena_spills": on["spills"],
+            "tapes_bit_identical": on["tapes"] == off["tapes"],
+            "elapsed_off_s": off["elapsed_s"],
+            "elapsed_on_s": on["elapsed_s"],
+            "quota": _mt_quota(),
+        }
+        return out
+    finally:
+        for d in dirs:
+            _shutil.rmtree(d, ignore_errors=True)
+
+
 def main():
     schema = bench_schema()
     corpus = stresstest_records(CORPUS, seed=1234)
@@ -2050,6 +2362,8 @@ def main():
         result["capacity"] = capacity_bench()
     if MESH_BENCH and BACKEND == "device":
         result["mesh"] = mesh_bench()
+    if MT_BENCH and BACKEND == "device":
+        result["multitenant"] = multitenant_bench()
     if TAIL and BACKEND == "device":
         result["tail_latency"] = tail_latency_bench()
     print(json.dumps(result))

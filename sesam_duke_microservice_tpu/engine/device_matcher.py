@@ -214,6 +214,12 @@ class DeviceCorpus:
         # hide rows from the device copy
         self._upload_lock = threading.Lock()
         self._mutation_gen = 0
+        # arena identity (ISSUE 19): the owning workload stamps its
+        # kind/name label and a cost-ledger heat callable after build;
+        # device_arrays admits through ops.arena under these before
+        # every upload (no-ops under DUKE_ARENA=0)
+        self.arena_label = ""
+        self.arena_heat: Optional[object] = None
 
     # -- growth --------------------------------------------------------------
 
@@ -333,6 +339,39 @@ class DeviceCorpus:
 
     # -- device mirror -------------------------------------------------------
 
+    def _device_nbytes(self) -> int:
+        """Device-mirror footprint: the host mirrors' nbytes (the device
+        copies share shapes and dtypes, so the host sum IS the device
+        cost).  Lock-free torn reads tolerated — the arena re-admits at
+        the settled size on the next call."""
+        total = 0
+        for tensors in list(self.feats.values()):
+            for arr in list(tensors.values()):
+                total += int(arr.nbytes)
+        for arr in (self.row_valid, self.row_deleted, self.row_group):
+            total += int(arr.nbytes)
+        return total
+
+    def spill_device(self) -> int:
+        """Drop the device mirrors to the host tier (arena eviction).
+
+        Takes the upload lock — the arena's lock is OUTER to it (lock
+        order in ops.arena), so a spill waits out any in-flight upload.
+        The numpy host mirrors stay authoritative; the owner's next
+        query re-admits and faults the corpus back in through the
+        normal dirty-full upload.  Returns the freed byte estimate."""
+        with self._upload_lock:
+            freed = self._device_nbytes() if self._device is not None else 0
+            self._device = None
+            self._mask_device = None
+            self._dirty_full = True
+            self._dirty_masks = True
+            self._pending_update = None
+            self._mask_slice = None
+            self._mask_rows = []
+            self._mutation_gen += 1
+            return freed
+
     def _place(self, arr: np.ndarray):
         """Host array -> device array; the sharded corpus overrides with
         record-axis-sharded placement over its mesh."""
@@ -358,7 +397,17 @@ class DeviceCorpus:
         dominated the serve batch.  External code that mutates
         ``row_valid``/``row_deleted`` outside ``append``/``tombstone``
         MUST set ``_dirty_masks = True`` (snapshot_load does).
+
+        Residency is leased from the shared arena FIRST (ISSUE 19):
+        admission may spill colder tenants' mirrors and raises
+        ``ops.arena.ArenaAdmissionError`` — surfaced as a 503, never an
+        allocator OOM — when the budget cannot fit this corpus.  The
+        admit call stays OUTSIDE the upload lock (arena lock is outer).
         """
+        from ..ops.arena import ARENA
+
+        ARENA.admit(self, self._device_nbytes(), spill=self.spill_device,
+                    label=self.arena_label, heat=self.arena_heat)
         with self._upload_lock:
             while True:
                 gen = self._mutation_gen
@@ -1560,7 +1609,15 @@ class DeviceIndex(CandidateIndex):
             self._store_synced_hash = store_hash
 
     def close(self) -> None:
-        pass
+        # drop the arena lease and the shared-ladder ref NOW instead of
+        # waiting for GC: a hot reload's replacement workload must see
+        # this tenant's HBM residency and AOT refcount released
+        from ..ops.arena import ARENA
+
+        ARENA.forget(self.corpus)
+        cache = self._scorer_cache
+        if cache is not None:
+            cache.release_shared()
 
 
 class _BlockResult:
@@ -1684,7 +1741,19 @@ class _ScorerCache:
         # (the synchronous load pass, the warm thread) and reads (the
         # dispatch fast path) are GIL-atomic dict ops, and a stale read
         # only costs one jit-path fallback.
+        # With DUKE_SHARED_AOT (default), this dict IS a shared ladder's
+        # map (utils.jit_cache.SHARED_LADDERS): every cache with the
+        # same (plan fingerprint, geometry) key points at ONE dict, so
+        # N same-schema tenants share one warm pass and one set of
+        # executables.  The holder indirection lets weakref.finalize
+        # release the ref when this cache dies without resurrecting it.
         self._aot: Dict[tuple, object] = {}
+        self._shared_holder: List[Optional[object]] = [None]
+        self._shared_finalizer = None
+        # serializes lease churn (rebind/release): two concurrent plan
+        # moves on one cache must not double-release a lease or strand
+        # an acquired one in an overwritten holder slot
+        self._shared_rebind_lock = threading.Lock()
 
     # -- compile-ladder pre-warm / AOT load ---------------------------------
 
@@ -1732,6 +1801,67 @@ class _ScorerCache:
             "bucket": bucket,
         }
 
+    def _shared_ladder_key(self, group_filtering: bool) -> tuple:
+        """The cross-workload ladder identity: the AOT store key minus
+        the per-entry facets (k, variant, capacity, bucket all live
+        inside the map's akeys).  Derived through ``_store_key`` so the
+        sharded caches' mesh facets ride along automatically — two
+        tenants share a ladder iff their entries would share store
+        files."""
+        import json
+
+        doc = self._store_key(self.index.plan, 0, group_filtering,
+                              True, 0, 0)
+        for facet in ("k", "from_rows", "cap", "bucket"):
+            doc.pop(facet, None)
+        return (json.dumps(doc, sort_keys=True, separators=(",", ":"),
+                           default=str),)
+
+    def _rebind_shared_ladder(self, group_filtering: bool) -> None:
+        """Point ``self._aot`` at the shared ladder for the current
+        (fingerprint, geometry) key, releasing any previous lease — the
+        refcounted form of the plan-mutation eviction seam: THIS
+        tenant's plan moved, so it steps off the old ladder (which
+        other tenants may still be on) and onto the new key's; the old
+        ladder's executables die with its last leaseholder."""
+        import weakref
+
+        from ..utils.jit_cache import (
+            SHARED_LADDERS,
+            release_shared_lease,
+        )
+
+        key = self._shared_ladder_key(group_filtering)
+        with self._shared_rebind_lock:
+            lease = self._shared_holder[0]
+            if lease is not None and lease.key == key:
+                return
+            SHARED_LADDERS.release(lease)
+            lease = SHARED_LADDERS.acquire(key)
+            self._shared_holder[0] = lease
+            self._aot = lease.map
+            if self._shared_finalizer is None:
+                self._shared_finalizer = weakref.finalize(
+                    self, release_shared_lease, self._shared_holder)
+
+    def release_shared(self) -> None:
+        """Drop this cache's shared-ladder ref eagerly (index close)."""
+        from ..utils.jit_cache import release_shared_lease
+
+        with self._shared_rebind_lock:
+            release_shared_lease(self._shared_holder)
+            if self._aot:
+                self._aot = {}
+
+    def _warm_serial(self):
+        """Context serializing warm compiles over the shared ladder so
+        N same-schema tenants pay ONE compile per entry (the losers
+        find it registered and skip); per-workload ladders need no
+        serialization (one warm thread per cache)."""
+        lease = self._shared_holder[0]
+        return (lease.warm_lock if lease is not None
+                else contextlib.nullcontext())
+
     def prewarm_async(self, group_filtering: bool) -> None:
         """Make the (query-bucket x capacity x K x variant) scorer ladder
         hot for the current corpus shapes — and speculatively the next
@@ -1769,7 +1899,18 @@ class _ScorerCache:
         if prev == key:
             return
         self._warmed = key
-        if prev is not None and prev[1:] != key[1:]:
+        from ..utils.jit_cache import shared_aot_enabled
+
+        if shared_aot_enabled() and self.supports_aot:
+            # shared-ladder form of the eviction seam (ISSUE 19): the
+            # ladder key embeds the full plan fingerprint, so a plan
+            # move rebinds this cache to a DIFFERENT shared map — other
+            # tenants still on the old plan keep theirs, and the old
+            # ladder's executables die with its last leaseholder
+            # (refcounted evict).  Capacity-only changes keep the lease
+            # (the key has no capacity facet).
+            self._rebind_shared_ladder(group_filtering)
+        elif prev is not None and prev[1:] != key[1:]:
             # the PLAN moved (value-slot/char growth, demotion, filtering
             # flip): every registered executable was built for the old
             # tensor shapes, and its (k, gf, from_rows, cap, bucket) akey
@@ -2011,27 +2152,35 @@ class _ScorerCache:
         for cap_i, bucket, from_rows in entries:
             if self._warmed != key or _WARM_SHUTDOWN.is_set():
                 return  # superseded / interpreter exiting
-            record_compile()
-            ctx = (self._cache_bypass() if store is not None
-                   else contextlib.nullcontext())
-            t_compile = time.monotonic()
-            with ctx:
-                compiled = self._lower_one(
-                    row_feats, cap_i, bucket, group_filtering,
-                    from_rows=from_rows,
-                    probe_feats=None if from_rows else probe_feats,
-                    plan=plan,
-                )
-            costs.note_compile(time.monotonic() - t_compile)
-            self._warm_compiled += 1
             k = self._ladder_k(cap_i)
             akey = (k, bool(group_filtering), bool(from_rows),
                     cap_i, bucket)
-            # serve the fresh executable directly — first contact in
-            # THIS process skips the live jit trace too; setdefault
-            # so a deserialized entry (or a newer warm) is never
-            # replaced mid-use
-            self._aot.setdefault(akey, compiled)
+            if akey in self._aot:
+                # already registered — on a shared ladder this is the
+                # fingerprint-batched prewarm: another tenant's warm (or
+                # load pass) filled the slot, so this tenant pays zero
+                continue
+            with self._warm_serial():
+                if akey in self._aot:
+                    continue  # lost the race: the winner compiled it
+                record_compile()
+                ctx = (self._cache_bypass() if store is not None
+                       else contextlib.nullcontext())
+                t_compile = time.monotonic()
+                with ctx:
+                    compiled = self._lower_one(
+                        row_feats, cap_i, bucket, group_filtering,
+                        from_rows=from_rows,
+                        probe_feats=None if from_rows else probe_feats,
+                        plan=plan,
+                    )
+                costs.note_compile(time.monotonic() - t_compile)
+                self._warm_compiled += 1
+                # serve the fresh executable directly — first contact in
+                # THIS process skips the live jit trace too; setdefault
+                # so a deserialized entry (or a newer warm) is never
+                # replaced mid-use
+                self._aot.setdefault(akey, compiled)
             if store is not None and not store.save(
                     self._store_key(plan, k, group_filtering,
                                     from_rows, cap_i, bucket),

@@ -27,7 +27,14 @@ engine and a single dispatcher thread:
   * **schedules fairly** across workloads with deficit round-robin
     (``DUKE_SCHED_QUANTUM`` records of quantum per round), so one hot
     tenant's deep queue cannot starve the others — their requests ride
-    the next round, not the end of the hot queue.
+    the next round, not the end of the hot queue;
+  * **enforces per-tenant quotas** (ISSUE 19): ``DUKE_TENANT_WEIGHT``
+    scales each tenant's per-round quantum (``kind/name=2,name=0.5``
+    comma map) and ``DUKE_TENANT_MIN_SHARE`` is the starvation-proof
+    floor every tenant earns regardless of weight.  Deficit-starved
+    rounds count into ``duke_tenant_throttled_total``, and a
+    down-weighted tenant's 429 Retry-After scales by its weight so its
+    clients back off at the rate it actually drains.
 
 ``DUKE_SCHEDULER=0`` disables the subsystem entirely; the HTTP layer then
 falls back to today's lock-winner merge in ``Workload.submit_batch``.
@@ -54,7 +61,7 @@ from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from ..telemetry import slo, tracing
 from ..telemetry.decisions import _MonitorHist
-from ..telemetry.env import env_flag, env_float, env_int
+from ..telemetry.env import env_flag, env_float, env_int, env_str
 
 logger = logging.getLogger("ingest-scheduler")
 
@@ -64,6 +71,7 @@ __all__ = [
     "SchedulerClosed",
     "SchedulerReject",
     "WorkloadGone",
+    "parse_tenant_weights",
     "scheduler_enabled",
 ]
 
@@ -95,6 +103,30 @@ def fold_ewma(prev: Optional[float], sample: float) -> float:
 def retry_after_seconds(estimate: float) -> int:
     """Whole-second Retry-After: ceil'd, clamped to [1, 60]."""
     return int(min(60, max(1, math.ceil(estimate))))
+
+
+def parse_tenant_weights(spec: Optional[str] = None) -> Dict[str, float]:
+    """``DUKE_TENANT_WEIGHT`` parse: a comma map of ``key=weight`` where
+    key is ``kind/name`` (most specific) or bare ``name``.  Weights
+    scale each tenant's DRR quantum; unlisted tenants weigh 1.0.
+    Malformed entries are skipped with a log line — a typo must never
+    take admission down."""
+    if spec is None:
+        spec = env_str("DUKE_TENANT_WEIGHT", "")
+    out: Dict[str, float] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, sep, value = part.partition("=")
+        try:
+            if not sep or not key.strip():
+                raise ValueError("missing '=' or empty key")
+            out[key.strip()] = max(0.0, float(value))
+        except ValueError:
+            logger.warning("ignoring malformed DUKE_TENANT_WEIGHT "
+                           "entry %r", part)
+    return out
 
 
 class SchedulerReject(Exception):
@@ -177,13 +209,22 @@ class _TenantQueue:
     the single dispatcher thread; /metrics and /stats read them lock-free
     like every other single-writer engine counter."""
 
-    __slots__ = ("kind", "name", "pending", "queued", "deficit", "admitted",
-                 "rejected", "microbatches", "merged_requests",
-                 "dispatched_records", "wait_hist", "fill_hist")
+    __slots__ = ("kind", "name", "weight", "pending", "queued", "deficit",
+                 "admitted", "rejected", "throttled", "microbatches",
+                 "merged_requests", "dispatched_records", "wait_hist",
+                 "fill_hist")
 
-    def __init__(self, kind: str, name: str):
+    def __init__(self, kind: str, name: str, weight: float = 1.0):
         self.kind = kind
         self.name = name
+        # per-tenant DRR weight (ISSUE 19): scales the quantum this
+        # queue earns per round; immutable after creation (re-resolved
+        # when a reload recreates the queue)
+        self.weight = weight
+        # rounds where this tenant's head request exceeded its
+        # accumulated deficit — it waited for later rounds' quantum
+        # (delayed, never starved: the min-share floor keeps earning)
+        self.throttled = 0  # guarded by: self._cv [writes]
         self.pending: Deque[_SchedRequest] = deque()  # guarded by: self._cv [writes]
         # record count mirror of ``pending``, maintained under the
         # scheduler condition — /metrics and /stats read it (and
@@ -239,6 +280,14 @@ class IngestScheduler:
             0.0, env_float("DUKE_SCHED_WINDOW_MS", 5.0) / 1000.0)
         self.queue_max = max(1, env_int("DUKE_SCHED_QUEUE_MAX", 256))
         self.quantum = max(1, env_int("DUKE_SCHED_QUANTUM", 4096))
+        # per-tenant quota knobs (ISSUE 19): DUKE_TENANT_WEIGHT scales
+        # each tenant's per-round quantum; DUKE_TENANT_MIN_SHARE is the
+        # starvation-proof floor — even a zero-weighted tenant earns
+        # max(1, quantum * min_share) records per round, so weights
+        # shape throughput, never availability
+        self.min_share = min(1.0, max(
+            0.0, env_float("DUKE_TENANT_MIN_SHARE", 0.05)))
+        self._weights = parse_tenant_weights()
         self._buckets = _default_buckets()
         # sec/record EWMA over dispatched microbatches (dispatcher-written,
         # admission-read): the Retry-After estimator.  Starts None — the
@@ -263,7 +312,8 @@ class IngestScheduler:
             key = (kind, name)
             q = self._queues.get(key)
             if q is None:
-                q = self._queues[key] = _TenantQueue(kind, name)
+                q = self._queues[key] = _TenantQueue(
+                    kind, name, self._weight_for(kind, name))
                 self._order.append(key)
             if len(q.pending) >= self.queue_max:
                 q.rejected += 1
@@ -286,11 +336,30 @@ class IngestScheduler:
             q = self._queues.get((kind, name))
             return self._retry_after_locked(q) if q is not None else 1
 
+    def _weight_for(self, kind: str, name: str) -> float:
+        """``kind/name`` (most specific) wins over bare ``name``."""
+        w = self._weights.get(f"{kind}/{name}")
+        if w is None:
+            w = self._weights.get(name, 1.0)
+        return w
+
+    def _quantum_for(self, q: _TenantQueue) -> int:
+        """Per-round deficit grant: the weighted quantum with the
+        min-share floor (a weight of 0 still drains, just last)."""
+        floor = max(1, int(self.quantum * self.min_share))
+        return max(floor, int(round(self.quantum * q.weight)))
+
     def _retry_after_locked(self, q: _TenantQueue) -> int:
         per_record = self._ewma_sec_per_record
         if per_record is None:
             return 1
-        return retry_after_seconds(q.queued_records() * per_record)
+        est = q.queued_records() * per_record
+        if q.weight != 1.0:
+            # a down-weighted tenant drains at weight * the fleet rate:
+            # its 429s must say so, or a flooding tenant retries on an
+            # estimate computed for capacity it no longer gets
+            est /= max(q.weight, self.min_share, 1e-9)
+        return retry_after_seconds(est)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -394,7 +463,7 @@ class IngestScheduler:
                         del self._queues[key]
                         self._order.remove(key)
                     continue
-                q.deficit += self.quantum
+                q.deficit += self._quantum_for(q)
             batch, deadline = self._collect(q)
             if batch:
                 if self._dispatch(q, batch):
@@ -431,7 +500,10 @@ class IngestScheduler:
                               or total >= ladder_max):
                     break
                 if not batch and head.records > q.deficit:
-                    return None, None  # earns more deficit next round
+                    # earns more deficit next round; the counter is the
+                    # quota-throttle signal (duke_tenant_throttled_total)
+                    q.throttled += 1
+                    return None, None
                 q.pending.popleft()
                 q.queued -= head.records
                 batch.append(head)
@@ -583,6 +655,7 @@ class IngestScheduler:
             "window_ms": round(self.window_seconds * 1000.0, 3),
             "queue_max": self.queue_max,
             "quantum_records": self.quantum,
+            "min_share": self.min_share,
             "sec_per_record_ewma": (
                 round(self._ewma_sec_per_record, 9)
                 if self._ewma_sec_per_record is not None else None
@@ -594,10 +667,12 @@ class IngestScheduler:
             out["workloads"].append({
                 "kind": q.kind,
                 "name": q.name,
+                "weight": q.weight,
                 "depth": len(q.pending),
                 "queued_records": q.queued_records(),
                 "admitted": q.admitted,
                 "rejected": q.rejected,
+                "throttled": q.throttled,
                 "microbatches": q.microbatches,
                 "merged_requests": q.merged_requests,
                 "records_dispatched": q.dispatched_records,

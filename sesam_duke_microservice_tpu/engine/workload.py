@@ -85,6 +85,23 @@ def _hbm_components(wl_ref) -> Dict[str, int]:
     return {k: v for k, v in out.items() if v}
 
 
+def _arena_heat(wl_ref) -> float:
+    """Accumulated per-workload device-seconds from the cost ledger's
+    phase recorder — the arena's eviction heat (ISSUE 19): among cold
+    candidates, the tenant that has burned the least device time spills
+    first.  Lock-free torn reads tolerated (ordering hint only)."""
+    wl = wl_ref()
+    if wl is None:
+        return 0.0
+    phases = getattr(wl.processor, "phases", None)
+    if phases is None:
+        return 0.0
+    try:
+        return float(sum(phases.phase_seconds().values()))
+    except Exception:
+        return 0.0
+
+
 class _BatchRequest:
     """One queued ingest request awaiting the merged device batch."""
 
@@ -142,10 +159,23 @@ class Workload:
         # HBM ledger enrollment (telemetry/memory.py): the components
         # callable holds this workload weakly, so a reload-replaced
         # workload drops out of the books with its last reference and
-        # the closed flag hides it meanwhile
+        # the closed flag hides it meanwhile.  Arena-enabled device
+        # corpora register as LOGICAL views (ISSUE 19): the arena owns
+        # the physical slab bytes and attributes them once; this
+        # registration keeps per-tenant attribution without double
+        # counting the budget.
+        from ..ops.arena import arena_enabled
+
         wl_ref = weakref.ref(self)
+        corpus = getattr(index, "corpus", None)
         memory.register(self, self.kind, self.name,
-                        lambda: _hbm_components(wl_ref))
+                        lambda: _hbm_components(wl_ref),
+                        logical=corpus is not None and arena_enabled())
+        if corpus is not None:
+            # arena identity + eviction heat: device_arrays admits
+            # under these (engine.device_matcher.DeviceCorpus)
+            corpus.arena_label = f"{self.kind}/{self.name}"
+            corpus.arena_heat = lambda: _arena_heat(wl_ref)
 
     def replace_link_database(self, link_database: LinkDatabase) -> None:
         """Swap the link database wrapper in place — the dispatcher

@@ -49,6 +49,7 @@ from ..engine.scheduler import (
     scheduler_enabled,
 )
 from ..engine.workload import Workload, build_workload
+from ..ops.arena import ArenaAdmissionError
 from ..telemetry import slo, tracing
 from ..telemetry.env import env_flag, env_str
 from ..telemetry.logctx import new_request_id, request_id_var
@@ -1003,6 +1004,14 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                 )
             except SchedulerClosed:
                 raise _HttpError(503, "The service is shutting down.")
+            except ArenaAdmissionError as e:
+                # the corpus no longer fits the HBM budget even after
+                # spilling every other tenant (ISSUE 19): a loud,
+                # actionable 503 — never an allocator OOM
+                raise _HttpError(
+                    503, f"HBM budget exhausted: {e}",
+                    extra_headers={"Retry-After": "30"},
+                )
             except _HttpError:
                 raise
             except Exception as e:
@@ -1021,6 +1030,11 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
                 try:
                     rows = workload.submit_batch(dataset_id, batch,
                                                  http_transform=transform)
+                except ArenaAdmissionError as e:
+                    raise _HttpError(
+                        503, f"HBM budget exhausted: {e}",
+                        extra_headers={"Retry-After": "30"},
+                    )
                 except Exception as e:
                     logger.exception("Batch processing failed")
                     raise _HttpError(500, f"Batch processing failed: {e}")

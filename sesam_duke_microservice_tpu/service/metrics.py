@@ -380,6 +380,7 @@ def make_app_collector(app):
         sched_merged = []
         sched_wait = []
         sched_fill = []
+        sched_throttled = []
         scheduler = getattr(app, "scheduler", None)
         if scheduler is not None:
             for q in scheduler.queues():
@@ -394,6 +395,7 @@ def make_app_collector(app):
                 sched_merged.append(("", labels, q.merged_requests))
                 sched_wait.extend(q.wait_hist.samples(labels))
                 sched_fill.extend(q.fill_hist.samples(labels))
+                sched_throttled.append(("", labels, float(q.throttled)))
 
         out = [
             FamilySnapshot("duke_uptime_seconds", "gauge",
@@ -469,6 +471,12 @@ def make_app_collector(app):
                 "duke_sched_microbatch_records", "histogram",
                 "Records per dispatched microbatch (coalesced fill toward "
                 "the query-padding buckets)", sched_fill))
+            out.append(FamilySnapshot(
+                "duke_tenant_throttled_total", "counter",
+                "DRR rounds where the tenant's head request exceeded its "
+                "accumulated deficit (quota throttling: delayed to later "
+                "rounds, never starved — the DUKE_TENANT_MIN_SHARE floor "
+                "keeps earning)", sched_throttled))
         with app._feed_abort_lock:
             abort_counts = dict(app.feed_aborts)
         out.append(FamilySnapshot(

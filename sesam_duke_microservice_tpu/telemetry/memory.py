@@ -43,40 +43,47 @@ from collections import deque
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from .env import env_int
-from .registry import FamilySnapshot
+from .registry import FamilySnapshot, add_render_hook
 
 DEFAULT_BUDGET_BYTES = 16 << 30  # 16 GiB: one modern accelerator's HBM
 
 _REG_LOCK = threading.Lock()
-# id(owner) -> (weakref(owner), kind, name, components_fn)
+# id(owner) -> (weakref(owner), kind, name, components_fn, logical)
 _ENTRIES: Dict[int, tuple] = {}  # guarded by: _REG_LOCK [writes]
 # (unix_ts, corpus_bytes) scrape-time samples driving the growth forecast
 _growth: deque = deque(maxlen=256)  # guarded by: _REG_LOCK
 
 
 def register(owner: object, kind: str, name: str,
-             fn: Callable[[], Dict[str, int]]) -> None:
+             fn: Callable[[], Dict[str, int]], *,
+             logical: bool = False) -> None:
     """Enroll ``owner``'s device buffers; ``fn`` must be lock-free and
-    must not strongly reference ``owner`` (close over a weakref)."""
+    must not strongly reference ``owner`` (close over a weakref).
+
+    ``logical`` marks a per-tenant VIEW of bytes whose physical owner is
+    registered elsewhere (ISSUE 19: arena-enabled workloads view corpus
+    slabs the arena attributes once) — logical arena-tier components are
+    reported per owner for attribution but excluded from the budget
+    totals, so shared slabs are never double-counted against headroom."""
     key = id(owner)
     with _REG_LOCK:
-        _ENTRIES[key] = (weakref.ref(owner), kind, name, fn)
+        _ENTRIES[key] = (weakref.ref(owner), kind, name, fn, logical)
 
 
-def _iter_live() -> List[Tuple[str, str, object, Callable]]:
+def _iter_live() -> List[Tuple[str, str, object, Callable, bool]]:
     """Live registrations, pruning dead/closed owners in passing."""
     out = []
     with _REG_LOCK:
         items = list(_ENTRIES.items())
     dead = []
-    for key, (ref, kind, name, fn) in items:
+    for key, (ref, kind, name, fn, logical) in items:
         owner = ref()
         if owner is None:
             dead.append(key)
             continue
         if getattr(owner, "closed", False):
             continue  # replaced by reload; the weakref reaps it later
-        out.append((kind, name, owner, fn))
+        out.append((kind, name, owner, fn, logical))
     if dead:
         with _REG_LOCK:
             for key in dead:
@@ -84,17 +91,68 @@ def _iter_live() -> List[Tuple[str, str, object, Callable]]:
     return out
 
 
+# -- once-per-scrape ledger pass (ISSUE 19 satellite) -------------------------
+#
+# The app/group collectors call components_for() per workload and the
+# GLOBAL collector walks the whole ledger again in the SAME render — at
+# 200 tenants that is 400+ component-callable evaluations per scrape.
+# registry.render() brackets every scrape with the hooks below; inside
+# a bracket the FIRST ledger read evaluates every callable once into a
+# thread-local snapshot and every later read (either API) serves from
+# it.  Direct calls outside a render (debug endpoints, tests) see no
+# cache at all — no staleness window exists.
+
+_PASS = threading.local()
+
+
+def _begin_render() -> None:
+    _PASS.active = True
+    _PASS.snapshot = None
+
+
+def _end_render() -> None:
+    _PASS.active = False
+    _PASS.snapshot = None
+
+
+add_render_hook(_begin_render, _end_render)
+
+
+def _eval_components(fn: Callable) -> Dict[str, float]:
+    try:
+        return {k: float(v) for k, v in fn().items() if v}
+    except Exception:
+        return {}  # a mid-mutation read must never fail a scrape
+
+
+def _ledger_pass() -> Dict[int, tuple]:
+    """id(owner) -> (kind, name, components, logical) — ONE evaluation
+    of every live registration, cached for the duration of the active
+    render (none active: computed fresh, never cached)."""
+    snapshot = (getattr(_PASS, "snapshot", None)
+                if getattr(_PASS, "active", False) else None)
+    if snapshot is not None:
+        return snapshot
+    snapshot = {
+        id(owner): (kind, name, _eval_components(fn), logical)
+        for kind, name, owner, fn, logical in _iter_live()
+    }
+    if getattr(_PASS, "active", False):
+        _PASS.snapshot = snapshot
+    return snapshot
+
+
 def components_for(owner: object) -> Dict[str, float]:
     """One owner's current component bytes (empty if unregistered) —
     the app/group collectors' per-workload read."""
+    if getattr(_PASS, "active", False):
+        entry = _ledger_pass().get(id(owner))
+        return dict(entry[2]) if entry is not None else {}
     with _REG_LOCK:
         entry = _ENTRIES.get(id(owner))
     if entry is None:
         return {}
-    try:
-        return {k: float(v) for k, v in entry[3]().items() if v}
-    except Exception:
-        return {}  # a mid-mutation read must never fail a scrape
+    return _eval_components(entry[3])
 
 
 def process_components() -> Dict[str, float]:
@@ -142,18 +200,29 @@ def budget_bytes() -> Tuple[float, str]:
 
 _CORPUS_COMPONENTS = ("corpus_tensors", "corpus_embeddings", "int8_scales",
                       "ivf_membership")
+# components an arena-enabled workload only VIEWS (the arena owns the
+# physical device bytes and attributes them once): excluded from the
+# budget totals when the registration is logical.  ivf_membership stays
+# physical either way — the arena does not manage IVF uploads.
+_ARENA_VIEW_COMPONENTS = ("corpus_tensors", "corpus_embeddings",
+                          "int8_scales")
 
 
 def _totals(now_unix: Optional[float] = None
             ) -> Tuple[float, float, List[Tuple[str, str, str, float]]]:
     """(total_bytes, corpus_bytes, [(kind, name, component, bytes)]) and
-    feed the growth ring with the corpus share."""
+    feed the growth ring with the corpus share.  Logical registrations'
+    arena-tier components appear in the rows (per-tenant attribution)
+    but never in the totals — the arena's own registration carries the
+    physical bytes exactly once."""
     rows: List[Tuple[str, str, str, float]] = []
     total = 0.0
     corpus = 0.0
-    for kind, name, owner, _fn in _iter_live():
-        for comp, nbytes in sorted(components_for(owner).items()):
+    for kind, name, comps, logical in _ledger_pass().values():
+        for comp, nbytes in sorted(comps.items()):
             rows.append((kind, name, comp, nbytes))
+            if logical and comp in _ARENA_VIEW_COMPONENTS:
+                continue  # a view: the arena row already counted it
             total += nbytes
             if comp in _CORPUS_COMPONENTS:
                 corpus += nbytes
@@ -201,11 +270,22 @@ def live_arrays_bytes() -> Optional[int]:
 
 
 def debug_snapshot() -> Dict[str, object]:
-    """``GET /debug/memory`` payload."""
+    """``GET /debug/memory`` payload.
+
+    The ``jax.live_arrays()`` cross-check reconciles against the
+    PHYSICAL total only: arena-enabled workloads' corpus rows are
+    logical views (their ``logical`` flag marks them here), and the
+    backend's live arrays correspond to the arena's resident tier plus
+    the non-logical registrations — spilled tenants' mirrors are host
+    numpy, invisible to both sides of the check by construction."""
     budget, source = budget_bytes()
     total, corpus, rows = _totals()
+    logical_owners = {
+        (kind, name) for kind, name, _comps, logical
+        in _ledger_pass().values() if logical
+    }
     headroom = budget - total
-    return {
+    out = {
         "budget_bytes": int(budget),
         "budget_source": source,
         "total_bytes": int(total),
@@ -215,16 +295,30 @@ def debug_snapshot() -> Dict[str, object]:
         "overflow_days": round(overflow_days(headroom), 3),
         "workloads": [
             {"kind": kind, "workload": name, "component": comp,
-             "bytes": int(nbytes)}
+             "bytes": int(nbytes),
+             # the marker appears ONLY on arena-view rows so legacy
+             # (non-arena) rows keep their exact shape
+             **({"logical": True}
+                if (kind, name) in logical_owners
+                and comp in _ARENA_VIEW_COMPONENTS else {})}
             for kind, name, comp, nbytes in rows if kind != "process"
         ],
         "process": {comp: int(nbytes)
                     for kind, _n, comp, nbytes in rows if kind == "process"},
         "live_arrays_bytes": live_arrays_bytes(),
     }
+    try:
+        from ..ops import arena
+
+        out["arena"] = arena.ARENA.debug_snapshot()
+    except Exception:
+        pass  # arena import must never fail the debug endpoint
+    return out
 
 
 def _reset_for_tests() -> None:
+    # NOTE: this also drops the arena's import-time enrollment; tests
+    # that assert arena attribution re-enroll via arena._enroll_ledger()
     with _REG_LOCK:
         _ENTRIES.clear()
         _growth.clear()

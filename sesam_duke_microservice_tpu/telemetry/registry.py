@@ -433,6 +433,20 @@ class MetricRegistry:
         return out
 
 
+# scrape-pass hooks (ISSUE 19 satellite): (begin, end) pairs invoked
+# around one render() so expensive state (the HBM ledger's component
+# callables) is snapshotted ONCE per scrape no matter how many
+# collectors read it — at 200 tenants the per-collector recompute made
+# /metrics a hot path.  Registration is import-time only (the ledger
+# module's tail), so the list is read-mostly and needs no lock.
+_RENDER_HOOKS: List[tuple] = []
+
+
+def add_render_hook(begin: Callable[[], None],
+                    end: Callable[[], None]) -> None:
+    _RENDER_HOOKS.append((begin, end))
+
+
 def render(*registries: MetricRegistry) -> str:
     """Prometheus text exposition (0.0.4) over one or more registries.
 
@@ -440,26 +454,32 @@ def render(*registries: MetricRegistry) -> str:
     (first declaration wins) — required for validity: a name may appear
     in only one block.
     """
-    merged: Dict[str, FamilySnapshot] = {}
-    for registry in registries:
-        for snap in registry.collect():
-            existing = merged.get(snap.name)
-            if existing is None:
-                merged[snap.name] = FamilySnapshot(
-                    snap.name, snap.mtype, snap.help, list(snap.samples)
+    for begin, _end in _RENDER_HOOKS:
+        begin()
+    try:
+        merged: Dict[str, FamilySnapshot] = {}
+        for registry in registries:
+            for snap in registry.collect():
+                existing = merged.get(snap.name)
+                if existing is None:
+                    merged[snap.name] = FamilySnapshot(
+                        snap.name, snap.mtype, snap.help, list(snap.samples)
+                    )
+                else:
+                    existing.samples.extend(snap.samples)
+        lines: List[str] = []
+        for snap in merged.values():
+            help_text = snap.help.replace("\\", "\\\\").replace("\n", "\\n")
+            lines.append(f"# HELP {snap.name} {help_text}")
+            lines.append(f"# TYPE {snap.name} {snap.mtype}")
+            for suffix, labels, value in snap.samples:
+                lines.append(
+                    f"{snap.name}{suffix}{_fmt_labels(labels)} {_fmt(value)}"
                 )
-            else:
-                existing.samples.extend(snap.samples)
-    lines: List[str] = []
-    for snap in merged.values():
-        help_text = snap.help.replace("\\", "\\\\").replace("\n", "\\n")
-        lines.append(f"# HELP {snap.name} {help_text}")
-        lines.append(f"# TYPE {snap.name} {snap.mtype}")
-        for suffix, labels, value in snap.samples:
-            lines.append(
-                f"{snap.name}{suffix}{_fmt_labels(labels)} {_fmt(value)}"
-            )
-    return "\n".join(lines) + "\n"
+        return "\n".join(lines) + "\n"
+    finally:
+        for _begin, end in _RENDER_HOOKS:
+            end()
 
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"

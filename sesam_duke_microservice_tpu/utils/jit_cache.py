@@ -39,10 +39,12 @@ import json
 import logging
 import os
 import pickle
-from typing import Optional
+import threading
+from typing import Dict, List, Optional
 
-from ..telemetry import AOT_LOADS, JIT_CACHE_HITS, JIT_COMPILES
+from ..telemetry import AOT_LOADS, GLOBAL, JIT_CACHE_HITS, JIT_COMPILES
 from ..telemetry.env import env_flag, env_float, env_str
+from ..telemetry.registry import FamilySnapshot
 
 logger = logging.getLogger("jit-cache")
 
@@ -284,3 +286,104 @@ class AotStore:
             return None
         _AOT_HIT.inc()
         return loaded
+
+
+# -- shared in-process AOT ladders (ISSUE 19 tentpole b) ----------------------
+
+
+def shared_aot_enabled() -> bool:
+    """``DUKE_SHARED_AOT`` gates the cross-workload ladder sharing
+    (default on); =0 pins the per-workload registration maps exactly."""
+    return env_flag("DUKE_SHARED_AOT", True)
+
+
+class SharedLadder:
+    """One refcounted (plan fingerprint, geometry) executable ladder.
+
+    ``map`` is the scorer caches' ``_aot`` registration dict — the same
+    lock-free akey->executable contract as before, now pointed at by
+    every tenant on the schema.  ``warm_lock`` serializes the tenants'
+    warm threads over the ladder so N same-schema tenants pay ONE warm
+    compile per entry (the losers find the entry present and skip).
+    ``refs`` is guarded by the registry lock."""
+
+    __slots__ = ("key", "map", "refs", "warm_lock")
+
+    def __init__(self, key: tuple):
+        self.key = key
+        self.map: Dict[tuple, object] = {}
+        self.refs = 0  # guarded by: self._lock (the registry's — SharedLadder has no lock of its own)
+        self.warm_lock = threading.Lock()
+
+
+class SharedLadderRegistry:
+    """Process-wide (fingerprint, geometry) -> :class:`SharedLadder` map.
+
+    The on-disk :class:`AotStore` already dedupes by plan fingerprint;
+    this is the in-process counterpart: N tenants with identical keys
+    share one registration map (and so one warm pass and one set of
+    live executables) instead of compiling N ladders.  Release is
+    refcounted — the PR 14 plan-mutation eviction seam releases the
+    tenant's lease, and the LAST tenant off a plan drops the ladder and
+    its executables (the refcounted evict)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[tuple, SharedLadder] = {}  # guarded by: self._lock
+
+    def acquire(self, key: tuple) -> SharedLadder:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                entry = self._entries[key] = SharedLadder(key)
+            entry.refs += 1
+            return entry
+
+    def release(self, entry: Optional[SharedLadder]) -> None:
+        if entry is None:
+            return
+        with self._lock:
+            entry.refs -= 1
+            if entry.refs <= 0:
+                self._entries.pop(entry.key, None)
+
+    def stats(self) -> Dict[str, int]:
+        """{ladders, refs, executables} — bench/debug surface."""
+        with self._lock:
+            entries = list(self._entries.values())
+            return {
+                "ladders": len(entries),
+                "refs": sum(e.refs for e in entries),
+                "executables": sum(len(e.map) for e in entries),
+            }
+
+    def _reset_for_tests(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+SHARED_LADDERS = SharedLadderRegistry()
+
+
+def release_shared_lease(holder: List[Optional[SharedLadder]]) -> None:
+    """weakref.finalize target for a scorer cache's lease holder: the
+    cache dying (workload reload/close) must release its ref so the
+    last tenant off a schema actually evicts the shared ladder."""
+    lease, holder[0] = holder[0], None
+    SHARED_LADDERS.release(lease)
+
+
+def _collect_shared() -> List[FamilySnapshot]:
+    """Scrape-time collector (registered on ``telemetry.GLOBAL``)."""
+    stats = SHARED_LADDERS.stats()
+    return [
+        FamilySnapshot(
+            "duke_aot_shared_refs", "gauge",
+            "Scorer caches currently leasing a shared AOT ladder "
+            "(tenants sharing compiled executables by plan fingerprint "
+            "+ geometry)",
+            [("", (), float(stats["refs"]))]),
+    ]
+
+
+GLOBAL.register_collector(_collect_shared)

@@ -188,7 +188,8 @@ class TestHbmLedger:
                         lambda: {"corpus_tensors": 1024, "empty": 0})
         assert memory.components_for(owner) == {"corpus_tensors": 1024.0}
         owner.closed = True
-        assert all(o is not owner for _k, _n, o, _f in memory._iter_live())
+        assert all(
+            o is not owner for _k, _n, o, _f, _l in memory._iter_live())
         owner.closed = False
         del owner
         import gc

@@ -1,0 +1,757 @@
+"""Multi-tenant density (ISSUE 19): the shared device-memory arena,
+the deduplicated AOT ladder, and per-tenant quotas.
+
+Invariants held here:
+
+  * arena admission spills the COLDEST resident tenant (cost-ledger
+    device-seconds, admission recency as tiebreak), never the admitting
+    one, and a spilled tenant's next query faults back in — with the
+    event tape and link rows BIT-IDENTICAL to an arena-off control
+    (the arena changes WHERE tensors live, never what scoring computes);
+  * a corpus that cannot fit the HBM budget even after spilling every
+    eligible resident is refused with a loud 503 + Retry-After at the
+    HTTP layer (``ArenaAdmissionError``), not an allocator OOM;
+  * N same-schema tenants lease ONE shared AOT ladder (same underlying
+    dict — an executable registered through one cache is visible to
+    all), refcounted: a plan move rebinds the mover onto a new key
+    while others keep theirs, and the last lease release evicts the
+    ladder's executables;
+  * per-tenant journal recovery is ISOLATED: tenant A replaying a large
+    backlog fences only A's writes (503 + Retry-After) while tenant B
+    ingests normally the whole time — PR 14's per-folder scoping, now
+    proven at the HTTP layer;
+  * per-tenant DRR quotas: ``DUKE_TENANT_WEIGHT`` scales the round
+    quantum, the ``DUKE_TENANT_MIN_SHARE`` floor keeps a zero-weighted
+    tenant draining (starvation-proof), and deficit-throttled rounds
+    count into ``duke_tenant_throttled_total``.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from sesam_duke_microservice_tpu import telemetry
+from sesam_duke_microservice_tpu.core.config import parse_config
+from sesam_duke_microservice_tpu.engine.scheduler import (
+    IngestScheduler,
+    parse_tenant_weights,
+)
+from sesam_duke_microservice_tpu.engine.workload import build_workload
+from sesam_duke_microservice_tpu.links.base import Link, LinkKind, LinkStatus
+from sesam_duke_microservice_tpu.links.journal import LinkJournal
+from sesam_duke_microservice_tpu.links.replica import encode_link
+from sesam_duke_microservice_tpu.links.sqlite import SqliteLinkDatabase
+from sesam_duke_microservice_tpu.ops import arena as arena_mod
+from sesam_duke_microservice_tpu.ops.arena import (
+    ARENA,
+    ArenaAdmissionError,
+    DeviceArena,
+)
+from sesam_duke_microservice_tpu.service.app import DukeApp, serve
+from sesam_duke_microservice_tpu.telemetry import memory
+from sesam_duke_microservice_tpu.utils import faults
+from sesam_duke_microservice_tpu.utils.jit_cache import SHARED_LADDERS
+
+from test_observability import parse_exposition  # noqa: F401
+from test_scheduler import CONFIG_XML, EventLog, link_rows
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate(monkeypatch):
+    # pin the density features on regardless of the CI leg's env (the
+    # arena=0 legacy leg runs this suite too — tests that exercise the
+    # opt-outs set DUKE_ARENA/DUKE_SHARED_AOT=0 themselves)
+    monkeypatch.setenv("DUKE_ARENA", "1")
+    monkeypatch.setenv("DUKE_SHARED_AOT", "1")
+    faults.configure("")
+    ARENA._reset_for_tests()
+    SHARED_LADDERS._reset_for_tests()
+    yield
+    faults.configure(None)
+    ARENA._reset_for_tests()
+    SHARED_LADDERS._reset_for_tests()
+
+
+@pytest.fixture()
+def sc(monkeypatch):
+    monkeypatch.setenv("MIN_RELEVANCE", "0.05")
+    return parse_config(CONFIG_XML)
+
+
+class _Owner:
+    """A fake corpus: admission needs only a spill callable."""
+
+    def __init__(self):
+        self.spilled = 0
+
+    def spill(self) -> int:
+        self.spilled += 1
+        return 0
+
+
+def _arena(budget):
+    a = DeviceArena()
+    a._budget_bytes = lambda: float(budget)
+    return a
+
+
+# -- tentpole a: the shared device memory arena (unit) ------------------------
+
+
+class TestArenaUnit:
+    def test_admit_within_budget_keeps_everyone_resident(self):
+        a = _arena(1000)
+        o1, o2 = _Owner(), _Owner()
+        a.admit(o1, 400, spill=o1.spill, label="t1")
+        a.admit(o2, 400, spill=o2.spill, label="t2")
+        assert a.tier_bytes() == {"device": 800, "host": 0}
+        assert (o1.spilled, o2.spilled) == (0, 0)
+        assert a.admissions == 2 and a.spills == 0
+
+    def test_eviction_picks_the_coldest_tenant_first(self):
+        a = _arena(1000)
+        hot, cold, new = _Owner(), _Owner(), _Owner()
+        a.admit(cold, 400, spill=cold.spill, label="cold",
+                heat=lambda: 0.01)
+        a.admit(hot, 400, spill=hot.spill, label="hot",
+                heat=lambda: 99.0)
+        a.admit(new, 400, spill=new.spill, label="new")
+        assert cold.spilled == 1 and hot.spilled == 0
+        assert a.tier_bytes() == {"device": 800, "host": 400}
+        assert a.spills == 1
+
+    def test_admitting_owner_is_never_its_own_victim(self):
+        a = _arena(1000)
+        o = _Owner()
+        a.admit(o, 900, spill=o.spill)
+        # regrow past the budget alone: must reject, not self-spill
+        with pytest.raises(ArenaAdmissionError):
+            a.admit(o, 1100, spill=o.spill)
+        assert o.spilled == 0 and a.rejections == 1
+
+    def test_budget_exhaustion_raises_not_ooms(self):
+        a = _arena(500)
+        o1, o2 = _Owner(), _Owner()
+        a.admit(o1, 300, spill=o1.spill, label="resident")
+        with pytest.raises(ArenaAdmissionError) as e:
+            a.admit(o2, 600, spill=o2.spill, label="huge")
+        assert e.value.need == 600 and e.value.budget == 500
+        # a doomed admission must not evict bystanders on the way down
+        assert o1.spilled == 0
+        assert a.tier_bytes()["device"] == 300
+
+    def test_fault_in_counts_only_after_a_spill(self):
+        a = _arena(500)
+        o1, o2 = _Owner(), _Owner()
+        a.admit(o1, 300, spill=o1.spill)     # cold start: not a fault
+        a.admit(o2, 300, spill=o2.spill)     # spills o1
+        assert a.faults == 0
+        a.admit(o1, 300, spill=o1.spill)     # fault-in (spills o2)
+        assert a.faults == 1
+        a.admit(o1, 300, spill=o1.spill)     # steady state: no-op
+        assert a.faults == 1 and a.admissions == 3
+
+    def test_disabled_arena_is_a_noop(self, monkeypatch):
+        monkeypatch.setenv("DUKE_ARENA", "0")
+        a = _arena(10)
+        o = _Owner()
+        a.admit(o, 1 << 30, spill=o.spill)  # way past budget: no reject
+        assert a.tier_bytes() == {"device": 0, "host": 0}
+
+    def test_dead_owners_are_pruned(self):
+        a = _arena(1000)
+        o = _Owner()
+        a.admit(o, 400, spill=o.spill)
+        del o
+        import gc
+
+        gc.collect()
+        assert a.tier_bytes() == {"device": 0, "host": 0}
+
+    def test_debug_snapshot_shape(self):
+        a = _arena(1000)
+        o = _Owner()
+        a.admit(o, 400, spill=o.spill, label="dedup/people",
+                heat=lambda: 1.25)
+        snap = a.debug_snapshot()
+        assert snap["enabled"] is True
+        (row,) = snap["leases"]
+        assert row == {"label": "dedup/people", "bytes": 400,
+                       "resident": True, "faults": 0,
+                       "heat_device_seconds": 1.25}
+        assert snap["tiers"] == {"device": 400, "host": 0}
+
+
+# -- tentpole a: spill -> fault-in bit-identity (device backend) --------------
+
+
+REQUESTS = [
+    ("crm", [{"_id": "a1", "name": "acme corp", "email": "a@x.no"},
+             {"_id": "a2", "name": "acme corp", "email": "a@x.no"}]),
+    ("reg", [{"_id": "r1", "name": "bolt ltd"},
+             {"_id": "r2", "name": "bolt ltd"}]),
+    ("crm", [{"_id": "a3", "name": "quux as", "email": "q@x.no"},
+             {"_id": "a4", "name": "quux as", "email": "q@x.no"}]),
+    ("reg", [{"_id": "r3", "name": "acme corp"}]),
+]
+
+
+def _run_two_tenants(sc, budget=None):
+    """Drive two device workloads through REQUESTS, optionally forcing
+    the global arena's budget so the second tenant's admission spills
+    the first.  Returns (tapes, rows, faults, spills)."""
+    wls = {
+        "people": build_workload(sc.deduplications["people"], sc,
+                                 backend="device", persistent=False),
+        "orgs": build_workload(sc.deduplications["orgs"], sc,
+                               backend="device", persistent=False),
+    }
+    logs = {}
+    for name, wl in wls.items():
+        logs[name] = EventLog()
+        wl.processor.add_match_listener(logs[name])
+    old_budget = ARENA._budget_bytes
+    try:
+        if budget is not None:
+            ARENA._budget_bytes = lambda: float(budget)
+        for dataset, entities in REQUESTS:
+            wl = wls["people"] if dataset == "crm" else wls["orgs"]
+            wl.submit_batch(dataset, entities)
+        tapes = {n: logs[n].events for n in wls}
+        rows = {n: link_rows(wls[n]) for n in wls}
+        return tapes, rows, ARENA.faults, ARENA.spills
+    finally:
+        ARENA._budget_bytes = old_budget
+        for wl in wls.values():
+            wl.close()
+
+
+class TestSpillFaultInBitIdentity:
+    def test_spill_and_fault_in_tapes_bit_identical(self, sc, monkeypatch):
+        # control: arena off, both tenants pinned (the legacy behavior)
+        monkeypatch.setenv("DUKE_ARENA", "0")
+        control_tapes, control_rows, _, _ = _run_two_tenants(sc)
+        ARENA._reset_for_tests()
+
+        # arena on with a budget that fits ONE tenant: each dataset flip
+        # in REQUESTS forces a spill of the other tenant and a fault-in
+        monkeypatch.setenv("DUKE_ARENA", "1")
+        wl = build_workload(sc.deduplications["people"], sc,
+                            backend="device", persistent=False)
+        try:
+            wl.submit_batch("crm", REQUESTS[0][1])
+            one = wl.index.corpus._device_nbytes()
+            assert one > 0
+        finally:
+            wl.close()
+        ARENA._reset_for_tests()
+
+        tapes, rows, faults, spills = _run_two_tenants(
+            sc, budget=int(one * 1.5))
+        assert spills >= 2, "the budget must actually force spills"
+        assert faults >= 1, "a spilled tenant must fault back in"
+        assert tapes == control_tapes
+        assert rows == control_rows
+        assert rows["people"], "the duplicate upserts must have linked"
+
+    def test_arena_families_render_after_spill(self, sc, monkeypatch):
+        monkeypatch.setenv("DUKE_ARENA", "1")
+        wl = build_workload(sc.deduplications["people"], sc,
+                            backend="device", persistent=False)
+        try:
+            wl.submit_batch("crm", REQUESTS[0][1])
+            one = wl.index.corpus._device_nbytes()
+            old = ARENA._budget_bytes
+            ARENA._budget_bytes = lambda: float(one * 1.1)
+            try:
+                wl2 = build_workload(sc.deduplications["orgs"], sc,
+                                     backend="device", persistent=False)
+                try:
+                    wl2.submit_batch("reg", REQUESTS[1][1])
+                    wl.submit_batch("crm", REQUESTS[2][1])  # fault-in
+                finally:
+                    wl2.close()
+            finally:
+                ARENA._budget_bytes = old
+            scraped = parse_exposition(telemetry.render(telemetry.GLOBAL))
+            dev = scraped[("duke_arena_bytes", (("tier", "device"),))]
+            assert dev > 0
+            assert ("duke_arena_bytes", (("tier", "host"),)) in scraped
+            assert scraped[("duke_arena_faults_total", ())] >= 1.0
+        finally:
+            wl.close()
+
+    def test_ledger_attributes_arena_once(self, sc, monkeypatch):
+        """Satellite 1: with the arena on, resident slab bytes sit under
+        the arena owner while tenants keep LOGICAL views — the budget
+        totals count the slabs exactly once."""
+        monkeypatch.setenv("DUKE_ARENA", "1")
+        memory._reset_for_tests()
+        wl = build_workload(sc.deduplications["people"], sc,
+                            backend="device", persistent=False)
+        try:
+            wl.submit_batch("crm", REQUESTS[0][1])
+            snap = memory.debug_snapshot()
+            arena_rows = [r for r in snap["workloads"]
+                          if r["kind"] == "arena"]
+            logical_rows = [r for r in snap["workloads"]
+                            if r.get("logical")]
+            assert arena_rows, "arena must re-enroll after a ledger reset"
+            assert logical_rows, "tenants must keep logical views"
+            arena_total = sum(r["bytes"] for r in arena_rows)
+            logical_corpus = sum(
+                r["bytes"] for r in logical_rows
+                if r["component"] in memory._ARENA_VIEW_COMPONENTS)
+            assert arena_total == pytest.approx(logical_corpus)
+            # the budget total counts the slabs once: arena rows plus
+            # process-level components (AOT executables, feature cache)
+            # — the tenants' logical corpus views add NOTHING on top
+            process_total = sum(snap["process"].values())
+            assert snap["total_bytes"] == pytest.approx(
+                arena_total + process_total)
+            assert snap["arena"]["tiers"]["device"] == arena_total
+        finally:
+            wl.close()
+            memory._reset_for_tests()
+
+
+# -- tentpole a: budget exhaustion is a loud 503 ------------------------------
+
+
+class _NoRedirect(urllib.request.HTTPRedirectHandler):
+    def redirect_request(self, *args, **kwargs):
+        return None
+
+
+_opener = urllib.request.build_opener(_NoRedirect)
+
+
+def _request(url, method="GET", body=None, headers=None, timeout=30):
+    req = urllib.request.Request(url, data=body, method=method,
+                                 headers=headers or {})
+    try:
+        with _opener.open(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _post(url, payload):
+    return _request(url, "POST", json.dumps(payload).encode(),
+                    {"Content-Type": "application/json"})
+
+
+class TestBudgetCeiling503:
+    def test_exhausted_budget_maps_to_503_with_retry_after(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MIN_RELEVANCE", "0.05")
+        monkeypatch.setenv("DUKE_ARENA", "1")
+        sc = parse_config(CONFIG_XML)
+        app = DukeApp(sc, backend="device", persistent=False)
+        server = serve(app, port=0, host="127.0.0.1")
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        old = ARENA._budget_bytes
+        try:
+            ARENA._budget_bytes = lambda: 16.0  # nothing fits
+            status, headers, body = _post(
+                url + "/deduplication/people/crm",
+                [{"_id": "x1", "name": "acme", "email": "a@x"}])
+            assert status == 503
+            assert "HBM budget exhausted" in body.decode()
+            assert headers.get("Retry-After")
+            # raising the ceiling heals the tenant without a restart
+            ARENA._budget_bytes = old
+            status, _, _ = _post(
+                url + "/deduplication/people/crm",
+                [{"_id": "x2", "name": "acme", "email": "a@x"}])
+            assert status == 200
+        finally:
+            ARENA._budget_bytes = old
+            server.shutdown()
+            app.close()
+
+
+# -- tentpole b: the deduplicated AOT ladder ----------------------------------
+
+
+class TestSharedLadder:
+    def _two_same_schema(self, sc):
+        w1 = build_workload(sc.deduplications["people"], sc,
+                            backend="device", persistent=False)
+        w2 = build_workload(parse_config(CONFIG_XML).deduplications["people"],
+                            sc, backend="device", persistent=False)
+        return w1, w2
+
+    def test_same_schema_tenants_share_one_ladder(self, sc):
+        w1, w2 = self._two_same_schema(sc)
+        try:
+            w1.submit_batch("crm", REQUESTS[0][1])
+            w2.submit_batch("crm", REQUESTS[0][1])
+            c1 = w1.index.scorer_cache
+            c2 = w2.index.scorer_cache
+            assert c1._aot is c2._aot, \
+                "same (fingerprint, geometry) must lease ONE ladder"
+            stats = SHARED_LADDERS.stats()
+            assert stats["ladders"] == 1 and stats["refs"] == 2
+            # an executable registered through one tenant serves all:
+            # the maps are the same object, so dispatch on tenant 2 hits
+            # entries tenant 1 compiled (the N-tenants-one-compile win)
+            if c1._aot:
+                akey = next(iter(c1._aot))
+                assert c2._aot[akey] is c1._aot[akey]
+        finally:
+            w1.close()
+            w2.close()
+        # refcounted evict: both leases released on close
+        assert SHARED_LADDERS.stats() == {
+            "ladders": 0, "refs": 0, "executables": 0}
+
+    def test_last_release_evicts_the_ladder(self, sc):
+        w1, w2 = self._two_same_schema(sc)
+        w1.submit_batch("crm", REQUESTS[0][1])
+        w2.submit_batch("crm", REQUESTS[0][1])
+        shared_map = w1.index.scorer_cache._aot
+        w1.close()
+        stats = SHARED_LADDERS.stats()
+        assert stats["ladders"] == 1 and stats["refs"] == 1
+        assert w2.index.scorer_cache._aot is shared_map, \
+            "the survivor keeps the warm ladder"
+        w2.close()
+        assert SHARED_LADDERS.stats()["ladders"] == 0
+
+    def test_plan_move_rebinds_without_disturbing_others(self, sc):
+        """The refcounted form of the eviction seam: a geometry flip
+        (group_filtering here — same facet family as a plan move)
+        rebinds the mover to a NEW key; the other tenant keeps its
+        ladder and executables."""
+        w1, w2 = self._two_same_schema(sc)
+        try:
+            w1.submit_batch("crm", REQUESTS[0][1])
+            w2.submit_batch("crm", REQUESTS[0][1])
+            c1 = w1.index.scorer_cache
+            c2 = w2.index.scorer_cache
+            kept = c2._aot
+            c1._rebind_shared_ladder(True)  # key differs from gf=False
+            stats = SHARED_LADDERS.stats()
+            assert stats["ladders"] == 2 and stats["refs"] == 2
+            assert c1._aot is not c2._aot
+            assert c2._aot is kept
+        finally:
+            w1.close()
+            w2.close()
+        assert SHARED_LADDERS.stats()["ladders"] == 0
+
+    def test_concurrent_plan_mutation_keeps_refcounts_consistent(self, sc):
+        """Two tenants flip between ladder keys concurrently (the
+        worst-case plan-mutation interleaving): refcounts stay exact,
+        no ladder leaks, no double-free."""
+        w1, w2 = self._two_same_schema(sc)
+        try:
+            w1.submit_batch("crm", REQUESTS[0][1])
+            w2.submit_batch("crm", REQUESTS[0][1])
+            caches = [w1.index.scorer_cache, w2.index.scorer_cache]
+            errors = []
+
+            def churn(cache, n):
+                try:
+                    for i in range(n):
+                        cache._rebind_shared_ladder(bool(i % 2))
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+
+            threads = [threading.Thread(target=churn, args=(c, 60))
+                       for c in caches for _ in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert not errors
+            stats = SHARED_LADDERS.stats()
+            assert stats["refs"] == 2
+            assert 1 <= stats["ladders"] <= 2
+            for c in caches:
+                assert c._aot is c._shared_holder[0].map
+        finally:
+            w1.close()
+            w2.close()
+        assert SHARED_LADDERS.stats() == {
+            "ladders": 0, "refs": 0, "executables": 0}
+
+    def test_shared_refs_gauge_renders(self, sc):
+        w1, w2 = self._two_same_schema(sc)
+        try:
+            w1.submit_batch("crm", REQUESTS[0][1])
+            w2.submit_batch("crm", REQUESTS[0][1])
+            scraped = parse_exposition(telemetry.render(telemetry.GLOBAL))
+            assert scraped[("duke_aot_shared_refs", ())] == 2.0
+        finally:
+            w1.close()
+            w2.close()
+
+    def test_shared_ladder_opt_out(self, sc, monkeypatch):
+        monkeypatch.setenv("DUKE_SHARED_AOT", "0")
+        w1, w2 = self._two_same_schema(sc)
+        try:
+            w1.submit_batch("crm", REQUESTS[0][1])
+            w2.submit_batch("crm", REQUESTS[0][1])
+            assert w1.index.scorer_cache._aot is not \
+                w2.index.scorer_cache._aot
+            assert SHARED_LADDERS.stats()["ladders"] == 0
+        finally:
+            w1.close()
+            w2.close()
+
+
+# -- tentpole c: per-tenant quotas --------------------------------------------
+
+
+class TestTenantQuotas:
+    def test_weight_spec_parsing(self):
+        w = parse_tenant_weights("people=2, deduplication/orgs=0.5")
+        assert w == {"people": 2.0, "deduplication/orgs": 0.5}
+        # malformed entries are skipped, never fatal; negatives clamp
+        w = parse_tenant_weights("a=junk,b,=3,c=-1,d=4")
+        assert w == {"c": 0.0, "d": 4.0}
+        assert parse_tenant_weights(None) == {}
+
+    def test_weights_scale_the_round_quantum(self, sc, monkeypatch):
+        monkeypatch.setenv("DUKE_TENANT_WEIGHT",
+                           "deduplication/people=2,orgs=0.5")
+        wls = {
+            "people": build_workload(sc.deduplications["people"], sc,
+                                     backend="host", persistent=False),
+            "orgs": build_workload(sc.deduplications["orgs"], sc,
+                                   backend="host", persistent=False),
+        }
+        sched = IngestScheduler(lambda kind, name: wls[name])
+        try:
+            sched.submit("deduplication", "people", "crm",
+                         [{"_id": "p1", "name": "acme", "email": "a@x"}])
+            sched.submit("deduplication", "orgs", "reg",
+                         [{"_id": "o1", "name": "acme"}])
+            by_name = {q.name: q for q in sched.queues()}
+            assert by_name["people"].weight == 2.0
+            assert by_name["orgs"].weight == 0.5
+            assert sched._quantum_for(by_name["people"]) == \
+                2 * sched.quantum
+            assert sched._quantum_for(by_name["orgs"]) == \
+                max(int(sched.quantum * sched.min_share),
+                    sched.quantum // 2)
+        finally:
+            sched.shutdown()
+            for wl in wls.values():
+                wl.close()
+
+    def test_zero_weight_still_drains_via_min_share_floor(
+            self, sc, monkeypatch):
+        """Starvation-proof: a zero-weighted tenant's grant is the
+        min-share floor — its requests complete, just last."""
+        monkeypatch.setenv("DUKE_TENANT_WEIGHT", "people=0")
+        wl = build_workload(sc.deduplications["people"], sc,
+                            backend="host", persistent=False)
+        sched = IngestScheduler(lambda kind, name: wl)
+        try:
+            sched.submit("deduplication", "people", "crm",
+                         [{"_id": f"z{i}", "name": f"zed {i}",
+                           "email": f"z{i}@x"} for i in range(8)])
+            (q,) = sched.queues()
+            assert q.weight == 0.0
+            assert sched._quantum_for(q) == max(
+                1, int(sched.quantum * sched.min_share))
+            assert q.dispatched_records == 8  # it actually drained
+        finally:
+            sched.shutdown()
+            wl.close()
+
+    def test_throttled_rounds_count_and_work_completes(
+            self, sc, monkeypatch):
+        """A batch wider than the tenant's grant defers to later rounds
+        (deficit accumulates) and each deferral counts into the
+        ``duke_tenant_throttled_total`` family."""
+        monkeypatch.setenv("DUKE_SCHED_QUANTUM", "2")
+        wl = build_workload(sc.deduplications["people"], sc,
+                            backend="host", persistent=False)
+        sched = IngestScheduler(lambda kind, name: wl, start=False)
+        try:
+            t = threading.Thread(
+                target=sched.submit,
+                args=("deduplication", "people", "crm",
+                      [{"_id": f"t{i}", "name": f"tee {i}",
+                        "email": f"t{i}@x"} for i in range(8)]))
+            t.start()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                qs = sched.queues()
+                if qs and qs[0].pending:
+                    break
+                time.sleep(0.01)
+            sched.start()
+            t.join(timeout=30)
+            assert not t.is_alive()
+            (q,) = sched.queues()
+            assert q.throttled >= 1, \
+                "an 8-record batch on a quantum of 2 must defer rounds"
+            assert q.dispatched_records == 8
+            snap = sched.stats_snapshot()
+            assert snap["min_share"] == pytest.approx(0.05)
+            (row,) = snap["workloads"]
+            assert row["throttled"] == q.throttled
+            assert row["weight"] == 1.0
+        finally:
+            sched.shutdown()
+            wl.close()
+
+    def test_down_weighted_retry_after_scales(self, sc, monkeypatch):
+        """A down-weighted tenant's 429 Retry-After reflects ITS drain
+        rate (est / weight), not the fleet's."""
+        monkeypatch.setenv("DUKE_TENANT_WEIGHT", "people=0.25")
+        wl = build_workload(sc.deduplications["people"], sc,
+                            backend="host", persistent=False)
+        sched = IngestScheduler(lambda kind, name: wl, start=False)
+        try:
+            # seed the queue so the estimator sees backlog + weight
+            t = threading.Thread(
+                target=sched.submit,
+                args=("deduplication", "people", "crm",
+                      [{"_id": f"w{i}", "name": "acme", "email": "a@x"}
+                       for i in range(4)]))
+            t.start()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                qs = sched.queues()
+                if qs and qs[0].pending:
+                    break
+                time.sleep(0.01)
+            (q,) = sched.queues()
+            assert q.weight == 0.25
+            with sched._cv:
+                sched._ewma_sec_per_record = 2.0  # 4 records -> est 8 s
+                weighted = sched._retry_after_locked(q)
+                q.weight = 1.0
+                unweighted = sched._retry_after_locked(q)
+                q.weight = 0.25
+            assert unweighted == 8
+            assert weighted == 32, \
+                "0.25-weight drains 4x slower: Retry-After must say so"
+            sched.start()
+            t.join(timeout=30)
+        finally:
+            sched.shutdown()
+            wl.close()
+
+
+# -- satellite: per-tenant recovery isolation ---------------------------------
+
+
+TWO_TENANT_DURABLE_XML = """
+<DukeMicroService dataFolder="{folder}">
+  <Deduplication name="people">
+    <duke>
+      <schema>
+        <threshold>0.8</threshold>
+        <property><name>NAME</name><comparator>levenshtein</comparator><low>0.1</low><high>0.95</high></property>
+      </schema>
+      <data-source class="io.sesam.dukemicroservice.IncrementalDeduplicationDataSource">
+        <param name="dataset-id" value="crm"/>
+        <column name="name" property="NAME"/>
+      </data-source>
+    </duke>
+  </Deduplication>
+  <Deduplication name="orgs">
+    <duke>
+      <schema>
+        <threshold>0.8</threshold>
+        <property><name>NAME</name><comparator>levenshtein</comparator><low>0.1</low><high>0.95</high></property>
+      </schema>
+      <data-source class="io.sesam.dukemicroservice.IncrementalDeduplicationDataSource">
+        <param name="dataset-id" value="reg"/>
+        <column name="name" property="NAME"/>
+      </data-source>
+    </duke>
+  </Deduplication>
+</DukeMicroService>
+"""
+
+
+def _link(i, t0=1_000_000):
+    return Link(f"a{i}", f"b{i}", LinkStatus.INFERRED, LinkKind.DUPLICATE,
+                0.9, t0 + i)
+
+
+class TestRecoveryIsolation:
+    def test_tenant_a_replay_fences_only_tenant_a(
+            self, tmp_path, monkeypatch):
+        """PR 14's per-folder scoping, proven end to end: tenant A boots
+        into a journal replay of a large acked backlog; for the whole
+        replay window A's writes 503 with Retry-After while B's ingest
+        lands 200.  When A's fence lifts, A writes normally and the
+        recovered backlog is intact."""
+        monkeypatch.setenv("MIN_RELEVANCE", "0.05")
+        monkeypatch.setenv("DUKE_JOURNAL", "1")  # pin under the =0 CI leg
+        folder = tmp_path / "deduplication" / "people"
+        folder.mkdir(parents=True)
+        n = 1024
+        j = LinkJournal(str(folder / "linkdatabase.journal"), sync="none")
+        for i in range(n):
+            j.append_batch([encode_link(_link(i))])
+        j.close()
+
+        # slow each replay chunk so the overlap window is deterministic:
+        # only the link-recovery thread gates (B has no backlog, and
+        # post-fence flushes run on the write-behind thread)
+        real = SqliteLinkDatabase.assert_links
+
+        def gated(self, links):
+            if threading.current_thread().name == "link-recovery":
+                time.sleep(0.35)
+            return real(self, links)
+
+        monkeypatch.setattr(SqliteLinkDatabase, "assert_links", gated)
+        sc = parse_config(TWO_TENANT_DURABLE_XML.format(folder=tmp_path))
+        app = DukeApp(sc, backend="host", persistent=True)
+        server = serve(app, port=0, host="127.0.0.1")
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            a_db = app.deduplications["people"].link_database
+            assert getattr(a_db, "recovering", False), \
+                "the 1024-batch backlog must still be replaying"
+            # tenant A: fenced for the whole replay
+            status, headers, body = _post(
+                url + "/deduplication/people/crm",
+                [{"_id": "pa", "name": "fenced write"}])
+            assert status == 503
+            assert headers.get("Retry-After")
+            assert "replaying" in body.decode()
+            # tenant B: completely unaffected, repeatedly, while A is
+            # still mid-replay (asserted before AND after the writes)
+            for i in range(3):
+                status, headers, _ = _post(
+                    url + "/deduplication/orgs/reg",
+                    [{"_id": f"ob{i}", "name": f"org {i}"},
+                     {"_id": f"ob{i}x", "name": f"org {i}"}])
+                assert status == 200, \
+                    "tenant B must ingest while A replays"
+            # fence lifts: A serves writes again, backlog intact
+            deadline = time.monotonic() + 60
+            while getattr(a_db, "recovering", False) and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert not getattr(a_db, "recovering", False)
+            status, _, _ = _post(
+                url + "/deduplication/people/crm",
+                [{"_id": "pz", "name": "post-recovery write"}])
+            assert status == 200
+            recovered = a_db.get_changes_since(0)
+            assert len(recovered) >= n
+            b_rows = app.deduplications["orgs"] \
+                .link_database.get_changes_since(0)
+            assert b_rows, "B's overlapped ingest must have linked"
+        finally:
+            server.shutdown()
+            app.close()

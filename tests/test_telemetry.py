@@ -411,3 +411,68 @@ def test_metrics_scrape_is_lock_free_under_held_workload_lock(live_app):
         t.join(timeout=10)
         assert not t.is_alive(), "/metrics blocked on the workload lock"
     assert result["resp"][0] == 200
+
+
+def test_hbm_component_fns_evaluate_once_per_scrape_pass():
+    """ISSUE 19 satellite: at hundreds of tenants the scrape was
+    re-evaluating every workload's HBM component fn once per consumer
+    (app collector, group collector, totals) — O(consumers x workloads)
+    per pass.  ``render()`` now brackets a ledger pass: however many
+    collectors read ``components_for`` during one exposition, each
+    registered fn runs EXACTLY once, and the pass cache dies with the
+    render (no staleness outside it)."""
+    import time as _time
+
+    from sesam_duke_microservice_tpu.telemetry import memory
+    from sesam_duke_microservice_tpu.telemetry.registry import (
+        FamilySnapshot,
+    )
+
+    memory._reset_for_tests()
+
+    class _Owner:
+        pass
+
+    n = 200
+    calls = [0] * n
+    owners = []
+    for i in range(n):
+        owner = _Owner()
+        owners.append(owner)
+
+        def fn(i=i):
+            calls[i] += 1
+            return {"corpus_tensors": 1024}
+
+        memory.register(owner, "deduplication", f"wl{i}", fn)
+
+    def collector():
+        # reads every owner TWICE, like the app + group collectors
+        # both scanning the same registrations inside one scrape
+        samples = []
+        for _kind, name, owner, _fn, _logical in memory._iter_live():
+            first = memory.components_for(owner)
+            assert memory.components_for(owner) == first
+            samples.append(
+                ("", (("workload", name),), float(sum(first.values()))))
+        return [FamilySnapshot("duke_hbm_test_bytes", "gauge", "per-"
+                               "tenant test bytes", samples)]
+
+    registry = MetricRegistry()
+    registry.register_collector(collector)
+    try:
+        t0 = _time.perf_counter()
+        text = render(registry)
+        elapsed = _time.perf_counter() - t0
+        assert text.count("duke_hbm_test_bytes{") == n
+        assert calls == [1] * n, \
+            "each component fn must run exactly once per scrape pass"
+        # the O(workloads) latency bound: one pass over 200 tenants is
+        # interpreter-speed work; the generous ceiling catches a
+        # regression back to O(consumers x workloads) device syncs
+        assert elapsed < 2.0
+        # outside a render, reads evaluate fresh every time
+        memory.components_for(owners[0])
+        assert calls[0] == 2
+    finally:
+        memory._reset_for_tests()
