@@ -53,6 +53,7 @@ from ..ops.arena import ArenaAdmissionError
 from ..telemetry import slo, tracing
 from ..telemetry.env import env_flag, env_str
 from ..telemetry.logctx import new_request_id, request_id_var
+from ..telemetry.probes import is_probe_name, probes_enabled
 from . import debug as debug_api
 from .homepage import render_homepage
 from .metrics import (
@@ -216,8 +217,26 @@ class DukeApp:
         # lock-winner merge inside Workload.submit_batch.
         self.scheduler = (IngestScheduler(self._resolve_workload)
                           if scheduler_enabled() else None)
+        # black-box canary prober (ISSUE 20): one shadow workload per
+        # user workload under the reserved __probe__ namespace, cycling
+        # the derived canary corpus through the REAL path (scheduler,
+        # scoring, finalize, link journal, feed materialization) on a
+        # background interval.  Shadows live only here — never in the
+        # HTTP registries — and DUKE_PROBE=0 restores today's behavior
+        # exactly (no prober object, no thread, no collector).
+        self.prober = None
+        if probes_enabled():
+            from .prober import CanaryProber
+
+            self.prober = CanaryProber(self)
+            self.prober.start()
 
     def _resolve_workload(self, kind: str, name: str) -> Optional[Workload]:
+        if is_probe_name(name):
+            # scheduler dispatch for canary batches: probe names resolve
+            # through the prober's shadow registry, invisible to HTTP
+            prober = getattr(self, "prober", None)
+            return prober.resolve(kind, name) if prober is not None else None
         registry = (self.deduplications if kind == "deduplication"
                     else self.record_linkages)
         return registry.get(name)
@@ -441,6 +460,10 @@ class DukeApp:
             self._close_done.wait()
             return
         try:
+            # stop the canary prober before the scheduler drain: its
+            # cycles submit through the scheduler this is shutting down
+            if getattr(self, "prober", None) is not None:
+                self.prober.stop()
             # drain the ingest scheduler FIRST: queued requests complete
             # against still-open workloads (no lost requests), and the
             # dispatcher must be able to take the workload locks this
@@ -501,6 +524,7 @@ _STATIC_ROUTES = frozenset((
     "/debug/traces", "/debug/requests", "/debug/decisions", "/explain",
     "/debug/profile", "/debug/profile/reset",
     "/debug/costs", "/debug/memory", "/debug/loadmap", "/debug/slo",
+    "/debug/probes",
 ))
 
 
@@ -714,6 +738,15 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
             prewarm_errors = self.app.prewarm_errors()
             if prewarm_errors:
                 health["prewarm_errors"] = prewarm_errors
+            # canary verdict mismatches are a CORRECTNESS incident: the
+            # status flips to degraded (still 200 — the process is
+            # alive) and names the offending workloads (ISSUE 20)
+            prober = getattr(self.app, "prober", None)
+            probe_detail = (prober.health_detail()
+                            if prober is not None else None)
+            if probe_detail is not None:
+                health["status"] = "degraded"
+                health["probe_verdict_mismatches"] = probe_detail
             self._reply(200, json.dumps(health).encode("utf-8"),
                         "application/json")
         elif path == "/readyz":
@@ -747,6 +780,9 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
             self._reply(*debug_api.handle_loadmap(None))
         elif path == "/debug/slo":
             self._reply(*debug_api.handle_slo())
+        elif path == "/debug/probes":
+            self._reply(*debug_api.handle_probes(
+                getattr(self.app, "prober", None)))
         elif m := _ENTITY_PATH.match(path):
             self._validate_entity_path(m)
             raise _HttpError(405, "This endpoint only supports POST requests.")
@@ -938,6 +974,12 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
             raise _HttpError(404, f"The {label}Name cannot be an empty string!")
         if not dataset_id:
             raise _HttpError(404, "The datasetId cannot be an empty string!")
+        if is_probe_name(name) or is_probe_name(dataset_id):
+            # namespace-exclusion contract (ISSUE 20): probe shadows are
+            # never HTTP-addressable, even by their real names
+            raise _HttpError(
+                404, "The '__probe__' namespace is reserved for the "
+                     "synthetic canary prober.")
         workload = self._workloads(kind).get(name)
         if workload is None:
             raise _HttpError(
@@ -1085,6 +1127,12 @@ class DukeRequestHandler(BaseHTTPRequestHandler):
         label = _kind_label(kind)
         if not name:
             raise _HttpError(400, f"The {label}Name cannot be an empty string!")
+        if is_probe_name(name):
+            # feed filter half of the namespace-exclusion contract: no
+            # probe shadow's links are ever served to a ?since= poller
+            raise _HttpError(
+                400, "The '__probe__' namespace is reserved for the "
+                     "synthetic canary prober.")
         since = 0
         since_params = query.get("since")
         if since_params and since_params[0]:

@@ -271,3 +271,13 @@ def handle_slo() -> Reply:
     from ..telemetry import slo
 
     return _reply_json(200, slo.debug_snapshot())
+
+
+def handle_probes(prober) -> Reply:
+    """``GET /debug/probes``: per-workload canary probe history with
+    links into /debug/traces and /debug/decisions (``prober`` is None
+    when DUKE_PROBE=0 — report disabled instead of 404 so dashboards
+    can tell "off" from "missing")."""
+    if prober is None:
+        return _reply_json(200, {"enabled": False})
+    return _reply_json(200, prober.debug_snapshot())

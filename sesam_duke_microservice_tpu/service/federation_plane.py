@@ -70,6 +70,7 @@ from ..federation.router import (
 )
 from ..telemetry import FamilySnapshot, MetricRegistry, heat, slo, tracing
 from ..telemetry.logctx import new_request_id, request_id_var
+from ..telemetry.probes import probes_enabled
 from ..telemetry.registry import DEFAULT_LATENCY_BUCKETS, histogram_snapshot
 from ..telemetry.rollup import GroupRollup
 from . import debug as debug_api
@@ -175,6 +176,7 @@ _FED_STATIC_ROUTES = frozenset((
     "/debug/traces", "/debug/requests", "/debug/migrations",
     "/debug/profile", "/debug/profile/reset",
     "/debug/costs", "/debug/memory", "/debug/loadmap", "/debug/slo",
+    "/debug/probes",
 ))
 
 
@@ -197,6 +199,7 @@ class FederationHandler(BaseHTTPRequestHandler):
     fed: Federation = None  # set by serve_federation()
     registry: MetricRegistry = None
     rollup: GroupRollup = None
+    range_prober = None  # set by serve_federation() when DUKE_PROBE=1
     protocol_version = "HTTP/1.1"
 
     # class-level defaults keep _reply safe for direct/test callers that
@@ -328,6 +331,11 @@ class FederationHandler(BaseHTTPRequestHandler):
             self._reply(*debug_api.handle_loadmap(self.fed.router.heat))
         elif path == "/debug/slo":
             self._reply(*debug_api.handle_slo())
+        elif path == "/debug/probes":
+            if self.range_prober is None:
+                self._reply_json(200, {"enabled": False})
+            else:
+                self._reply_json(200, self.range_prober.snapshot())
         elif m := _FEED_PATH.match(path):
             self._handle_feed(m, parse_qs(parsed.query))
         else:
@@ -360,13 +368,21 @@ class FederationHandler(BaseHTTPRequestHandler):
 
     def _handle_healthz(self) -> None:
         degraded = self.fed.router.degraded_range_ids()
-        self._reply_json(200, {
+        health = {
             "status": "ok" if not degraded else "degraded",
             "role": "federation-router",
             "groups": len(self.fed.groups),
             "epoch": self.fed.map.epoch,
             "degraded_ranges": degraded,
-        })
+        }
+        # black-box overlay: a range whose reachability probe failed is
+        # degraded even when the router hasn't contacted it yet
+        if self.range_prober is not None:
+            failing = self.range_prober.failing_ranges()
+            if failing:
+                health["status"] = "degraded"
+                health["probe_failing_ranges"] = failing
+        self._reply_json(200, health)
 
     def _handle_readyz(self) -> None:
         recovering = self._recovering_scopes()
@@ -559,15 +575,37 @@ def serve_federation(fed: Federation, port: int = 0,
     # lock-free workload-walking collector; GroupRollup snapshots them
     # sequentially at scrape, so no group lock is ever held across
     # another group's collection
+    # black-box range reachability probing (ISSUE 20): per-range probes
+    # through the group read path; each group's registry carries the
+    # collector for ITS ranges so the rollup merges the fleet view
+    prober = None
+    if probes_enabled():
+        from .prober import RangeProber
+
+        prober = RangeProber(fed)
     group_regs = []
     for g in fed.groups:
         reg = MetricRegistry()
         reg.register_collector(make_group_collector(g))
+        if prober is not None:
+            reg.register_collector(prober.collector_for(g.idx))
         group_regs.append((str(g.idx), reg))
     rollup = GroupRollup(group_regs)
     handler = type("BoundFederationHandler", (FederationHandler,),
-                   {"fed": fed, "registry": registry, "rollup": rollup})
+                   {"fed": fed, "registry": registry, "rollup": rollup,
+                    "range_prober": prober})
     server = ThreadingHTTPServer((host, port), handler)
+    if prober is not None:
+        prober.start()
+        # stop the probe thread with the plane: shutdown() is the
+        # caller-owned teardown seam
+        orig_shutdown = server.shutdown
+
+        def _shutdown():
+            prober.stop()
+            orig_shutdown()
+
+        server.shutdown = _shutdown
     thread = threading.Thread(target=server.serve_forever,
                               name="federation-plane", daemon=True)
     thread.start()

@@ -60,6 +60,11 @@ Spec tokens (``p`` in [0,1]; ``@tag`` filters to one dispatch op tag):
                                    complete, map still names the source),
                                    ``post_cutover`` (map cut over, drain/
                                    cleanup pending)
+  ``probe_flip=<n>``               corrupt the ``n``-th canary verdict
+                                   the prober checks (service/prober.py
+                                   readback seam) — drives the
+                                   mismatch-latch + /healthz-degraded
+                                   detection drill
   ``fed_down=<g>``                 federation group ``g`` is unreachable:
                                    every router call into it raises
                                    GroupUnavailable — drives the
@@ -138,8 +143,11 @@ class FaultPlan:
         self._crash_at: Dict[str, int] = {}
         # federation groups whose router calls fail (ISSUE 14)
         self._fed_down: set = set()
+        # 1-based canary-verdict check occurrence to corrupt (ISSUE 20)
+        self._probe_flip_at: Optional[int] = None
         self._flush_lock = threading.Lock()
         self._flush_count = 0  # guarded by: self._flush_lock
+        self._probe_count = 0  # guarded by: self._flush_lock
         self._lock_count = 0  # guarded by: self._flush_lock
         self._crash_counts: Dict[str, int] = {}  # guarded by: self._flush_lock
         self._parse(spec)
@@ -178,6 +186,8 @@ class FaultPlan:
                     self._crash_at[str(parts[0])] = int(parts[1])
                 elif kind == "fed_down":
                     self._fed_down.add(int(parts[0]))
+                elif kind == "probe_flip":
+                    self._probe_flip_at = int(parts[0])
                 else:
                     raise ValueError(f"unknown fault kind {kind!r}")
             except (IndexError, ValueError) as e:
@@ -282,6 +292,22 @@ class FaultPlan:
     def check_crash(self, site: str) -> None:
         if self.crash_hit(site):
             self.crash_now(site)
+
+    # -- canary prober (ISSUE 20) ---------------------------------------------
+
+    def probe_flip(self) -> bool:
+        """Count one canary-verdict check; True iff this is the
+        configured occurrence (spec ``probe_flip=<n>``) — the prober
+        then corrupts that one verdict at its readback seam, exactly as
+        a finalize corruption would surface."""
+        if self._probe_flip_at is None:
+            return False
+        with self._flush_lock:
+            self._probe_count += 1
+            hit = self._probe_count == self._probe_flip_at
+        if hit:
+            _count("probe_flip")
+        return hit
 
     # -- federation router (ISSUE 14) -----------------------------------------
 

@@ -22,6 +22,11 @@ os.environ.setdefault("DEVICE_MAX_GRAMS", "24")
 # background compile pre-warm off by default in tests (it competes with the
 # slow CPU-interpret compiles); test_device_matcher re-enables it explicitly
 os.environ.setdefault("DEVICE_PREWARM", "0")
+# canary prober (ISSUE 20): keep the background probe cycle from firing
+# mid-test — probe suites drive run_cycle() synchronously, and every
+# other suite should see an idle prober (no shadow builds, no probe
+# traces in the flight recorder)
+os.environ.setdefault("DUKE_PROBE_INTERVAL_S", "3600")
 # AOT executable store (ISSUE 15): point at a session-scoped temp dir so
 # test runs never write the operator's ~/.cache (subprocess-differential
 # tests pin their own DUKE_AOT_DIR); removed at interpreter exit so dev
